@@ -1,0 +1,15 @@
+"""automerge_tpu — a TPU-native convergent-document (CRDT) framework.
+
+Same capabilities as Automerge v0.14.1 (reference at /root/reference): JSON
+documents (maps, lists, text, tables, counters) edited concurrently by many
+actors, merged deterministically with guaranteed convergence, with history,
+undo/redo, save/load, and a vector-clock sync protocol. The backend
+reconciliation runs on a host oracle engine, with a batched JAX/XLA columnar
+engine for the hot merge paths (built out in ``automerge_tpu.ops``).
+"""
+
+from . import backend  # noqa: F401
+from ._common import ROOT_ID  # noqa: F401
+from ._uuid import uuid  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,49 @@
+"""Shared primitives: root id, elemId parsing, vector-clock comparison.
+
+Counterpart of the reference's ``src/common.js`` (see
+/root/reference/src/common.js:1-48), re-expressed for Python. Clocks are plain
+``dict[str, int]`` throughout the framework — the wire format is JSON, and
+device kernels operate on interned/densified clock matrices instead (device
+engine, built in ``automerge_tpu.ops``).
+"""
+
+from __future__ import annotations
+
+import re
+
+# The root object of every document (src/common.js:1).
+ROOT_ID = "00000000-0000-0000-0000-000000000000"
+
+# elemId = "<actorId>:<counter>" — counter is a Lamport timestamp unique per list.
+_ELEM_ID_RE = re.compile(r"^(.*):(\d+)$")
+
+
+def is_object(value) -> bool:
+    return isinstance(value, (dict, list))
+
+
+def less_or_equal(clock1: dict, clock2: dict) -> bool:
+    """True iff every component of clock1 is <= the one in clock2.
+
+    Mirrors src/common.js:27-31: false means clock1 is greater or the clocks
+    are incomparable (concurrent states).
+    """
+    for key in set(clock1) | set(clock2):
+        if clock1.get(key, 0) > clock2.get(key, 0):
+            return False
+    return True
+
+
+def parse_elem_id(elem_id: str):
+    """Split an ``actorId:counter`` element ID into (actor_id, counter).
+
+    Mirrors src/common.js:38-44.
+    """
+    match = _ELEM_ID_RE.match(elem_id or "")
+    if not match:
+        raise ValueError(f"Not a valid elemId: {elem_id}")
+    return match.group(1), int(match.group(2))
+
+
+def make_elem_id(actor_id: str, counter: int) -> str:
+    return f"{actor_id}:{counter}"

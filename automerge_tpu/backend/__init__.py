@@ -1,0 +1,6 @@
+# populated below — facade defined in facade.py, re-exported here at the end of the build step
+from .facade import (  # noqa: F401
+    BackendState, init, apply_changes, apply_local_change, get_patch,
+    get_changes, get_changes_for_actor, get_missing_changes, get_missing_deps,
+    merge, Backend,
+)

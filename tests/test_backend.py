@@ -1,0 +1,530 @@
+"""Golden change→patch fixtures for the backend.
+
+Ported from the reference's backend unit suite
+(/root/reference/test/backend_test.js) — hand-written change JSON in, exact
+patch JSON out, no frontend involved. This is the parity oracle format for the
+TPU engine as well (SURVEY.md §4).
+"""
+
+import pytest
+
+from automerge_tpu._common import ROOT_ID
+from automerge_tpu import backend as Backend
+
+ACTOR = "1234-abcd"
+
+
+def test_assign_key_in_map():
+    change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+        {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "magpie"},
+    ]}
+    s0 = Backend.init()
+    s1, patch1 = Backend.apply_changes(s0, [change1])
+    assert patch1 == {
+        "canUndo": False, "canRedo": False, "clock": {ACTOR: 1}, "deps": {ACTOR: 1},
+        "diffs": [{"action": "set", "obj": ROOT_ID, "path": [], "type": "map",
+                   "key": "bird", "value": "magpie"}],
+    }
+
+
+def test_increment_key_in_map():
+    change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+        {"action": "set", "obj": ROOT_ID, "key": "counter", "value": 1, "datatype": "counter"},
+    ]}
+    change2 = {"actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+        {"action": "inc", "obj": ROOT_ID, "key": "counter", "value": 2},
+    ]}
+    s0 = Backend.init()
+    s1, _ = Backend.apply_changes(s0, [change1])
+    s2, patch2 = Backend.apply_changes(s1, [change2])
+    assert patch2 == {
+        "canUndo": False, "canRedo": False, "clock": {ACTOR: 2}, "deps": {ACTOR: 2},
+        "diffs": [{"action": "set", "obj": ROOT_ID, "path": [], "type": "map",
+                   "key": "counter", "value": 3, "datatype": "counter"}],
+    }
+
+
+def test_conflict_on_same_key():
+    change1 = {"actor": "actor1", "seq": 1, "deps": {}, "ops": [
+        {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "magpie"},
+    ]}
+    change2 = {"actor": "actor2", "seq": 1, "deps": {}, "ops": [
+        {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "blackbird"},
+    ]}
+    s0 = Backend.init()
+    s1, _ = Backend.apply_changes(s0, [change1])
+    s2, patch2 = Backend.apply_changes(s1, [change2])
+    assert patch2 == {
+        "canUndo": False, "canRedo": False,
+        "clock": {"actor1": 1, "actor2": 1}, "deps": {"actor1": 1, "actor2": 1},
+        "diffs": [{"action": "set", "obj": ROOT_ID, "path": [], "type": "map",
+                   "key": "bird", "value": "blackbird",
+                   "conflicts": [{"actor": "actor1", "value": "magpie"}]}],
+    }
+
+
+def test_delete_key_from_map():
+    change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+        {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "magpie"},
+    ]}
+    change2 = {"actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+        {"action": "del", "obj": ROOT_ID, "key": "bird"},
+    ]}
+    s0 = Backend.init()
+    s1, _ = Backend.apply_changes(s0, [change1])
+    s2, patch2 = Backend.apply_changes(s1, [change2])
+    assert patch2 == {
+        "canUndo": False, "canRedo": False, "clock": {ACTOR: 2}, "deps": {ACTOR: 2},
+        "diffs": [{"action": "remove", "obj": ROOT_ID, "path": [], "type": "map", "key": "bird"}],
+    }
+
+
+def test_create_nested_maps():
+    birds = "birds-obj-uuid"
+    change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+        {"action": "makeMap", "obj": birds},
+        {"action": "set", "obj": birds, "key": "wrens", "value": 3},
+        {"action": "link", "obj": ROOT_ID, "key": "birds", "value": birds},
+    ]}
+    s0 = Backend.init()
+    s1, patch1 = Backend.apply_changes(s0, [change1])
+    assert patch1 == {
+        "canUndo": False, "canRedo": False, "clock": {ACTOR: 1}, "deps": {ACTOR: 1},
+        "diffs": [
+            {"action": "create", "obj": birds, "type": "map"},
+            {"action": "set", "obj": birds, "type": "map", "path": None,
+             "key": "wrens", "value": 3},
+            {"action": "set", "obj": ROOT_ID, "type": "map", "path": [],
+             "key": "birds", "value": birds, "link": True},
+        ],
+    }
+
+
+def test_assign_keys_in_nested_maps():
+    birds = "birds-obj-uuid"
+    change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+        {"action": "makeMap", "obj": birds},
+        {"action": "set", "obj": birds, "key": "wrens", "value": 3},
+        {"action": "link", "obj": ROOT_ID, "key": "birds", "value": birds},
+    ]}
+    change2 = {"actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+        {"action": "set", "obj": birds, "key": "sparrows", "value": 15},
+    ]}
+    s0 = Backend.init()
+    s1, _ = Backend.apply_changes(s0, [change1])
+    s2, patch2 = Backend.apply_changes(s1, [change2])
+    assert patch2 == {
+        "canUndo": False, "canRedo": False, "clock": {ACTOR: 2}, "deps": {ACTOR: 2},
+        "diffs": [{"action": "set", "obj": birds, "type": "map", "path": ["birds"],
+                   "key": "sparrows", "value": 15}],
+    }
+
+
+def test_create_lists():
+    birds = "birds-list-uuid"
+    change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+        {"action": "makeList", "obj": birds},
+        {"action": "ins", "obj": birds, "key": "_head", "elem": 1},
+        {"action": "set", "obj": birds, "key": f"{ACTOR}:1", "value": "chaffinch"},
+        {"action": "link", "obj": ROOT_ID, "key": "birds", "value": birds},
+    ]}
+    s0 = Backend.init()
+    s1, patch1 = Backend.apply_changes(s0, [change1])
+    assert patch1 == {
+        "canUndo": False, "canRedo": False, "clock": {ACTOR: 1}, "deps": {ACTOR: 1},
+        "diffs": [
+            {"action": "create", "obj": birds, "type": "list"},
+            {"action": "insert", "obj": birds, "type": "list", "path": None,
+             "index": 0, "value": "chaffinch", "elemId": f"{ACTOR}:1"},
+            {"action": "set", "obj": ROOT_ID, "type": "map", "path": [],
+             "key": "birds", "value": birds, "link": True},
+        ],
+    }
+
+
+def test_apply_updates_inside_lists():
+    birds = "birds-list-uuid"
+    change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+        {"action": "makeList", "obj": birds},
+        {"action": "ins", "obj": birds, "key": "_head", "elem": 1},
+        {"action": "set", "obj": birds, "key": f"{ACTOR}:1", "value": "chaffinch"},
+        {"action": "link", "obj": ROOT_ID, "key": "birds", "value": birds},
+    ]}
+    change2 = {"actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+        {"action": "set", "obj": birds, "key": f"{ACTOR}:1", "value": "greenfinch"},
+    ]}
+    s0 = Backend.init()
+    s1, _ = Backend.apply_changes(s0, [change1])
+    s2, patch2 = Backend.apply_changes(s1, [change2])
+    assert patch2 == {
+        "canUndo": False, "canRedo": False, "clock": {ACTOR: 2}, "deps": {ACTOR: 2},
+        "diffs": [{"action": "set", "obj": birds, "type": "list", "path": ["birds"],
+                   "index": 0, "value": "greenfinch"}],
+    }
+
+
+def test_delete_list_elements():
+    birds = "birds-list-uuid"
+    change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+        {"action": "makeList", "obj": birds},
+        {"action": "ins", "obj": birds, "key": "_head", "elem": 1},
+        {"action": "set", "obj": birds, "key": f"{ACTOR}:1", "value": "chaffinch"},
+        {"action": "link", "obj": ROOT_ID, "key": "birds", "value": birds},
+    ]}
+    change2 = {"actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+        {"action": "del", "obj": birds, "key": f"{ACTOR}:1"},
+    ]}
+    s0 = Backend.init()
+    s1, _ = Backend.apply_changes(s0, [change1])
+    s2, patch2 = Backend.apply_changes(s1, [change2])
+    assert patch2 == {
+        "canUndo": False, "canRedo": False, "clock": {ACTOR: 2}, "deps": {ACTOR: 2},
+        "diffs": [{"action": "remove", "obj": birds, "type": "list", "path": ["birds"], "index": 0}],
+    }
+
+
+def test_insert_and_delete_in_same_change():
+    birds = "birds-list-uuid"
+    change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+        {"action": "makeList", "obj": birds},
+        {"action": "link", "obj": ROOT_ID, "key": "birds", "value": birds},
+    ]}
+    change2 = {"actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+        {"action": "ins", "obj": birds, "key": "_head", "elem": 1},
+        {"action": "del", "obj": birds, "key": f"{ACTOR}:1"},
+    ]}
+    s0 = Backend.init()
+    s1, _ = Backend.apply_changes(s0, [change1])
+    s2, patch2 = Backend.apply_changes(s1, [change2])
+    assert patch2 == {
+        "canUndo": False, "canRedo": False, "clock": {ACTOR: 2}, "deps": {ACTOR: 2},
+        "diffs": [{"action": "maxElem", "obj": birds, "value": 1, "type": "list",
+                   "path": ["birds"]}],
+    }
+
+
+def test_timestamp_at_root():
+    now = 1_700_000_000_000
+    change = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+        {"action": "set", "obj": ROOT_ID, "key": "now", "value": now, "datatype": "timestamp"},
+    ]}
+    s0 = Backend.init()
+    s1, patch = Backend.apply_changes(s0, [change])
+    assert patch == {
+        "canUndo": False, "canRedo": False, "clock": {ACTOR: 1}, "deps": {ACTOR: 1},
+        "diffs": [{"action": "set", "obj": ROOT_ID, "type": "map", "path": [],
+                   "key": "now", "value": now, "datatype": "timestamp"}],
+    }
+
+
+def test_timestamp_in_list():
+    now = 1_700_000_000_000
+    lst = "list-uuid"
+    change = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+        {"action": "makeList", "obj": lst},
+        {"action": "ins", "obj": lst, "key": "_head", "elem": 1},
+        {"action": "set", "obj": lst, "key": f"{ACTOR}:1", "value": now, "datatype": "timestamp"},
+        {"action": "link", "obj": ROOT_ID, "key": "list", "value": lst},
+    ]}
+    s0 = Backend.init()
+    s1, patch = Backend.apply_changes(s0, [change])
+    assert patch == {
+        "canUndo": False, "canRedo": False, "clock": {ACTOR: 1}, "deps": {ACTOR: 1},
+        "diffs": [
+            {"action": "create", "obj": lst, "type": "list"},
+            {"action": "insert", "obj": lst, "type": "list", "path": None, "index": 0,
+             "value": now, "elemId": f"{ACTOR}:1", "datatype": "timestamp"},
+            {"action": "set", "obj": ROOT_ID, "type": "map", "path": [],
+             "key": "list", "value": lst, "link": True},
+        ],
+    }
+
+
+class TestApplyLocalChange:
+    def test_apply_change_requests(self):
+        change1 = {"requestType": "change", "actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "magpie"},
+        ]}
+        s0 = Backend.init()
+        s1, patch1 = Backend.apply_local_change(s0, change1)
+        assert patch1 == {
+            "actor": ACTOR, "seq": 1, "canUndo": True, "canRedo": False,
+            "clock": {ACTOR: 1}, "deps": {ACTOR: 1},
+            "diffs": [{"action": "set", "obj": ROOT_ID, "path": [], "type": "map",
+                       "key": "bird", "value": "magpie"}],
+        }
+
+    def test_throws_on_duplicate_requests(self):
+        change1 = {"requestType": "change", "actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "magpie"},
+        ]}
+        change2 = {"requestType": "change", "actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "jay"},
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_local_change(s0, change1)
+        s2, _ = Backend.apply_local_change(s1, change2)
+        with pytest.raises(ValueError, match="already been applied"):
+            Backend.apply_local_change(s2, change1)
+        with pytest.raises(ValueError, match="already been applied"):
+            Backend.apply_local_change(s2, change2)
+
+
+class TestGetPatch:
+    def test_most_recent_value_for_key(self):
+        change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "magpie"},
+        ]}
+        change2 = {"actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "blackbird"},
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            "canUndo": False, "canRedo": False, "clock": {ACTOR: 2}, "deps": {ACTOR: 2},
+            "diffs": [{"action": "set", "obj": ROOT_ID, "type": "map",
+                       "key": "bird", "value": "blackbird"}],
+        }
+
+    def test_conflicting_values_for_key(self):
+        change1 = {"actor": "actor1", "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "magpie"},
+        ]}
+        change2 = {"actor": "actor2", "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "blackbird"},
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            "canUndo": False, "canRedo": False,
+            "clock": {"actor1": 1, "actor2": 1}, "deps": {"actor1": 1, "actor2": 1},
+            "diffs": [{"action": "set", "obj": ROOT_ID, "type": "map", "key": "bird",
+                       "value": "blackbird",
+                       "conflicts": [{"actor": "actor1", "value": "magpie"}]}],
+        }
+
+    def test_increments_for_key_in_map(self):
+        change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "counter", "value": 1, "datatype": "counter"},
+        ]}
+        change2 = {"actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+            {"action": "inc", "obj": ROOT_ID, "key": "counter", "value": 2},
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            "canUndo": False, "canRedo": False, "clock": {ACTOR: 2}, "deps": {ACTOR: 2},
+            "diffs": [{"action": "set", "obj": ROOT_ID, "type": "map", "key": "counter",
+                       "value": 3, "datatype": "counter"}],
+        }
+
+    def test_nested_maps(self):
+        birds = "birds-obj-uuid"
+        change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "makeMap", "obj": birds},
+            {"action": "set", "obj": birds, "key": "wrens", "value": 3},
+            {"action": "link", "obj": ROOT_ID, "key": "birds", "value": birds},
+        ]}
+        change2 = {"actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+            {"action": "del", "obj": birds, "key": "wrens"},
+            {"action": "set", "obj": birds, "key": "sparrows", "value": 15},
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            "canUndo": False, "canRedo": False, "clock": {ACTOR: 2}, "deps": {ACTOR: 2},
+            "diffs": [
+                {"action": "create", "obj": birds, "type": "map"},
+                {"action": "set", "obj": birds, "type": "map", "key": "sparrows", "value": 15},
+                {"action": "set", "obj": ROOT_ID, "type": "map", "key": "birds",
+                 "value": birds, "link": True},
+            ],
+        }
+
+    def test_create_lists(self):
+        birds = "birds-list-uuid"
+        change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "makeList", "obj": birds},
+            {"action": "ins", "obj": birds, "key": "_head", "elem": 1},
+            {"action": "set", "obj": birds, "key": f"{ACTOR}:1", "value": "chaffinch"},
+            {"action": "link", "obj": ROOT_ID, "key": "birds", "value": birds},
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1])
+        assert Backend.get_patch(s1) == {
+            "canUndo": False, "canRedo": False, "clock": {ACTOR: 1}, "deps": {ACTOR: 1},
+            "diffs": [
+                {"action": "create", "obj": birds, "type": "list"},
+                {"action": "insert", "obj": birds, "type": "list", "index": 0,
+                 "value": "chaffinch", "elemId": f"{ACTOR}:1"},
+                {"action": "maxElem", "obj": birds, "type": "list", "value": 1},
+                {"action": "set", "obj": ROOT_ID, "type": "map", "key": "birds",
+                 "value": birds, "link": True},
+            ],
+        }
+
+    def test_latest_state_of_list(self):
+        birds = "birds-list-uuid"
+        change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "makeList", "obj": birds},
+            {"action": "ins", "obj": birds, "key": "_head", "elem": 1},
+            {"action": "set", "obj": birds, "key": f"{ACTOR}:1", "value": "chaffinch"},
+            {"action": "ins", "obj": birds, "key": f"{ACTOR}:1", "elem": 2},
+            {"action": "set", "obj": birds, "key": f"{ACTOR}:2", "value": "goldfinch"},
+            {"action": "link", "obj": ROOT_ID, "key": "birds", "value": birds},
+        ]}
+        change2 = {"actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+            {"action": "del", "obj": birds, "key": f"{ACTOR}:1"},
+            {"action": "ins", "obj": birds, "key": f"{ACTOR}:1", "elem": 3},
+            {"action": "set", "obj": birds, "key": f"{ACTOR}:3", "value": "greenfinch"},
+            {"action": "set", "obj": birds, "key": f"{ACTOR}:2", "value": "goldfinches!!"},
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1, change2])
+        assert Backend.get_patch(s1) == {
+            "canUndo": False, "canRedo": False, "clock": {ACTOR: 2}, "deps": {ACTOR: 2},
+            "diffs": [
+                {"action": "create", "obj": birds, "type": "list"},
+                {"action": "insert", "obj": birds, "type": "list", "index": 0,
+                 "value": "greenfinch", "elemId": f"{ACTOR}:3"},
+                {"action": "insert", "obj": birds, "type": "list", "index": 1,
+                 "value": "goldfinches!!", "elemId": f"{ACTOR}:2"},
+                {"action": "maxElem", "obj": birds, "type": "list", "value": 3},
+                {"action": "set", "obj": ROOT_ID, "type": "map", "key": "birds",
+                 "value": birds, "link": True},
+            ],
+        }
+
+    def test_nested_maps_in_lists(self):
+        todos, item = "todos-uuid", "item-uuid"
+        change = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "makeList", "obj": todos},
+            {"action": "ins", "obj": todos, "key": "_head", "elem": 1},
+            {"action": "makeMap", "obj": item},
+            {"action": "set", "obj": item, "key": "title", "value": "water plants"},
+            {"action": "set", "obj": item, "key": "done", "value": False},
+            {"action": "link", "obj": todos, "key": f"{ACTOR}:1", "value": item},
+            {"action": "link", "obj": ROOT_ID, "key": "todos", "value": todos},
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change])
+        assert Backend.get_patch(s1) == {
+            "canUndo": False, "canRedo": False, "clock": {ACTOR: 1}, "deps": {ACTOR: 1},
+            "diffs": [
+                {"action": "create", "obj": item, "type": "map"},
+                {"action": "set", "obj": item, "type": "map", "key": "title", "value": "water plants"},
+                {"action": "set", "obj": item, "type": "map", "key": "done", "value": False},
+                {"action": "create", "obj": todos, "type": "list"},
+                {"action": "insert", "obj": todos, "type": "list", "index": 0,
+                 "value": item, "link": True, "elemId": f"{ACTOR}:1"},
+                {"action": "maxElem", "obj": todos, "type": "list", "value": 1},
+                {"action": "set", "obj": ROOT_ID, "type": "map", "key": "todos",
+                 "value": todos, "link": True},
+            ],
+        }
+
+
+class TestCausalOrdering:
+    def test_queues_changes_until_ready(self):
+        change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "magpie"},
+        ]}
+        change2 = {"actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "jay"},
+        ]}
+        s0 = Backend.init()
+        # change2 arrives first: buffered, no diffs, missing deps reported
+        s1, patch1 = Backend.apply_changes(s0, [change2])
+        assert patch1["diffs"] == []
+        assert Backend.get_missing_deps(s1) == {ACTOR: 1}
+        # change1 arrives: both apply in causal order
+        s2, patch2 = Backend.apply_changes(s1, [change1])
+        assert Backend.get_missing_deps(s2) == {}
+        assert [d["value"] for d in patch2["diffs"]] == ["magpie", "jay"]
+        assert patch2["clock"] == {ACTOR: 2}
+
+    def test_duplicate_changes_are_idempotent(self):
+        change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "magpie"},
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, patch = Backend.apply_changes(s1, [change1])
+        assert patch["diffs"] == []
+        assert patch["clock"] == {ACTOR: 1}
+
+    def test_inconsistent_seq_reuse_raises(self):
+        change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "magpie"},
+        ]}
+        change1b = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "jay"},
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1])
+        with pytest.raises(RuntimeError, match="Inconsistent reuse"):
+            Backend.apply_changes(s1, [change1b])
+
+
+class TestStateBranching:
+    """Old BackendStates stay usable after the lineage moves on (the
+    command-log fork replaces Immutable.js persistence)."""
+
+    def test_stale_state_reads(self):
+        change1 = {"actor": ACTOR, "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "magpie"},
+        ]}
+        change2 = {"actor": ACTOR, "seq": 2, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "jay"},
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2, _ = Backend.apply_changes(s1, [change2])
+        # s1 still materializes its own snapshot
+        patch1 = Backend.get_patch(s1)
+        assert patch1["diffs"][-1]["value"] == "magpie"
+        assert patch1["clock"] == {ACTOR: 1}
+        # diffing historical states works
+        assert len(Backend.get_changes(s1, s2)) == 1
+        assert len(Backend.get_changes(s0, s2)) == 2
+
+    def test_stale_state_branching_writes(self):
+        change1 = {"actor": "a1", "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "x", "value": 1},
+        ]}
+        change2a = {"actor": "a1", "seq": 2, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "x", "value": 2},
+        ]}
+        change2b = {"actor": "a2", "seq": 1, "deps": {}, "ops": [
+            {"action": "set", "obj": ROOT_ID, "key": "y", "value": 3},
+        ]}
+        s0 = Backend.init()
+        s1, _ = Backend.apply_changes(s0, [change1])
+        s2a, _ = Backend.apply_changes(s1, [change2a])   # lineage A
+        s2b, patch_b = Backend.apply_changes(s1, [change2b])  # fork from s1
+        assert patch_b["clock"] == {"a1": 1, "a2": 1}
+        assert s2a.clock == {"a1": 2}
+        # both branches materialize correctly
+        pa = Backend.get_patch(s2a)
+        pb = Backend.get_patch(s2b)
+        assert {d["key"]: d["value"] for d in pa["diffs"]} == {"x": 2}
+        assert {d["key"]: d["value"] for d in pb["diffs"]} == {"x": 1, "y": 3}
+
+
+def test_merge_and_get_changes_for_actor():
+    c_one = {"actor": "actor1", "seq": 1, "deps": {}, "ops": [
+        {"action": "set", "obj": ROOT_ID, "key": "document", "value": "watch me now"},
+    ]}
+    c_two1 = {"actor": "actor2", "seq": 1, "deps": {}, "ops": [
+        {"action": "set", "obj": ROOT_ID, "key": "document", "value": "i can mash potato"},
+    ]}
+    c_two2 = {"actor": "actor2", "seq": 2, "deps": {}, "ops": [
+        {"action": "set", "obj": ROOT_ID, "key": "document", "value": "i can do the twist"},
+    ]}
+    one, _ = Backend.apply_changes(Backend.init(), [c_one])
+    two, _ = Backend.apply_changes(Backend.init(), [c_two1, c_two2])
+    merged, patch = Backend.merge(one, two)
+    assert merged.clock == {"actor1": 1, "actor2": 2}
+    actor_changes = Backend.get_changes_for_actor(merged, "actor2")
+    assert len(actor_changes) == 2
+    assert actor_changes[0]["actor"] == "actor2"

@@ -1,0 +1,115 @@
+"""Order-statistic skip list tests: deterministic units with injected levels,
+plus a randomized property test against a plain-list shadow model (the same
+strategy as the reference suite, /root/reference/test/skip_list_test.js:171-224).
+"""
+
+import random
+
+import pytest
+
+from automerge_tpu.backend.skip_list import SkipList
+
+
+def make(level_seq=None):
+    return SkipList(level_source=iter(level_seq) if level_seq else None)
+
+
+class TestBasics:
+    def test_empty(self):
+        s = SkipList()
+        assert len(s) == 0
+        assert s.index_of("a") == -1
+        assert s.key_of(0) is None
+        assert list(s) == []
+
+    def test_insert_and_lookup(self):
+        s = SkipList()
+        s.insert_index(0, "a", 1)
+        s.insert_index(1, "b", 2)
+        s.insert_index(1, "c", 3)  # between a and b
+        assert list(s) == ["a", "c", "b"]
+        assert [s.index_of(k) for k in ("a", "c", "b")] == [0, 1, 2]
+        assert [s.key_of(i) for i in range(3)] == ["a", "c", "b"]
+        assert s.get_value("c") == 3
+
+    def test_insert_after(self):
+        s = SkipList()
+        s.insert_after(None, "a", 1)
+        s.insert_after("a", "b", 2)
+        s.insert_after("a", "c", 3)
+        assert list(s) == ["a", "c", "b"]
+
+    def test_remove(self):
+        s = SkipList()
+        for i, k in enumerate("abcde"):
+            s.insert_index(i, k, i)
+        s.remove_index(2)
+        assert list(s) == ["a", "b", "d", "e"]
+        s.remove_key("a")
+        assert list(s) == ["b", "d", "e"]
+        assert s.index_of("a") == -1
+        assert s.index_of("e") == 2
+
+    def test_set_value(self):
+        s = SkipList()
+        s.insert_index(0, "a", 1)
+        s.set_value("a", 42)
+        assert s.get_value("a") == 42
+
+    def test_duplicate_key_raises(self):
+        s = SkipList()
+        s.insert_index(0, "a")
+        with pytest.raises(ValueError):
+            s.insert_index(1, "a")
+
+    def test_out_of_bounds(self):
+        s = SkipList()
+        with pytest.raises(IndexError):
+            s.insert_index(1, "a")
+        with pytest.raises(IndexError):
+            s.remove_index(0)
+
+    def test_injected_levels_deterministic(self):
+        # Towers of explicit heights still index correctly.
+        s = make(level_seq=[1, 3, 1, 2, 5, 1, 1, 2])
+        for i, k in enumerate("abcdefgh"):
+            s.insert_index(i, k)
+        assert list(s) == list("abcdefgh")
+        for i, k in enumerate("abcdefgh"):
+            assert s.index_of(k) == i
+            assert s.key_of(i) == k
+
+
+def test_property_vs_shadow_model():
+    rng = random.Random(20260729)
+    s = SkipList(random_source=rng.random)
+    shadow = []  # list of (key, value)
+    next_key = 0
+    for step in range(4000):
+        op = rng.random()
+        if op < 0.55 or not shadow:
+            index = rng.randint(0, len(shadow))
+            key = f"k{next_key}"
+            next_key += 1
+            s.insert_index(index, key, step)
+            shadow.insert(index, (key, step))
+        elif op < 0.8:
+            index = rng.randrange(len(shadow))
+            s.remove_index(index)
+            del shadow[index]
+        elif op < 0.9:
+            index = rng.randrange(len(shadow))
+            key, _ = shadow[index]
+            s.set_value(key, step)
+            shadow[index] = (key, step)
+        else:
+            index = rng.randrange(len(shadow))
+            key, value = shadow[index]
+            assert s.index_of(key) == index
+            assert s.key_of(index) == key
+            assert s.get_value(key) == value
+
+    assert len(s) == len(shadow)
+    assert list(s.items()) == shadow
+    for i, (key, _) in enumerate(shadow):
+        assert s.index_of(key) == i
