@@ -9,7 +9,19 @@ engine for the hot merge paths (built out in ``automerge_tpu.ops``).
 """
 
 from . import backend  # noqa: F401
+from . import frontend  # noqa: F401
 from ._common import ROOT_ID  # noqa: F401
 from ._uuid import uuid  # noqa: F401
+from .api import (  # noqa: F401
+    apply_changes, change, diff, empty_change, equals, from_, get_all_changes,
+    get_changes, get_history, get_missing_deps, init, load, merge, redo, save,
+    to_json, undo,
+)
+from .backend import Backend  # noqa: F401
+from .frontend import (  # noqa: F401
+    Counter, Frontend, Table, Text, can_redo, can_undo, get_actor_id,
+    get_conflicts, get_object_by_id, get_object_id, set_actor_id,
+)
+from .sync import Connection, DocSet, WatchableDoc  # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,299 @@
+"""Apply backend diffs to the materialized document tree.
+
+Counterpart of /root/reference/frontend/apply_patch.js: structural sharing via
+an `updated` overlay over the previous `cache`, child->parent `inbound` index
+maintenance (single-parent invariant), and parent re-linking up to the root.
+Text diffs are applied element-wise (the reference batches consecutive
+insert/remove splices purely as a JS-array optimization; semantics are
+identical).
+"""
+
+from __future__ import annotations
+
+from .._common import ROOT_ID, parse_elem_id
+from .types import (Counter, ListDoc, MapDoc, Table, Text, instantiate_table,
+                    instantiate_text, timestamp_to_datetime)
+
+
+def get_value(diff: dict, cache: dict, updated: dict):
+    """Reconstruct the value a diff assigns (apply_patch.js:10-25)."""
+    if diff.get("link"):
+        child = updated.get(diff["value"])
+        return child if child is not None else cache[diff["value"]]
+    datatype = diff.get("datatype")
+    if datatype == "timestamp":
+        return timestamp_to_datetime(diff["value"])
+    if datatype == "counter":
+        return Counter(diff["value"])
+    if datatype is not None:
+        raise TypeError(f"Unknown datatype: {datatype}")
+    return diff["value"]
+
+
+def _is_doc_object(value) -> bool:
+    return isinstance(value, (MapDoc, ListDoc, Table, Text)) and value._object_id
+
+
+def _child_references(obj, key) -> dict:
+    """Object IDs referenced at `key` (value + conflicts) (apply_patch.js:32-41)."""
+    refs = {}
+    if isinstance(obj, ListDoc):
+        conflicts = (obj._conflicts[key] or {}) if 0 <= key < len(obj._conflicts) else {}
+        value = obj[key] if 0 <= key < len(obj) else None
+    else:
+        conflicts = obj._conflicts.get(key) or {}
+        value = dict.get(obj, key)
+    for child in [value, *conflicts.values()]:
+        if _is_doc_object(child):
+            refs[child._object_id] = True
+    return refs
+
+
+def _update_inbound(object_id: str, refs_before: dict, refs_after: dict, inbound: dict):
+    for ref in refs_before:
+        if ref not in refs_after:
+            inbound.pop(ref, None)
+    for ref in refs_after:
+        if inbound.get(ref) is not None and inbound[ref] != object_id:
+            raise ValueError(f"Object {ref} has multiple parents")
+        if ref not in inbound:
+            inbound[ref] = object_id
+
+
+def _clone_map_object(original, object_id: str) -> MapDoc:
+    if original is not None and original._object_id != object_id:
+        raise ValueError(f"cloneMapObject ID mismatch: {original._object_id} != {object_id}")
+    obj = MapDoc(original or {}, object_id=object_id)
+    obj._conflicts = {k: dict(v) for k, v in (original._conflicts if original else {}).items()}
+    return obj
+
+
+def _update_map_object(diff: dict, cache: dict, updated: dict, inbound: dict):
+    object_id = diff["obj"]
+    if object_id not in updated:
+        updated[object_id] = _clone_map_object(cache.get(object_id), object_id)
+    obj = updated[object_id]
+    conflicts = obj._conflicts
+    refs_before, refs_after = {}, {}
+
+    action = diff["action"]
+    if action == "create":
+        pass
+    elif action == "set":
+        refs_before = _child_references(obj, diff["key"])
+        dict.__setitem__(obj, diff["key"], get_value(diff, cache, updated))
+        if diff.get("conflicts"):
+            conflicts[diff["key"]] = {
+                c["actor"]: get_value(c, cache, updated) for c in diff["conflicts"]
+            }
+        else:
+            conflicts.pop(diff["key"], None)
+        refs_after = _child_references(obj, diff["key"])
+    elif action == "remove":
+        refs_before = _child_references(obj, diff["key"])
+        if dict.__contains__(obj, diff["key"]):
+            dict.__delitem__(obj, diff["key"])
+        conflicts.pop(diff["key"], None)
+    else:
+        raise ValueError(f"Unknown action type: {action}")
+
+    _update_inbound(object_id, refs_before, refs_after, inbound)
+
+
+def _parent_map_object(object_id: str, cache: dict, updated: dict):
+    if object_id not in updated:
+        updated[object_id] = _clone_map_object(cache.get(object_id), object_id)
+    obj = updated[object_id]
+    for key in list(obj.keys()):
+        value = dict.get(obj, key)
+        if _is_doc_object(value) and value._object_id in updated:
+            dict.__setitem__(obj, key, updated[value._object_id])
+        conflicts = obj._conflicts.get(key)
+        if conflicts:
+            for actor_id, cvalue in list(conflicts.items()):
+                if _is_doc_object(cvalue) and cvalue._object_id in updated:
+                    conflicts[actor_id] = updated[cvalue._object_id]
+
+
+def _update_table_object(diff: dict, cache: dict, updated: dict, inbound: dict):
+    object_id = diff["obj"]
+    if object_id not in updated:
+        cached = cache.get(object_id)
+        updated[object_id] = cached._clone() if cached else instantiate_table(object_id)
+    table = updated[object_id]
+    refs_before, refs_after = {}, {}
+
+    action = diff["action"]
+    if action == "create":
+        pass
+    elif action == "set":
+        previous = table.by_id(diff["key"])
+        if _is_doc_object(previous):
+            refs_before[previous._object_id] = True
+        if diff.get("link"):
+            child = updated.get(diff["value"])
+            table._set(diff["key"], child if child is not None else cache[diff["value"]])
+            refs_after[diff["value"]] = True
+        else:
+            table._set(diff["key"], diff["value"])
+    elif action == "remove":
+        previous = table.by_id(diff["key"])
+        if _is_doc_object(previous):
+            refs_before[previous._object_id] = True
+        table.remove(diff["key"])
+    else:
+        raise ValueError(f"Unknown action type: {action}")
+
+    _update_inbound(object_id, refs_before, refs_after, inbound)
+
+
+def _parent_table_object(object_id: str, cache: dict, updated: dict):
+    if object_id not in updated:
+        updated[object_id] = cache[object_id]._clone()
+    table = updated[object_id]
+    for key in list(table.entries.keys()):
+        value = table.by_id(key)
+        if _is_doc_object(value) and value._object_id in updated:
+            table._set(key, updated[value._object_id])
+
+
+def _clone_list_object(original, object_id: str) -> ListDoc:
+    if original is not None and original._object_id != object_id:
+        raise ValueError(f"cloneListObject ID mismatch: {original._object_id} != {object_id}")
+    lst = ListDoc(original or [], object_id=object_id)
+    lst._conflicts = list(original._conflicts) if original is not None else []
+    lst._elem_ids = list(original._elem_ids) if original is not None else []
+    lst._max_elem = original._max_elem if original is not None else 0
+    return lst
+
+
+def _update_list_object(diff: dict, cache: dict, updated: dict, inbound: dict):
+    object_id = diff["obj"]
+    if object_id not in updated:
+        updated[object_id] = _clone_list_object(cache.get(object_id), object_id)
+    lst = updated[object_id]
+    conflicts, elem_ids = lst._conflicts, lst._elem_ids
+
+    value, conflict = None, None
+    action = diff["action"]
+    if action in ("insert", "set"):
+        value = get_value(diff, cache, updated)
+        if diff.get("conflicts"):
+            conflict = {c["actor"]: get_value(c, cache, updated) for c in diff["conflicts"]}
+
+    refs_before, refs_after = {}, {}
+    if action == "create":
+        pass
+    elif action == "insert":
+        lst._max_elem = max(lst._max_elem, parse_elem_id(diff["elemId"])[1])
+        list.insert(lst, diff["index"], value)
+        conflicts.insert(diff["index"], conflict)
+        elem_ids.insert(diff["index"], diff["elemId"])
+        refs_after = _child_references(lst, diff["index"])
+    elif action == "set":
+        refs_before = _child_references(lst, diff["index"])
+        list.__setitem__(lst, diff["index"], value)
+        conflicts[diff["index"]] = conflict
+        refs_after = _child_references(lst, diff["index"])
+    elif action == "remove":
+        refs_before = _child_references(lst, diff["index"])
+        list.__delitem__(lst, diff["index"])
+        del conflicts[diff["index"]]
+        del elem_ids[diff["index"]]
+    elif action == "maxElem":
+        lst._max_elem = max(lst._max_elem, diff["value"])
+    else:
+        raise ValueError(f"Unknown action type: {action}")
+
+    _update_inbound(object_id, refs_before, refs_after, inbound)
+
+
+def _parent_list_object(object_id: str, cache: dict, updated: dict):
+    if object_id not in updated:
+        updated[object_id] = _clone_list_object(cache.get(object_id), object_id)
+    lst = updated[object_id]
+    for index in range(len(lst)):
+        value = list.__getitem__(lst, index)
+        if _is_doc_object(value) and value._object_id in updated:
+            list.__setitem__(lst, index, updated[value._object_id])
+        conflicts = lst._conflicts[index]
+        if conflicts:
+            for actor_id, cvalue in list(conflicts.items()):
+                if _is_doc_object(cvalue) and cvalue._object_id in updated:
+                    conflicts[actor_id] = updated[cvalue._object_id]
+
+
+def _update_text_object(diff: dict, cache: dict, updated: dict):
+    object_id = diff["obj"]
+    if object_id not in updated:
+        cached = cache.get(object_id)
+        if cached is not None:
+            updated[object_id] = instantiate_text(object_id, list(cached.elems), cached._max_elem)
+        else:
+            updated[object_id] = instantiate_text(object_id, [], 0)
+    text = updated[object_id]
+
+    action = diff["action"]
+    if action == "create":
+        pass
+    elif action == "insert":
+        text._max_elem = max(text._max_elem, parse_elem_id(diff["elemId"])[1])
+        elem = {"elemId": diff["elemId"], "value": get_value(diff, cache, updated),
+                "conflicts": diff.get("conflicts")}
+        text.elems.insert(diff["index"], elem)
+    elif action == "set":
+        text.elems[diff["index"]] = {
+            "elemId": text.elems[diff["index"]]["elemId"],
+            "value": get_value(diff, cache, updated),
+            "conflicts": diff.get("conflicts"),
+        }
+    elif action == "remove":
+        del text.elems[diff["index"]]
+    elif action == "maxElem":
+        text._max_elem = max(text._max_elem, diff["value"])
+    else:
+        raise ValueError(f"Unknown action type: {action}")
+
+
+def update_parent_objects(cache: dict, updated: dict, inbound: dict):
+    """Propagate updated children into new parent versions up to the root
+    (apply_patch.js:393-414)."""
+    affected = updated
+    while affected:
+        parents = {}
+        for child_id in list(affected.keys()):
+            parent_id = inbound.get(child_id)
+            if parent_id:
+                parents[parent_id] = True
+        affected = parents
+        for object_id in parents:
+            obj = updated.get(object_id)
+            if obj is None:
+                obj = cache.get(object_id)
+            if isinstance(obj, ListDoc):
+                _parent_list_object(object_id, cache, updated)
+            elif isinstance(obj, Table):
+                _parent_table_object(object_id, cache, updated)
+            else:
+                _parent_map_object(object_id, cache, updated)
+
+
+def apply_diffs(diffs: list, cache: dict, updated: dict, inbound: dict):
+    for diff in diffs:
+        diff_type = diff["type"]
+        if diff_type == "map":
+            _update_map_object(diff, cache, updated, inbound)
+        elif diff_type == "table":
+            _update_table_object(diff, cache, updated, inbound)
+        elif diff_type == "list":
+            _update_list_object(diff, cache, updated, inbound)
+        elif diff_type == "text":
+            _update_text_object(diff, cache, updated)
+        else:
+            raise TypeError(f"Unknown object type: {diff_type}")
+
+
+def clone_root_object(root: MapDoc) -> MapDoc:
+    if root._object_id != ROOT_ID:
+        raise ValueError(f"Not the root object: {root._object_id}")
+    return _clone_map_object(root, ROOT_ID)

@@ -1,0 +1,439 @@
+"""User-visible document value types: materialized views + CRDT wrappers.
+
+Counterparts of the reference's frontend value layer — plain JS objects/arrays
+with symbol-keyed metadata plus Text/Table/Counter classes
+(/root/reference/frontend/{text,table,counter}.js, constants.js). In Python the
+materialized document is built from ``dict``/``list`` subclasses carrying the
+same metadata as instance attributes, so documents compare equal to plain
+dicts/lists and serialize naturally.
+
+Documents are immutable by convention; with ``freeze=True`` on init, mutation
+attempts raise (the reference's deep-freeze option, README.md:208-212).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Iterator, Optional
+
+
+def _frozen_guard(self):
+    if getattr(self, "_frozen", False):
+        raise TypeError("Cannot modify a frozen document object outside a change block")
+
+
+class MapDoc(dict):
+    """A materialized map object: a dict plus CRDT metadata."""
+
+    _object_id: Optional[str] = None
+    _frozen = False
+
+    def __init__(self, *args, object_id=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._object_id = object_id
+        self._conflicts: dict = {}
+
+    # mutation guards (active once frozen)
+    def __setitem__(self, key, value):
+        _frozen_guard(self)
+        super().__setitem__(key, value)
+
+    def __delitem__(self, key):
+        _frozen_guard(self)
+        super().__delitem__(key)
+
+    def update(self, *args, **kwargs):
+        _frozen_guard(self)
+        super().update(*args, **kwargs)
+
+    def pop(self, *args):
+        _frozen_guard(self)
+        return super().pop(*args)
+
+    def clear(self):
+        _frozen_guard(self)
+        super().clear()
+
+    def _freeze(self):
+        self._frozen = True
+
+
+class ListDoc(list):
+    """A materialized list object: a list plus CRDT metadata."""
+
+    _object_id: Optional[str] = None
+    _frozen = False
+
+    def __init__(self, *args, object_id=None):
+        super().__init__(*args)
+        self._object_id = object_id
+        self._conflicts: list = []    # per-index conflict dicts (or None)
+        self._elem_ids: list = []     # per-index elemId strings
+        self._max_elem: int = 0
+
+    def __setitem__(self, key, value):
+        _frozen_guard(self)
+        super().__setitem__(key, value)
+
+    def __delitem__(self, key):
+        _frozen_guard(self)
+        super().__delitem__(key)
+
+    def append(self, value):
+        _frozen_guard(self)
+        super().append(value)
+
+    def insert(self, index, value):
+        _frozen_guard(self)
+        super().insert(index, value)
+
+    def extend(self, values):
+        _frozen_guard(self)
+        super().extend(values)
+
+    def pop(self, *args):
+        _frozen_guard(self)
+        return super().pop(*args)
+
+    def remove(self, value):
+        _frozen_guard(self)
+        super().remove(value)
+
+    def clear(self):
+        _frozen_guard(self)
+        super().clear()
+
+    def _freeze(self):
+        self._frozen = True
+
+
+class Counter:
+    """Convergent integer changed only by increment/decrement
+    (frontend/counter.js:6-44)."""
+
+    def __init__(self, value: int = 0):
+        object.__setattr__(self, "value", value)
+
+    def __setattr__(self, name, value):
+        raise AttributeError("Counter is immutable; use increment()/decrement() in a change block")
+
+    def __int__(self):
+        return int(self.value)
+
+    def __index__(self):
+        return int(self.value)
+
+    def __eq__(self, other):
+        if isinstance(other, Counter):
+            return self.value == other.value
+        if isinstance(other, (int, float)):
+            return self.value == other
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(("Counter", self.value))
+
+    def __lt__(self, other):
+        return self.value < (other.value if isinstance(other, Counter) else other)
+
+    def __add__(self, other):
+        return self.value + other
+
+    __radd__ = __add__
+
+    def __repr__(self):
+        return f"Counter({self.value})"
+
+    def __str__(self):
+        return str(self.value)
+
+    def to_json(self):
+        return self.value
+
+
+class WriteableCounter(Counter):
+    """Counter view inside a change block (frontend/counter.js:50-68)."""
+
+    def __init__(self, value, context, object_id, key):
+        super().__init__(value)
+        object.__setattr__(self, "context", context)
+        object.__setattr__(self, "object_id", object_id)
+        object.__setattr__(self, "key", key)
+
+    def increment(self, delta: int = 1) -> int:
+        self.context.increment(self.object_id, self.key, delta)
+        object.__setattr__(self, "value", self.value + delta)
+        return self.value
+
+    def decrement(self, delta: int = 1) -> int:
+        return self.increment(-delta)
+
+
+class Text:
+    """Sequence-of-characters (or embedded objects) CRDT view
+    (frontend/text.js:3-165). ``elems`` entries are dicts
+    {'value', 'elemId'?, 'conflicts'?}.
+    """
+
+    def __init__(self, text=None):
+        self._object_id: Optional[str] = None
+        self._max_elem: int = 0
+        self.context = None
+        if isinstance(text, str):
+            self.elems = [{"value": ch} for ch in text]
+        elif isinstance(text, (list, tuple)):
+            self.elems = [{"value": v} for v in text]
+        elif text is None:
+            self.elems = []
+        else:
+            raise TypeError(f"Unsupported initial value for Text: {text!r}")
+
+    def __len__(self) -> int:
+        return len(self.elems)
+
+    def get(self, index: int):
+        return self.elems[index]["value"]
+
+    def get_elem_id(self, index: int):
+        return self.elems[index].get("elemId")
+
+    def __getitem__(self, index):
+        if isinstance(index, slice):
+            return [e["value"] for e in self.elems[index]]
+        return self.elems[index]["value"]
+
+    def __iter__(self) -> Iterator:
+        return (e["value"] for e in self.elems)
+
+    def __eq__(self, other):
+        if isinstance(other, Text):
+            return [e["value"] for e in self.elems] == [e["value"] for e in other.elems]
+        if isinstance(other, str):
+            return str(self) == other
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(str(self))
+
+    def __str__(self) -> str:
+        return "".join(e["value"] for e in self.elems if isinstance(e["value"], str))
+
+    def __repr__(self):
+        return f"Text({str(self)!r})"
+
+    def to_spans(self) -> list:
+        """Runs of characters interleaved with non-character elements
+        (frontend/text.js:70-88): Text(['a','b',{'x':3},'c']) -> ['ab',{'x':3},'c'].
+        """
+        spans: list = []
+        chars = ""
+        for elem in self.elems:
+            if isinstance(elem["value"], str):
+                chars += elem["value"]
+            else:
+                if chars:
+                    spans.append(chars)
+                    chars = ""
+                spans.append(elem["value"])
+        if chars:
+            spans.append(chars)
+        return spans
+
+    def to_json(self) -> str:
+        return str(self)
+
+    def get_writeable(self, context) -> "Text":
+        if not self._object_id:
+            raise ValueError("get_writeable() requires the objectId to be set")
+        instance = Text()
+        instance._object_id = self._object_id
+        instance.elems = self.elems
+        instance._max_elem = self._max_elem
+        instance.context = context
+        return instance
+
+    # -- mutators: delegate to the change context when attached --
+
+    def set(self, index: int, value) -> "Text":
+        if self.context:
+            self.context.set_list_index(self._object_id, index, value)
+        elif not self._object_id:
+            self.elems[index] = {"value": value}
+        else:
+            raise TypeError("Text object cannot be modified outside of a change block")
+        return self
+
+    def insert_at(self, index: int, *values) -> "Text":
+        if self.context:
+            self.context.splice(self._object_id, index, 0, list(values))
+        elif not self._object_id:
+            self.elems[index:index] = [{"value": v} for v in values]
+        else:
+            raise TypeError("Text object cannot be modified outside of a change block")
+        return self
+
+    def delete_at(self, index: int, num_delete: int = 1) -> "Text":
+        if self.context:
+            self.context.splice(self._object_id, index, num_delete, [])
+        elif not self._object_id:
+            del self.elems[index:index + num_delete]
+        else:
+            raise TypeError("Text object cannot be modified outside of a change block")
+        return self
+
+
+def instantiate_text(object_id, elems, max_elem) -> Text:
+    instance = Text()
+    instance._object_id = object_id
+    instance.elems = elems
+    instance._max_elem = max_elem or 0
+    return instance
+
+
+def _compare_rows(properties, row1, row2):
+    for prop in properties:
+        v1, v2 = row1.get(prop), row2.get(prop)
+        if v1 == v2:
+            continue
+        if isinstance(v1, (int, float)) and isinstance(v2, (int, float)):
+            return -1 if v1 < v2 else 1
+        s1, s2 = str(v1), str(v2)
+        if s1 == s2:
+            continue
+        return -1 if s1 < s2 else 1
+    return 0
+
+
+class Table:
+    """Relational-style unordered row collection keyed by row object ID
+    (frontend/table.js:25-204)."""
+
+    def __init__(self):
+        self._object_id: Optional[str] = None
+        self._conflicts: dict = {}
+        self._frozen = False
+        self.entries: dict = {}
+
+    def by_id(self, row_id: str):
+        return self.entries.get(row_id)
+
+    @property
+    def ids(self) -> list:
+        return [key for key, entry in self.entries.items()
+                if isinstance(entry, dict) and entry.get("id") == key]
+
+    @property
+    def count(self) -> int:
+        return len(self.ids)
+
+    @property
+    def rows(self) -> list:
+        return [self.by_id(i) for i in self.ids]
+
+    def filter(self, callback) -> list:
+        return [row for row in self.rows if callback(row)]
+
+    def find(self, callback):
+        for row in self.rows:
+            if callback(row):
+                return row
+        return None
+
+    def map(self, callback) -> list:
+        return [callback(row) for row in self.rows]
+
+    def sort(self, arg=None) -> list:
+        import functools
+        if callable(arg):
+            return sorted(self.rows, key=functools.cmp_to_key(arg))
+        if isinstance(arg, str):
+            props = [arg]
+        elif isinstance(arg, (list, tuple)):
+            props = list(arg)
+        elif arg is None:
+            props = ["id"]
+        else:
+            raise TypeError(f"Unsupported sorting argument: {arg!r}")
+        return sorted(self.rows, key=functools.cmp_to_key(
+            lambda r1, r2: _compare_rows(props, r1, r2)))
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    def __len__(self):
+        return self.count
+
+    def __eq__(self, other):
+        if isinstance(other, Table):
+            return self.entries == other.entries
+        return NotImplemented
+
+    def _clone(self) -> "Table":
+        if not self._object_id:
+            raise ValueError("clone() requires the objectId to be set")
+        return instantiate_table(self._object_id, dict(self.entries))
+
+    def _set(self, row_id: str, value):
+        if self._frozen:
+            raise TypeError("A table can only be modified in a change function")
+        if isinstance(value, dict):
+            value["id"] = row_id
+        self.entries[row_id] = value
+
+    def remove(self, row_id: str):
+        if self._frozen:
+            raise TypeError("A table can only be modified in a change function")
+        del self.entries[row_id]
+
+    def _freeze(self):
+        self._frozen = True
+
+    def get_writeable(self, context) -> "WriteableTable":
+        if not self._object_id:
+            raise ValueError("get_writeable() requires the objectId to be set")
+        instance = WriteableTable.__new__(WriteableTable)
+        instance._object_id = self._object_id
+        instance._conflicts = self._conflicts
+        instance._frozen = False
+        instance.entries = self.entries
+        instance.context = context
+        return instance
+
+    def to_json(self) -> dict:
+        return {row_id: self.by_id(row_id) for row_id in self.ids}
+
+
+class WriteableTable(Table):
+    """Table view inside a change block (frontend/table.js:210-240)."""
+
+    def by_id(self, row_id: str):
+        entry = self.entries.get(row_id)
+        if isinstance(entry, dict) and entry.get("id") == row_id:
+            return self.context.instantiate_proxy(row_id)
+        return None
+
+    def add(self, row: dict) -> str:
+        """Adds a row (column-name -> value), returns its generated row ID."""
+        return self.context.add_table_row(self._object_id, row)
+
+    def remove(self, row_id: str):
+        entry = self.entries.get(row_id)
+        if isinstance(entry, dict) and entry.get("id") == row_id:
+            self.context.delete_table_row(self._object_id, row_id)
+        else:
+            raise KeyError(f"There is no row with ID {row_id} in this table")
+
+
+def instantiate_table(object_id, entries=None) -> Table:
+    instance = Table()
+    instance._object_id = object_id
+    instance.entries = entries if entries is not None else {}
+    return instance
+
+
+def timestamp_to_datetime(ms: int) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(ms / 1000, tz=_dt.timezone.utc)
+
+
+def datetime_to_timestamp(value: _dt.datetime) -> int:
+    return int(value.timestamp() * 1000)

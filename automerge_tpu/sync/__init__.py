@@ -1,0 +1,3 @@
+from .connection import Connection  # noqa: F401
+from .doc_set import DocSet  # noqa: F401
+from .watchable_doc import WatchableDoc  # noqa: F401

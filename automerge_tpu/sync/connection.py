@@ -1,0 +1,87 @@
+"""Per-peer vector-clock sync protocol, multiplexing many docs per connection.
+
+Counterpart of /root/reference/src/connection.js. Messages are plain JSON
+``{docId, clock, changes?}`` — byte-compatible with the reference protocol —
+and transport is user-supplied (``send_msg`` callback out, ``receive_msg`` in).
+
+``_their_clock`` is the most recent clock we believe the peer has;
+``_our_clock`` is the most recent clock we have advertised. Everything newer
+than their clock is sent; clock-only messages advertise or request state.
+"""
+
+from __future__ import annotations
+
+from .. import backend as Backend
+from .. import frontend as Frontend
+from .._common import less_or_equal
+
+
+def _clock_union(clock_map: dict, doc_id: str, clock: dict) -> dict:
+    merged = dict(clock_map.get(doc_id, {}))
+    for actor, seq in clock.items():
+        if seq > merged.get(actor, 0):
+            merged[actor] = seq
+    out = dict(clock_map)
+    out[doc_id] = merged
+    return out
+
+
+class Connection:
+    def __init__(self, doc_set, send_msg):
+        self._doc_set = doc_set
+        self._send_msg = send_msg
+        self._their_clock: dict = {}
+        self._our_clock: dict = {}
+
+    def open(self):
+        for doc_id in self._doc_set.doc_ids:
+            self.doc_changed(doc_id, self._doc_set.get_doc(doc_id))
+        self._doc_set.register_handler(self.doc_changed)
+
+    def close(self):
+        self._doc_set.unregister_handler(self.doc_changed)
+
+    def send_msg(self, doc_id: str, clock: dict, changes=None):
+        msg = {"docId": doc_id, "clock": dict(clock)}
+        self._our_clock = _clock_union(self._our_clock, doc_id, clock)
+        if changes is not None:
+            msg["changes"] = changes
+        self._send_msg(msg)
+
+    def maybe_send_changes(self, doc_id: str):
+        doc = self._doc_set.get_doc(doc_id)
+        state = Frontend.get_backend_state(doc)
+        clock = state.clock
+
+        if doc_id in self._their_clock:
+            changes = Backend.get_missing_changes(state, self._their_clock[doc_id])
+            if changes:
+                self._their_clock = _clock_union(self._their_clock, doc_id, clock)
+                self.send_msg(doc_id, clock, changes)
+                return
+
+        if clock != self._our_clock.get(doc_id, {}):
+            self.send_msg(doc_id, clock)
+
+    def doc_changed(self, doc_id: str, doc):
+        state = Frontend.get_backend_state(doc)
+        if state is None:
+            raise TypeError("This object cannot be used for network sync. "
+                            "Are you trying to sync a snapshot from the history?")
+        if not less_or_equal(self._our_clock.get(doc_id, {}), state.clock):
+            raise ValueError("Cannot pass an old state object to a connection")
+        self.maybe_send_changes(doc_id)
+
+    def receive_msg(self, msg: dict):
+        doc_id = msg["docId"]
+        if msg.get("clock") is not None:  # an empty clock still registers the peer
+            self._their_clock = _clock_union(self._their_clock, doc_id, msg["clock"])
+        if msg.get("changes"):
+            return self._doc_set.apply_changes(doc_id, msg["changes"])
+
+        if self._doc_set.get_doc(doc_id) is not None:
+            self.maybe_send_changes(doc_id)
+        elif doc_id not in self._our_clock:
+            # The peer has a document we don't: request it with an empty clock.
+            self.send_msg(doc_id, {})
+        return self._doc_set.get_doc(doc_id)

@@ -1,0 +1,51 @@
+"""Keyed collection of documents with change handlers.
+
+Counterpart of /root/reference/src/doc_set.js. A DocSet is the unit the sync
+protocol multiplexes over one connection, and the unit the device engine
+batches over (many documents merged in one call).
+"""
+
+from __future__ import annotations
+
+from .. import backend as Backend
+from .. import frontend as Frontend
+
+
+class DocSet:
+    def __init__(self):
+        self._docs: dict = {}
+        self._handlers: list = []
+
+    @property
+    def doc_ids(self):
+        return list(self._docs.keys())
+
+    def get_doc(self, doc_id: str):
+        return self._docs.get(doc_id)
+
+    def remove_doc(self, doc_id: str):
+        self._docs.pop(doc_id, None)
+
+    def set_doc(self, doc_id: str, doc):
+        self._docs[doc_id] = doc
+        for handler in list(self._handlers):
+            handler(doc_id, doc)
+
+    def apply_changes(self, doc_id: str, changes):
+        doc = self._docs.get(doc_id)
+        if doc is None:
+            doc = Frontend.init({"backend": Backend.Backend})
+        old_state = Frontend.get_backend_state(doc)
+        new_state, patch = Backend.apply_changes(old_state, changes)
+        patch["state"] = new_state
+        doc = Frontend.apply_patch(doc, patch)
+        self.set_doc(doc_id, doc)
+        return doc
+
+    def register_handler(self, handler):
+        if handler not in self._handlers:
+            self._handlers.append(handler)
+
+    def unregister_handler(self, handler):
+        if handler in self._handlers:
+            self._handlers.remove(handler)

@@ -1,0 +1,128 @@
+"""Randomized convergence property tests.
+
+The CRDT analogue of race detection (SURVEY.md §5): N actors make random
+concurrent edits; the full change-set must materialize to the same document
+under every delivery order. Nondeterminism sources are pinned (seeded RNG,
+fixed actor ids).
+"""
+
+import itertools
+import json
+import random
+
+import automerge_tpu as am
+from automerge_tpu import Text
+
+
+def random_edit(rng, doc, actor):
+    """One random change: map set/delete, list ops, text ops, counter inc."""
+    kind = rng.randrange(6)
+
+    def cb(d):
+        if kind == 0:
+            d[rng.choice("abc")] = rng.randrange(100)
+        elif kind == 1:
+            key = rng.choice("abc")
+            if key in d:
+                del d[key]
+            else:
+                d[key] = None
+        elif kind == 2:
+            if "xs" not in d:
+                d["xs"] = []
+            else:
+                d["xs"].insert(rng.randint(0, len(d["xs"])), f"{actor}-{rng.randrange(99)}")
+        elif kind == 3:
+            if "xs" in d and len(d["xs"]) > 0:
+                d["xs"].delete_at(rng.randrange(len(d["xs"])))
+            else:
+                d["xs"] = [f"{actor}-init"]
+        elif kind == 4:
+            if "t" not in d:
+                d["t"] = Text("seed")
+            else:
+                d["t"].insert_at(rng.randint(0, len(d["t"])), rng.choice("xyz"))
+        else:
+            if "n" not in d:
+                d["n"] = am.Counter(0)
+            else:
+                d["n"].increment(rng.randrange(1, 5))
+    return am.change(doc, cb)
+
+
+def converged_json(changes, order):
+    doc = am.init("observer")
+    for i in order:
+        doc = am.apply_changes(doc, [changes[i]])
+    return am.to_json(doc)
+
+
+def test_permutation_invariance_small():
+    """All orderings of a small concurrent change-set converge identically."""
+    rng = random.Random(42)
+    base = am.change(am.init("base"), lambda d: d.update({"xs": ["x"], "t": Text("ab")}))
+    base_changes = am.get_all_changes(base)
+
+    actors = ["actor-a", "actor-b", "actor-c"]
+    concurrent = []
+    for actor in actors:
+        doc = am.apply_changes(am.init(actor), base_changes)
+        doc = random_edit(rng, doc, actor)
+        concurrent.extend(am.get_changes(am.apply_changes(am.init("tmp"), base_changes), doc))
+
+    results = set()
+    for order in itertools.permutations(range(len(concurrent))):
+        doc = am.init("observer")
+        for ch in base_changes:
+            doc = am.apply_changes(doc, [ch])
+        for i in order:
+            doc = am.apply_changes(doc, [concurrent[i]])
+        results.add(json.dumps(am.to_json(doc), sort_keys=True))
+    assert len(results) == 1, f"diverged into {len(results)} states"
+
+
+def test_random_multi_actor_sessions():
+    """Longer random sessions: merge in random orders, assert convergence."""
+    for seed in range(5):
+        rng = random.Random(1000 + seed)
+        n_actors = rng.randint(2, 4)
+        docs = {}
+        base = am.change(am.init("base"), lambda d: d.update({"xs": [], "t": Text("")}))
+        base_changes = am.get_all_changes(base)
+        for i in range(n_actors):
+            docs[i] = am.apply_changes(am.init(f"actor-{i}"), base_changes)
+
+        # several rounds of concurrent edits + random pairwise syncs
+        for _ in range(6):
+            for i in range(n_actors):
+                if rng.random() < 0.8:
+                    docs[i] = random_edit(rng, docs[i], f"actor-{i}")
+            i, j = rng.sample(range(n_actors), 2)
+            docs[i] = am.merge(docs[i], docs[j])
+
+        # full mesh sync in two different orders must agree
+        all_changes = []
+        for i in range(n_actors):
+            all_changes.extend(am.get_all_changes(docs[i]))
+        order1 = list(range(len(all_changes)))
+        order2 = list(reversed(order1))
+        rng.shuffle(order1)
+
+        def apply_in(order):
+            doc = am.init("observer")
+            for k in order:
+                doc = am.apply_changes(doc, [all_changes[k]])
+            return am.to_json(doc)
+
+        r1, r2 = apply_in(order1), apply_in(order2)
+        assert r1 == r2, f"seed {seed}: diverged"
+
+
+def test_merge_is_idempotent_and_commutative():
+    a = am.change(am.init("actor-a"), lambda d: d.update({"x": 1}))
+    b = am.change(am.init("actor-b"), lambda d: d.update({"y": 2}))
+    ab = am.merge(a, b)
+    ab2 = am.merge(ab, b)      # idempotent
+    assert am.to_json(ab) == am.to_json(ab2)
+    ba = am.merge(b, a)
+    assert am.to_json(ab) == am.to_json(ba)  # commutative result

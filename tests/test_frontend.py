@@ -1,0 +1,153 @@
+"""Frontend-only tests: change-request generation and async (queued-request)
+mode with a detached backend — coverage mirrors /root/reference/test/
+frontend_test.js, especially backend concurrency (:238-358).
+"""
+
+import pytest
+
+import automerge_tpu.backend as Backend
+import automerge_tpu.frontend as Frontend
+from automerge_tpu._common import ROOT_ID
+
+
+def set_(key, value):
+    def cb(doc):
+        doc[key] = value
+    return cb
+
+
+class TestChangeRequests:
+    def test_request_shape(self):
+        doc = Frontend.init("actor-1")  # no backend option: async mode
+        doc2, req = Frontend.change(doc, set_("bird", "magpie"))
+        assert req["requestType"] == "change"
+        assert req["actor"] == "actor-1"
+        assert req["seq"] == 1
+        assert req["deps"] == {}
+        assert req["ops"] == [
+            {"action": "set", "obj": ROOT_ID, "key": "bird", "value": "magpie"}]
+
+    def test_optimistic_local_application(self):
+        doc = Frontend.init("actor-1")
+        doc2, _ = Frontend.change(doc, set_("bird", "magpie"))
+        assert doc2["bird"] == "magpie"  # applied before any backend round-trip
+
+    def test_seq_increments(self):
+        doc = Frontend.init("actor-1")
+        doc2, r1 = Frontend.change(doc, set_("a", 1))
+        doc3, r2 = Frontend.change(doc2, set_("b", 2))
+        assert (r1["seq"], r2["seq"]) == (1, 2)
+        assert len(doc3._state["requests"]) == 2
+
+    def test_single_assignment_dedup(self):
+        doc = Frontend.init("actor-1")
+
+        def cb(d):
+            d["x"] = 1
+            d["x"] = 2
+        _, req = Frontend.change(doc, cb)
+        assert [op for op in req["ops"] if op["action"] == "set"] == [
+            {"action": "set", "obj": ROOT_ID, "key": "x", "value": 2}]
+
+    def test_inc_ops_merge(self):
+        doc = Frontend.init("actor-1")
+        doc, _ = Frontend.change(doc, set_("n", Frontend.Counter(0)))
+
+        def cb(d):
+            d["n"].increment(2)
+            d["n"].increment(3)
+        _, req = Frontend.change(doc, cb)
+        incs = [op for op in req["ops"] if op["action"] == "inc"]
+        assert incs == [{"action": "inc", "obj": ROOT_ID, "key": "n", "value": 5}]
+
+
+class TestBackendConcurrency:
+    """Frontend and backend on 'different threads': requests queue locally and
+    are confirmed (or superseded) by backend patches."""
+
+    def round_trip(self, doc, backend_state, request):
+        backend_state, patch = Backend.apply_local_change(backend_state, request)
+        patch["actor"], patch["seq"] = request["actor"], request["seq"]
+        return Frontend.apply_patch(doc, patch), backend_state
+
+    def test_request_queue_drains_in_order(self):
+        doc = Frontend.init("actor-1")
+        bs = Backend.init()
+        doc, r1 = Frontend.change(doc, set_("a", 1))
+        doc, r2 = Frontend.change(doc, set_("b", 2))
+        assert len(doc._state["requests"]) == 2
+        doc, bs = self.round_trip(doc, bs, r1)
+        assert len(doc._state["requests"]) == 1
+        doc, bs = self.round_trip(doc, bs, r2)
+        assert doc._state["requests"] == []
+        assert dict(doc) == {"a": 1, "b": 2}
+
+    def test_out_of_order_patch_rejected(self):
+        doc = Frontend.init("actor-1")
+        bs = Backend.init()
+        doc, r1 = Frontend.change(doc, set_("a", 1))
+        doc, r2 = Frontend.change(doc, set_("b", 2))
+        bs, _ = Backend.apply_local_change(bs, r1)
+        bs, patch2 = Backend.apply_local_change(bs, r2)
+        with pytest.raises(ValueError, match="Mismatched sequence number"):
+            Frontend.apply_patch(doc, patch2)
+
+    def test_remote_patch_preserves_local_optimistic_change(self):
+        doc = Frontend.init("actor-1")
+        doc, r1 = Frontend.change(doc, set_("mine", "local"))
+        # remote change arrives while r1 is in flight
+        remote_bs, _ = Backend.apply_changes(Backend.init(), [
+            {"actor": "actor-2", "seq": 1, "deps": {},
+             "ops": [{"action": "set", "obj": ROOT_ID, "key": "theirs", "value": "remote"}]}])
+        patch = Backend.get_patch(remote_bs)
+        doc2 = Frontend.apply_patch(doc, patch)
+        # both the remote value and the unconfirmed local value are visible
+        assert doc2["theirs"] == "remote"
+        assert doc2["mine"] == "local"
+        assert len(doc2._state["requests"]) == 1
+
+    def test_ot_insert_index_shift(self):
+        doc = Frontend.init("actor-1")
+        bs = Backend.init()
+        doc, r1 = Frontend.change(doc, set_("xs", ["a", "b"]))
+        doc, bs = self.round_trip(doc, bs, r1)
+        # local in-flight insert at index 1
+        doc, r2 = Frontend.change(doc, lambda d: d["xs"].insert(1, "local"))
+        # remote insert at index 0 arrives first
+        remote = {"actor": "actor-2", "seq": 1,
+                  "deps": {"actor-1": 1},
+                  "ops": [{"action": "ins", "obj": None, "key": "_head", "elem": 99},
+                          ]}
+        # build the remote change against the same list object id
+        xs_id = doc["xs"]._object_id
+        remote["ops"] = [
+            {"action": "ins", "obj": xs_id, "key": "_head", "elem": 99},
+            {"action": "set", "obj": xs_id, "key": "actor-2:99", "value": "remote"}]
+        bs, patch = Backend.apply_changes(bs, [remote])
+        doc2 = Frontend.apply_patch(doc, patch)
+        # remote lands at 0; local optimistic insert shifts to index 2
+        assert list(doc2["xs"]) == ["remote", "a", "local", "b"]
+
+
+class TestUndoRedoRequests:
+    def test_undo_request_has_no_ops(self):
+        doc = Frontend.init({"actorId": "actor-1", "backend": Backend.Backend})
+        doc, _ = Frontend.change(doc, set_("x", 1))
+        assert Frontend.can_undo(doc)
+        doc2, req = Frontend.undo(doc)
+        assert req["requestType"] == "undo"
+        assert "ops" not in req
+        assert dict(doc2) == {}
+
+    def test_undo_in_flight_blocks_second_undo(self):
+        doc = Frontend.init("actor-1")  # async mode: requests stay queued
+        doc, r1 = Frontend.change(doc, set_("x", 1))
+        # simulate confirmed change so canUndo becomes true
+        bs = Backend.init()
+        bs, patch = Backend.apply_local_change(bs, r1)
+        doc = Frontend.apply_patch(doc, patch)
+        assert Frontend.can_undo(doc)
+        doc, _ = Frontend.undo(doc)
+        assert not Frontend.can_undo(doc)  # undo in flight
+        with pytest.raises(ValueError, match="one undo in flight"):
+            Frontend.undo(doc)

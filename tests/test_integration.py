@@ -1,0 +1,414 @@
+"""Facade-level integration tests.
+
+Coverage mirrors the reference's integration suite (/root/reference/test/
+test.js): init/from, change semantics, nested objects, lists, concurrent use &
+convergence (LWW + conflicts, counter merge, add-wins, no interleaving,
+same-position ordering by actor), undo/redo, save/load, history, diff, and the
+changes API.
+"""
+
+import datetime
+
+import pytest
+
+import automerge_tpu as am
+
+
+def set_(key, value):
+    def cb(doc):
+        doc[key] = value
+    return cb
+
+
+class TestInit:
+    def test_init_empty(self):
+        doc = am.init()
+        assert am.to_json(doc) == {}
+
+    def test_init_with_actor_id(self):
+        doc = am.init("actor-1")
+        assert am.get_actor_id(doc) == "actor-1"
+
+    def test_from_initial_state(self):
+        doc = am.from_({"birds": ["chaffinch"], "n": 42})
+        assert am.to_json(doc) == {"birds": ["chaffinch"], "n": 42}
+
+    def test_uuid_actor_by_default(self):
+        doc = am.init()
+        assert isinstance(am.get_actor_id(doc), str) and len(am.get_actor_id(doc)) > 8
+
+
+class TestChange:
+    def test_change_returns_new_doc(self):
+        d1 = am.init()
+        d2 = am.change(d1, set_("bird", "magpie"))
+        assert am.to_json(d1) == {}
+        assert am.to_json(d2) == {"bird": "magpie"}
+
+    def test_attribute_style(self):
+        d1 = am.init()
+        d2 = am.change(d1, lambda d: setattr(d, "bird", "magpie"))
+        assert d2["bird"] == "magpie"
+
+    def test_noop_change_returns_same_doc(self):
+        d1 = am.change(am.init(), set_("bird", "magpie"))
+        d2 = am.change(d1, set_("bird", "magpie"))  # same value: no-op
+        assert d2 is d1
+
+    def test_noop_callback(self):
+        d1 = am.init()
+        d2 = am.change(d1, lambda d: None)
+        assert d2 is d1
+
+    def test_nested_change_raises(self):
+        d1 = am.init()
+        with pytest.raises(TypeError):
+            am.change(d1, lambda d: am.change(d, set_("x", 1)))
+
+    def test_root_required(self):
+        d1 = am.change(am.init(), set_("nested", {}))
+        with pytest.raises(TypeError):
+            am.change(d1["nested"], set_("x", 1))
+
+    def test_nested_maps(self):
+        d = am.change(am.init(), set_("position", {"x": 1, "y": {"z": 2}}))
+        assert am.to_json(d) == {"position": {"x": 1, "y": {"z": 2}}}
+        assert am.get_object_id(d["position"]) is not None
+
+    def test_delete_key(self):
+        d1 = am.change(am.init(), lambda d: d.update({"a": 1, "b": 2}))
+        d2 = am.change(d1, lambda d: d.__delitem__("a"))
+        assert am.to_json(d2) == {"b": 2}
+
+    def test_read_own_writes_in_block(self):
+        seen = {}
+
+        def cb(d):
+            d["x"] = 5
+            seen["x"] = d["x"]
+            d["nested"] = {"a": 1}
+            seen["a"] = d["nested"]["a"]
+            d["nested"]["b"] = 2
+            seen["b"] = d["nested"]["b"]
+
+        am.change(am.init(), cb)
+        assert seen == {"x": 5, "a": 1, "b": 2}
+
+    def test_datetime_round_trip(self):
+        now = datetime.datetime(2026, 7, 29, 12, 0, tzinfo=datetime.timezone.utc)
+        d = am.change(am.init(), set_("now", now))
+        assert d["now"] == now
+
+    def test_message_in_history(self):
+        d = am.change(am.init(), "hello commit", set_("x", 1))
+        assert am.get_history(d)[0].change["message"] == "hello commit"
+
+    def test_assigning_doc_object_raises(self):
+        d1 = am.change(am.init(), set_("a", {"x": 1}))
+
+        def cb(d):
+            d["b"] = d["a"]
+        with pytest.raises(TypeError, match="already belongs"):
+            am.change(d1, cb)
+
+
+class TestLists:
+    def test_list_operations(self):
+        d1 = am.change(am.init(), set_("birds", ["chaffinch", "goldfinch"]))
+
+        def edit(d):
+            birds = d["birds"]
+            birds.insert(1, "greenfinch")
+            birds.append("bullfinch")
+            birds[0] = "wren"
+            del birds[3]
+        d2 = am.change(d1, edit)
+        assert am.to_json(d2) == {"birds": ["wren", "greenfinch", "goldfinch"]}
+
+    def test_list_of_maps(self):
+        d = am.change(am.init(), set_("todos", [{"title": "a", "done": False}]))
+        d2 = am.change(d, lambda doc: doc["todos"][0].__setitem__("done", True))
+        assert am.to_json(d2) == {"todos": [{"title": "a", "done": True}]}
+
+    def test_insert_at_delete_at(self):
+        d1 = am.change(am.init(), set_("xs", [1, 2, 3]))
+        d2 = am.change(d1, lambda d: d["xs"].insert_at(1, 10, 11).delete_at(3))
+        assert am.to_json(d2) == {"xs": [1, 10, 11, 3]}
+
+    def test_negative_index(self):
+        d1 = am.change(am.init(), set_("xs", [1, 2, 3]))
+        d2 = am.change(d1, lambda d: d["xs"].__setitem__(-1, 30))
+        assert am.to_json(d2) == {"xs": [1, 2, 30]}
+
+    def test_out_of_bounds_raises(self):
+        d1 = am.change(am.init(), set_("xs", [1]))
+        with pytest.raises(IndexError):
+            am.change(d1, lambda d: d["xs"].insert_at(5, 9))
+
+    def test_python_insert_clamps_like_list(self):
+        # Python list.insert clamps out-of-range indexes; the proxy matches.
+        d1 = am.change(am.init(), set_("xs", [1]))
+        d2 = am.change(d1, lambda d: d["xs"].insert(99, 2))
+        assert am.to_json(d2) == {"xs": [1, 2]}
+
+
+class TestConcurrentUse:
+    def test_concurrent_different_keys(self):
+        a = am.change(am.init("actor-a"), set_("a", 1))
+        b = am.change(am.init("actor-b"), set_("b", 2))
+        merged_ab = am.merge(a, b)
+        merged_ba = am.merge(b, a)
+        assert am.to_json(merged_ab) == am.to_json(merged_ba) == {"a": 1, "b": 2}
+
+    def test_lww_conflict_same_key(self):
+        a = am.change(am.init("actor-1"), set_("bird", "magpie"))
+        b = am.change(am.init("actor-2"), set_("bird", "blackbird"))
+        ab = am.merge(a, b)
+        ba = am.merge(b, a)
+        # winner is the highest actor id, deterministically on both sides
+        assert ab["bird"] == "blackbird"
+        assert ba["bird"] == "blackbird"
+        assert am.get_conflicts(ab, "bird") == {"actor-1": "magpie"}
+        assert am.get_conflicts(ba, "bird") == {"actor-1": "magpie"}
+
+    def test_conflict_resolved_by_later_write(self):
+        a = am.change(am.init("actor-1"), set_("bird", "magpie"))
+        b = am.change(am.init("actor-2"), set_("bird", "blackbird"))
+        ab = am.merge(a, b)
+        resolved = am.change(ab, set_("bird", "robin"))
+        assert resolved["bird"] == "robin"
+        assert am.get_conflicts(resolved, "bird") is None
+
+    def test_counter_merge_adds(self):
+        a = am.change(am.init("actor-1"), set_("n", am.Counter(0)))
+        b = am.merge(am.init("actor-2"), a)
+        a2 = am.change(a, lambda d: d["n"].increment(3))
+        b2 = am.change(b, lambda d: d["n"].increment(4))
+        ab = am.merge(a2, b2)
+        ba = am.merge(b2, a2)
+        assert am.to_json(ab)["n"] == 7
+        assert am.to_json(ba)["n"] == 7
+
+    def test_add_wins_on_concurrent_update_and_delete(self):
+        base = am.change(am.init("actor-1"), set_("bird", "robin"))
+        other = am.merge(am.init("actor-2"), base)
+        deleted = am.change(base, lambda d: d.__delitem__("bird"))
+        updated = am.change(other, set_("bird", "sparrow"))
+        m1 = am.merge(deleted, updated)
+        m2 = am.merge(updated, deleted)
+        assert am.to_json(m1) == am.to_json(m2) == {"bird": "sparrow"}
+
+    def test_concurrent_list_inserts_no_interleaving(self):
+        base = am.change(am.init("actor-1"), set_("log", []))
+        other = am.merge(am.init("actor-2"), base)
+        a = am.change(base, lambda d: d["log"].extend(["a1", "a2", "a3"]))
+        b = am.change(other, lambda d: d["log"].extend(["b1", "b2", "b3"]))
+        m1 = am.to_json(am.merge(a, b))["log"]
+        m2 = am.to_json(am.merge(b, a))["log"]
+        assert m1 == m2
+        # each actor's run stays contiguous
+        a_pos = [m1.index(x) for x in ("a1", "a2", "a3")]
+        b_pos = [m1.index(x) for x in ("b1", "b2", "b3")]
+        assert a_pos == sorted(a_pos) and a_pos[2] - a_pos[0] == 2
+        assert b_pos == sorted(b_pos) and b_pos[2] - b_pos[0] == 2
+
+    def test_same_position_insert_ordered_by_actor(self):
+        base = am.change(am.init("aaaa"), set_("xs", ["x"]))
+        other = am.merge(am.init("bbbb"), base)
+        a = am.change(base, lambda d: d["xs"].insert(0, "from-a"))
+        b = am.change(other, lambda d: d["xs"].insert(0, "from-b"))
+        m1 = am.to_json(am.merge(a, b))["xs"]
+        m2 = am.to_json(am.merge(b, a))["xs"]
+        assert m1 == m2
+        # higher actor id comes first (descending Lamport order)
+        assert m1 == ["from-b", "from-a", "x"]
+
+    def test_concurrent_nested_object_creation(self):
+        a = am.change(am.init("actor-1"), set_("config", {"a": 1}))
+        b = am.change(am.init("actor-2"), set_("config", {"b": 2}))
+        m = am.merge(a, b)
+        # one whole object wins; the other is a conflict
+        assert am.to_json(m)["config"] == {"b": 2}
+        conflicts = am.get_conflicts(m, "config")
+        assert am.to_json(conflicts["actor-1"]) == {"a": 1}
+
+    def test_three_way_convergence(self):
+        a = am.change(am.init("a"), set_("x", 1))
+        b = am.merge(am.init("b"), a)
+        c = am.merge(am.init("c"), a)
+        b2 = am.change(b, set_("y", 2))
+        c2 = am.change(c, set_("z", 3))
+        a2 = am.change(a, set_("x", 10))
+        final1 = am.merge(am.merge(a2, b2), c2)
+        final2 = am.merge(am.merge(c2, a2), b2)
+        assert am.to_json(final1) == am.to_json(final2) == {"x": 10, "y": 2, "z": 3}
+
+    def test_merge_same_actor_raises(self):
+        a = am.init("actor-1")
+        b = am.init("actor-1")
+        with pytest.raises(ValueError, match="itself"):
+            am.merge(a, b)
+
+
+class TestApplyChanges:
+    def test_network_style_sync(self):
+        a = am.change(am.init("actor-1"), set_("x", 1))
+        a2 = am.change(a, set_("y", 2))
+        b = am.init("actor-2")
+        b2 = am.apply_changes(b, am.get_all_changes(a2))
+        assert am.to_json(b2) == {"x": 1, "y": 2}
+
+    def test_incremental_changes(self):
+        a1 = am.change(am.init("actor-1"), set_("x", 1))
+        b1 = am.apply_changes(am.init("actor-2"), am.get_all_changes(a1))
+        a2 = am.change(a1, set_("y", 2))
+        delta = am.get_changes(a1, a2)
+        assert len(delta) == 1
+        b2 = am.apply_changes(b1, delta)
+        assert am.to_json(b2) == {"x": 1, "y": 2}
+
+    def test_out_of_order_buffering(self):
+        a1 = am.change(am.init("actor-1"), set_("x", 1))
+        a2 = am.change(a1, set_("y", 2))
+        delta2 = am.get_changes(a1, a2)
+        b = am.init("actor-2")
+        b1 = am.apply_changes(b, delta2)  # arrives before its dependency
+        assert am.to_json(b1) == {}
+        assert am.get_missing_deps(b1) == {"actor-1": 1}
+        b2 = am.apply_changes(b1, am.get_changes(am.init(), a1))
+        assert am.to_json(b2) == {"x": 1, "y": 2}
+        assert am.get_missing_deps(b2) == {}
+
+    def test_changes_survive_json_round_trip(self):
+        import json
+        a = am.change(am.init("actor-1"), set_("items", [{"k": "v"}]))
+        changes = json.loads(json.dumps(am.get_all_changes(a)))
+        b = am.apply_changes(am.init("actor-2"), changes)
+        assert am.to_json(b) == {"items": [{"k": "v"}]}
+
+
+class TestUndoRedo:
+    def test_undo_set(self):
+        d1 = am.change(am.init(), set_("x", 1))
+        d2 = am.change(d1, set_("x", 2))
+        assert am.can_undo(d2)
+        d3 = am.undo(d2)
+        assert am.to_json(d3) == {"x": 1}
+        d4 = am.undo(d3)
+        assert am.to_json(d4) == {}
+
+    def test_undo_nothing_raises(self):
+        with pytest.raises(ValueError, match="nothing to be undone"):
+            am.undo(am.init())
+
+    def test_redo(self):
+        d1 = am.change(am.init(), set_("x", 1))
+        d2 = am.undo(d1)
+        assert am.can_redo(d2)
+        d3 = am.redo(d2)
+        assert am.to_json(d3) == {"x": 1}
+        assert not am.can_redo(d3)
+
+    def test_redo_without_undo_raises(self):
+        d1 = am.change(am.init(), set_("x", 1))
+        with pytest.raises(ValueError, match="no prior undo"):
+            am.redo(d1)
+
+    def test_undo_delete_restores(self):
+        d1 = am.change(am.init(), set_("bird", "magpie"))
+        d2 = am.change(d1, lambda d: d.__delitem__("bird"))
+        d3 = am.undo(d2)
+        assert am.to_json(d3) == {"bird": "magpie"}
+
+    def test_undo_counter_increment(self):
+        d1 = am.change(am.init(), set_("n", am.Counter(10)))
+        d2 = am.change(d1, lambda d: d["n"].increment(5))
+        d3 = am.undo(d2)
+        assert am.to_json(d3) == {"n": 10}
+
+    def test_new_change_clears_redo_stack(self):
+        d1 = am.change(am.init(), set_("x", 1))
+        d2 = am.undo(d1)
+        d3 = am.change(d2, set_("y", 9))
+        assert not am.can_redo(d3)
+
+    def test_undoable_false_excluded_from_undo_history(self):
+        d1 = am.change(am.init(), {"undoable": False}, set_("x", 1))
+        assert not am.can_undo(d1)
+
+
+class TestSaveLoad:
+    def test_round_trip(self):
+        d = am.change(am.init("actor-1"), set_("todos", [{"t": "x", "done": False}]))
+        d2 = am.change(d, lambda doc: doc["todos"][0].__setitem__("done", True))
+        loaded = am.load(am.save(d2), "actor-2")
+        assert am.to_json(loaded) == am.to_json(d2)
+
+    def test_load_preserves_max_elem(self):
+        # After delete + reload, new inserts must not reuse element ids.
+        d1 = am.change(am.init("actor-1"), set_("xs", ["a", "b"]))
+        d2 = am.change(d1, lambda d: d["xs"].delete_at(1))
+        loaded = am.load(am.save(d2), "actor-1")
+        d3 = am.change(loaded, lambda d: d["xs"].append("c"))
+        assert am.to_json(d3) == {"xs": ["a", "c"]}
+        # merging back into the original lineage must not collide
+        other = am.load(am.save(d2), "actor-2")
+        m = am.merge(other, d3)
+        assert am.to_json(m) == {"xs": ["a", "c"]}
+
+    def test_save_includes_queued_changes(self):
+        a1 = am.change(am.init("actor-1"), set_("x", 1))
+        a2 = am.change(a1, set_("y", 2))
+        b = am.apply_changes(am.init("actor-2"), am.get_changes(a1, a2))  # missing dep
+        restored = am.load(am.save(b), "actor-3")
+        assert am.get_missing_deps(restored) == {"actor-1": 1}
+        full = am.apply_changes(restored, am.get_changes(am.init(), a1))
+        assert am.to_json(full) == {"x": 1, "y": 2}
+
+    def test_bad_format_raises(self):
+        with pytest.raises(ValueError, match="format"):
+            am.load('{"format": "not-a-doc"}')
+
+
+class TestHistoryAndDiff:
+    def test_history_snapshots(self):
+        d1 = am.change(am.init(), set_("x", 1))
+        d2 = am.change(d1, set_("y", 2))
+        history = am.get_history(d2)
+        assert len(history) == 2
+        assert am.to_json(history[0].snapshot) == {"x": 1}
+        assert am.to_json(history[1].snapshot) == {"x": 1, "y": 2}
+
+    def test_diff(self):
+        d1 = am.change(am.init(), set_("x", 1))
+        d2 = am.change(d1, set_("y", 2))
+        diffs = am.diff(d1, d2)
+        assert len(diffs) == 1
+        assert diffs[0]["key"] == "y"
+
+    def test_diff_diverged_raises(self):
+        d1 = am.change(am.init("actor-1"), set_("x", 1))
+        e1 = am.change(am.init("actor-2"), set_("y", 1))
+        with pytest.raises(ValueError, match="diverged"):
+            am.diff(d1, e1)
+
+    def test_equals(self):
+        d1 = am.change(am.init("a1"), set_("x", 1))
+        d2 = am.apply_changes(am.init("a2"), am.get_all_changes(d1))
+        assert am.equals(am.to_json(d1), am.to_json(d2))
+        assert not am.equals(am.to_json(d1), {"x": 2})
+
+
+class TestFreeze:
+    def test_frozen_docs_raise_on_mutation(self):
+        d1 = am.change(am.init({"freeze": True}), set_("xs", [1]))
+        with pytest.raises(TypeError, match="frozen"):
+            d1["direct"] = 1
+        with pytest.raises(TypeError, match="frozen"):
+            d1["xs"].append(2)
+
+    def test_unfrozen_by_default_but_convention(self):
+        d1 = am.change(am.init(), set_("x", 1))
+        # default docs are not frozen (same as the reference)
+        d1["sneaky"] = 1
+        assert d1["sneaky"] == 1

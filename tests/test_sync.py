@@ -1,0 +1,181 @@
+"""Sync layer: DocSet/WatchableDoc handlers and the Connection protocol.
+
+Multi-node behavior is tested entirely in-process, the same strategy as
+/root/reference/test/connection_test.js: N DocSets wired through an in-memory
+message network with explicit delivery (supports delaying/dropping messages).
+"""
+
+import automerge_tpu as am
+from automerge_tpu import Connection, DocSet, WatchableDoc
+
+
+def set_(key, value):
+    def cb(doc):
+        doc[key] = value
+    return cb
+
+
+class Network:
+    """In-memory message fabric between connections, with manual delivery."""
+
+    def __init__(self):
+        self.queues = {}   # name -> list of undelivered messages
+        self.conns = {}    # name -> Connection
+        self.sent = []     # (sender, msg) log for message-count invariants
+
+    def connect(self, name_a, docset_a, name_b, docset_b):
+        conn_a = Connection(docset_a, lambda msg: self._enqueue(name_a, name_b, msg))
+        conn_b = Connection(docset_b, lambda msg: self._enqueue(name_b, name_a, msg))
+        self.conns[name_a] = conn_a
+        self.conns[name_b] = conn_b
+        conn_a.open()
+        conn_b.open()
+        return conn_a, conn_b
+
+    def _enqueue(self, sender, receiver, msg):
+        self.sent.append((sender, msg))
+        self.queues.setdefault(receiver, []).append(msg)
+
+    def deliver(self, receiver, count=None):
+        queue = self.queues.get(receiver, [])
+        n = len(queue) if count is None else count
+        for _ in range(n):
+            self.conns[receiver].receive_msg(queue.pop(0))
+
+    def deliver_all(self):
+        while any(self.queues.values()):
+            for receiver in list(self.queues.keys()):
+                self.deliver(receiver)
+
+    def drop(self, receiver, count=1):
+        for _ in range(count):
+            self.queues.get(receiver, []).pop(0)
+
+
+class TestDocSet:
+    def test_set_get_remove(self):
+        ds = DocSet()
+        doc = am.init("actor-1")
+        ds.set_doc("doc1", doc)
+        assert ds.get_doc("doc1") is doc
+        assert ds.doc_ids == ["doc1"]
+        ds.remove_doc("doc1")
+        assert ds.get_doc("doc1") is None
+
+    def test_handlers_notified(self):
+        ds = DocSet()
+        seen = []
+        ds.register_handler(lambda doc_id, doc: seen.append(doc_id))
+        ds.set_doc("a", am.init())
+        assert seen == ["a"]
+        ds.unregister_handler(ds._handlers[0])
+        ds.set_doc("b", am.init())
+        assert seen == ["a"]
+
+    def test_apply_changes_creates_doc(self):
+        src = am.change(am.init("actor-1"), set_("x", 1))
+        ds = DocSet()
+        doc = ds.apply_changes("doc1", am.get_all_changes(src))
+        assert am.to_json(doc) == {"x": 1}
+
+
+class TestWatchableDoc:
+    def test_handler_on_set(self):
+        wd = WatchableDoc(am.init("actor-1"))
+        seen = []
+        wd.register_handler(lambda doc: seen.append(am.to_json(doc)))
+        src = am.change(am.init("actor-2"), set_("x", 1))
+        wd.apply_changes(am.get_all_changes(src))
+        assert seen == [{"x": 1}]
+        assert am.to_json(wd.get()) == {"x": 1}
+
+
+class TestConnection:
+    def test_doc_transfer(self):
+        # mirrors connection_test.js:81-108 — node A has a doc, node B requests it
+        ds_a, ds_b = DocSet(), DocSet()
+        doc = am.change(am.init("actor-1"), set_("bird", "magpie"))
+        ds_a.set_doc("birds", doc)
+        net = Network()
+        net.connect("a", ds_a, "b", ds_b)
+        net.deliver_all()
+        assert am.to_json(ds_b.get_doc("birds")) == {"bird": "magpie"}
+
+    def test_bidirectional_concurrent_changes(self):
+        ds_a, ds_b = DocSet(), DocSet()
+        base = am.change(am.init("actor-1"), set_("x", 0))
+        ds_a.set_doc("doc", base)
+        net = Network()
+        net.connect("a", ds_a, "b", ds_b)
+        net.deliver_all()
+
+        # both sides edit concurrently
+        ds_a.set_doc("doc", am.change(ds_a.get_doc("doc"), set_("a", 1)))
+        ds_b.set_doc("doc", am.change(
+            am.set_actor_id(ds_b.get_doc("doc"), "actor-2"), set_("b", 2)))
+        net.deliver_all()
+        assert am.to_json(ds_a.get_doc("doc")) == am.to_json(ds_b.get_doc("doc"))
+        assert am.to_json(ds_a.get_doc("doc")) == {"x": 0, "a": 1, "b": 2}
+
+    def test_sync_terminates(self):
+        # after convergence no further messages flow (message-count invariant,
+        # connection_test.js:53-64)
+        ds_a, ds_b = DocSet(), DocSet()
+        ds_a.set_doc("doc", am.change(am.init("actor-1"), set_("x", 1)))
+        net = Network()
+        net.connect("a", ds_a, "b", ds_b)
+        net.deliver_all()
+        n_msgs = len(net.sent)
+        # idempotent re-set of an unchanged doc must not cause a storm
+        ds_a.set_doc("doc", ds_a.get_doc("doc"))
+        net.deliver_all()
+        assert len(net.sent) == n_msgs
+
+    def test_dropped_advertisement_tolerated(self):
+        # The protocol tolerates dropped clock-only (advertisement/ack)
+        # messages; change-bearing sends optimistically advance theirClock
+        # (same contract as the reference, connection_test.js:188-231).
+        ds_a, ds_b = DocSet(), DocSet()
+        base = am.change(am.init("actor-1"), set_("x", 1))
+        other = am.change(am.set_actor_id(am.merge(am.init("tmp"), base), "actor-2"),
+                          set_("b", 2))
+        ds_a.set_doc("doc", am.change(base, set_("a", 1)))
+        ds_b.set_doc("doc", other)
+        net = Network()
+        net.connect("a", ds_a, "b", ds_b)
+        # drop b's initial advertisement to a; a's advertisement still arrives
+        net.drop("a", 1)
+        net.deliver_all()
+        assert am.to_json(ds_a.get_doc("doc")) == am.to_json(ds_b.get_doc("doc"))
+        assert am.to_json(ds_a.get_doc("doc")) == {"x": 1, "a": 1, "b": 2}
+
+    def test_three_node_chain(self):
+        ds_a, ds_b, ds_c = DocSet(), DocSet(), DocSet()
+        ds_a.set_doc("doc", am.change(am.init("actor-1"), set_("from", "a")))
+        net = Network()
+        net.connect("a", ds_a, "b", ds_b)
+        # second pair: b <-> c (b participates in both)
+        conn_b2 = Connection(ds_b, lambda msg: net._enqueue("b2", "c", msg))
+        conn_c = Connection(ds_c, lambda msg: net._enqueue("c", "b2", msg))
+        net.conns["b2"], net.conns["c"] = conn_b2, conn_c
+        conn_b2.open()
+        conn_c.open()
+        net.deliver_all()
+        assert am.to_json(ds_c.get_doc("doc")) == {"from": "a"}
+
+    def test_old_state_raises(self):
+        ds_a = DocSet()
+        d1 = am.change(am.init("actor-1"), set_("x", 1))
+        ds_a.set_doc("doc", d1)
+        net = Network()
+        net.connect("a", ds_a, "b", DocSet())
+        net.deliver_all()
+        d2 = am.change(d1, set_("y", 2))
+        ds_a.set_doc("doc", d2)
+        net.deliver_all()
+        try:
+            ds_a.set_doc("doc", d1)  # stale snapshot
+            raised = False
+        except ValueError:
+            raised = True
+        assert raised

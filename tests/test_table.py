@@ -1,0 +1,107 @@
+"""Table CRDT tests — coverage mirrors /root/reference/test/table_test.js."""
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import Table
+
+
+def make_table():
+    doc = am.change(am.init("actor-1"), lambda d: d.__setitem__("books", Table()))
+    row_ids = {}
+
+    def add(d):
+        row_ids["ddia"] = d["books"].add({
+            "authors": ["Kleppmann, Martin"],
+            "title": "Designing Data-Intensive Applications",
+            "isbn": "1449373321",
+        })
+    doc = am.change(doc, add)
+    return doc, row_ids["ddia"]
+
+
+class TestTable:
+    def test_create_empty(self):
+        doc = am.change(am.init(), lambda d: d.__setitem__("books", Table()))
+        assert doc["books"].count == 0
+        assert doc["books"].ids == []
+
+    def test_add_row_and_by_id(self):
+        doc, row_id = make_table()
+        row = doc["books"].by_id(row_id)
+        assert row["title"] == "Designing Data-Intensive Applications"
+        assert row["id"] == row_id
+        assert doc["books"].count == 1
+
+    def test_row_object_id_is_row_id(self):
+        doc, row_id = make_table()
+        assert am.get_object_id(doc["books"].by_id(row_id)) == row_id
+
+    def test_rows_and_iteration(self):
+        doc, row_id = make_table()
+        assert [r["isbn"] for r in doc["books"]] == ["1449373321"]
+        assert doc["books"].rows[0]["id"] == row_id
+
+    def test_filter_find_map(self):
+        doc, _ = make_table()
+        books = doc["books"]
+        assert books.filter(lambda r: r["isbn"] == "1449373321")[0]["title"].startswith("Designing")
+        assert books.find(lambda r: False) is None
+        assert books.map(lambda r: r["isbn"]) == ["1449373321"]
+
+    def test_sort_by_column(self):
+        doc = am.change(am.init(), lambda d: d.__setitem__("t", Table()))
+
+        def add_rows(d):
+            d["t"].add({"k": "b", "n": 2})
+            d["t"].add({"k": "a", "n": 3})
+            d["t"].add({"k": "c", "n": 1})
+        doc = am.change(doc, add_rows)
+        assert [r["k"] for r in doc["t"].sort("k")] == ["a", "b", "c"]
+        assert [r["n"] for r in doc["t"].sort("n")] == [1, 2, 3]
+
+    def test_remove_row(self):
+        doc, row_id = make_table()
+        doc2 = am.change(doc, lambda d: d["t" if False else "books"].remove(row_id))
+        assert doc2["books"].count == 0
+
+    def test_remove_missing_row_raises(self):
+        doc, _ = make_table()
+        with pytest.raises(KeyError):
+            am.change(doc, lambda d: d["books"].remove("no-such-row"))
+
+    def test_update_row_field(self):
+        doc, row_id = make_table()
+        doc2 = am.change(doc, lambda d: d["books"].by_id(row_id).__setitem__("isbn", "1"))
+        assert doc2["books"].by_id(row_id)["isbn"] == "1"
+
+    def test_row_id_property_rejected(self):
+        doc = am.change(am.init(), lambda d: d.__setitem__("t", Table()))
+        with pytest.raises(TypeError, match='"id"'):
+            am.change(doc, lambda d: d["t"].add({"id": "custom"}))
+
+    def test_non_empty_table_assignment_rejected(self):
+        doc, row_id = make_table()
+
+        def reassign(d):
+            d["other"] = Table()  # empty is fine
+        am.change(doc, reassign)
+
+    def test_concurrent_rows_merge(self):
+        base = am.change(am.init("actor-1"), lambda d: d.__setitem__("t", Table()))
+        other = am.merge(am.init("actor-2"), base)
+        a = am.change(base, lambda d: d["t"].add({"k": "from-a"}))
+        b = am.change(other, lambda d: d["t"].add({"k": "from-b"}))
+        m1, m2 = am.merge(a, b), am.merge(b, a)
+        assert m1["t"].count == m2["t"].count == 2
+        assert sorted(r["k"] for r in m1["t"]) == ["from-a", "from-b"]
+
+    def test_save_load(self):
+        doc, row_id = make_table()
+        loaded = am.load(am.save(doc), "actor-2")
+        assert loaded["books"].by_id(row_id)["isbn"] == "1449373321"
+
+    def test_to_json(self):
+        doc, row_id = make_table()
+        js = am.to_json(doc)
+        assert js["books"][row_id]["isbn"] == "1449373321"

@@ -1,0 +1,118 @@
+"""Text CRDT tests — coverage mirrors /root/reference/test/text_test.js:
+editing, spans, control characters/embedded objects, concurrent edits.
+"""
+
+import automerge_tpu as am
+from automerge_tpu import Text
+
+
+def with_text(initial=""):
+    return am.change(am.init("actor-1"), lambda d: d.__setitem__("text", Text(initial)))
+
+
+class TestTextBasics:
+    def test_empty_text(self):
+        d = with_text()
+        assert len(d["text"]) == 0
+        assert str(d["text"]) == ""
+
+    def test_initial_value(self):
+        d = with_text("init")
+        assert str(d["text"]) == "init"
+        assert len(d["text"]) == 4
+        assert d["text"][0] == "i"
+        assert list(d["text"]) == ["i", "n", "i", "t"]
+
+    def test_insert_at(self):
+        d1 = with_text("it")
+        d2 = am.change(d1, lambda d: d["text"].insert_at(1, "n", "i"))
+        assert str(d2["text"]) == "init"
+
+    def test_delete_at(self):
+        d1 = with_text("initial")
+        d2 = am.change(d1, lambda d: d["text"].delete_at(4, 3))
+        assert str(d2["text"]) == "init"
+
+    def test_set(self):
+        d1 = with_text("cat")
+        d2 = am.change(d1, lambda d: d["text"].set(1, "u"))
+        assert str(d2["text"]) == "cut"
+
+    def test_equality_with_str(self):
+        d = with_text("abc")
+        assert d["text"] == "abc"
+        assert d["text"] == Text("abc")
+
+    def test_immutable_outside_change(self):
+        d = with_text("abc")
+        try:
+            d["text"].insert_at(0, "x")
+            raised = False
+        except TypeError:
+            raised = True
+        assert raised
+
+    def test_elem_ids_stable(self):
+        d = with_text("ab")
+        e0 = d["text"].get_elem_id(0)
+        d2 = am.change(d, lambda doc: doc["text"].insert_at(1, "x"))
+        assert d2["text"].get_elem_id(0) == e0
+
+
+class TestSpans:
+    def test_to_spans_chars_only(self):
+        d = with_text("hello")
+        assert d["text"].to_spans() == ["hello"]
+
+    def test_to_spans_with_embeds(self):
+        d1 = with_text("ab")
+        d2 = am.change(d1, lambda d: d["text"].insert_at(1, {"attribute": "bold"}))
+        spans = d2["text"].to_spans()
+        assert spans[0] == "a"
+        assert am.to_json(spans[1]) == {"attribute": "bold"}
+        assert spans[2] == "b"
+
+    def test_to_string_skips_embeds(self):
+        d1 = with_text("ab")
+        d2 = am.change(d1, lambda d: d["text"].insert_at(1, {"x": 1}))
+        assert str(d2["text"]) == "ab"
+
+    def test_to_json(self):
+        d = with_text("hi")
+        assert am.to_json(d) == {"text": "hi"}
+
+
+class TestConcurrentText:
+    def test_concurrent_inserts_converge(self):
+        base = with_text("helo")
+        other = am.merge(am.init("actor-2"), base)
+        a = am.change(base, lambda d: d["text"].insert_at(2, "l"))
+        b = am.change(other, lambda d: d["text"].insert_at(4, "!"))
+        m1 = am.merge(a, b)
+        m2 = am.merge(b, a)
+        assert str(m1["text"]) == str(m2["text"]) == "hello!"
+
+    def test_concurrent_insert_same_position(self):
+        base = with_text("--")
+        other = am.merge(am.init("actor-2"), base)
+        a = am.change(base, lambda d: d["text"].insert_at(1, "A"))
+        b = am.change(other, lambda d: d["text"].insert_at(1, "B"))
+        m1, m2 = am.merge(a, b), am.merge(b, a)
+        assert str(m1["text"]) == str(m2["text"])
+        assert str(m1["text"]) in ("-AB-", "-BA-")
+
+    def test_insert_and_delete_converge(self):
+        base = with_text("abcdef")
+        other = am.merge(am.init("actor-2"), base)
+        a = am.change(base, lambda d: d["text"].delete_at(1, 2))  # a___def -> adef
+        b = am.change(other, lambda d: d["text"].insert_at(3, "X"))  # abcXdef
+        m1, m2 = am.merge(a, b), am.merge(b, a)
+        assert str(m1["text"]) == str(m2["text"]) == "aXdef"
+
+    def test_save_load_round_trip(self):
+        d1 = with_text("persist me")
+        d2 = am.change(d1, lambda d: d["text"].delete_at(0, 8))
+        loaded = am.load(am.save(d2), "actor-2")
+        assert str(loaded["text"]) == "me"
+        d3 = am.change(loaded, lambda d: d["text"].insert_at(0, "s", "a", "v", "e", " "))
+        assert str(d3["text"]) == "save me"
