@@ -1,0 +1,2 @@
+from .columnar import TextChangeBatch  # noqa: F401
+from .text_doc import DeviceTextDoc  # noqa: F401

@@ -1,0 +1,130 @@
+"""Columnar change-batch encoding for the device engine.
+
+The reference's wire format is row-oriented JSON (one dict per op). The device
+engine consumes a struct-of-arrays encoding instead: one numpy column per op
+field, with interned actor ids. `from_changes` converts wire-format changes;
+high-throughput producers (benchmarks, native ingest) can build the columns
+directly — this is the framework's native bulk format.
+
+Only text/list ops are encoded (ins/set/del/inc on one target object); the
+general document graph stays on the oracle path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .._common import parse_elem_id
+
+KIND_INS, KIND_SET, KIND_DEL, KIND_INC = 0, 1, 2, 3
+HEAD_PARENT = -1  # parent actor idx encoding for '_head'
+
+
+@dataclass
+class TextChangeBatch:
+    """A batch of changes targeting one list/text object, columnar."""
+
+    obj_id: str
+    # per-change rows
+    actors: list            # actor id string per change
+    seqs: np.ndarray        # int32[n_changes]
+    deps: list              # dict per change
+    messages: list          # optional str per change
+    # per-op columns
+    op_change: np.ndarray       # int32[n_ops] -> change row
+    op_kind: np.ndarray         # int8[n_ops]
+    op_target_actor: np.ndarray  # int32[n_ops] -> batch actor table (elemId actor)
+    op_target_ctr: np.ndarray   # int32[n_ops] (elemId counter; for ins: new elem)
+    op_parent_actor: np.ndarray  # int32[n_ops] (ins only; HEAD_PARENT for '_head')
+    op_parent_ctr: np.ndarray   # int32[n_ops]
+    op_value: np.ndarray        # int64[n_ops] (codepoint, value-pool ref, or inc delta)
+    actor_table: list = field(default_factory=list)  # batch-local actor interning
+    value_pool: list = field(default_factory=list)   # non-codepoint values
+
+    @property
+    def n_changes(self) -> int:
+        return len(self.actors)
+
+    @property
+    def n_ops(self) -> int:
+        return len(self.op_kind)
+
+    @classmethod
+    def from_changes(cls, changes, obj_id: str) -> "TextChangeBatch":
+        """Decode wire-format changes (plain dicts) into columns."""
+        actor_rank: dict = {}
+        actor_table: list = []
+        value_pool: list = []
+
+        def intern(actor: str) -> int:
+            if actor not in actor_rank:
+                actor_rank[actor] = len(actor_table)
+                actor_table.append(actor)
+            return actor_rank[actor]
+
+        actors, seqs, deps, messages = [], [], [], []
+        cols = {k: [] for k in ("change", "kind", "ta", "tc", "pa", "pc", "val")}
+
+        for row, change in enumerate(changes):
+            actors.append(change["actor"])
+            seqs.append(change["seq"])
+            deps.append(change.get("deps", {}))
+            messages.append(change.get("message"))
+            a_idx = intern(change["actor"])
+            for op in change["ops"]:
+                if op.get("obj") != obj_id:
+                    raise ValueError(
+                        f"op targets {op.get('obj')}, batch is for {obj_id}")
+                action = op["action"]
+                cols["change"].append(row)
+                if action == "ins":
+                    cols["kind"].append(KIND_INS)
+                    cols["ta"].append(a_idx)
+                    cols["tc"].append(op["elem"])
+                    if op["key"] == "_head":
+                        cols["pa"].append(HEAD_PARENT)
+                        cols["pc"].append(0)
+                    else:
+                        p_actor, p_ctr = parse_elem_id(op["key"])
+                        cols["pa"].append(intern(p_actor))
+                        cols["pc"].append(p_ctr)
+                    cols["val"].append(0)
+                elif action in ("set", "del", "inc"):
+                    kind = {"set": KIND_SET, "del": KIND_DEL, "inc": KIND_INC}[action]
+                    cols["kind"].append(kind)
+                    t_actor, t_ctr = parse_elem_id(op["key"])
+                    cols["ta"].append(intern(t_actor))
+                    cols["tc"].append(t_ctr)
+                    cols["pa"].append(HEAD_PARENT)
+                    cols["pc"].append(0)
+                    if action == "set":
+                        value = op["value"]
+                        if (isinstance(value, str) and len(value) == 1
+                                and not op.get("datatype")):
+                            cols["val"].append(ord(value))
+                        else:
+                            value_pool.append(
+                                {"value": value, "datatype": op.get("datatype")})
+                            cols["val"].append(-len(value_pool))  # negative = pool ref
+                    elif action == "inc":
+                        cols["val"].append(op["value"])
+                    else:
+                        cols["val"].append(0)
+                else:
+                    raise ValueError(
+                        f"unsupported op action for columnar batch: {action}")
+
+        return cls(
+            obj_id=obj_id, actors=actors,
+            seqs=np.asarray(seqs, np.int32), deps=deps, messages=messages,
+            op_change=np.asarray(cols["change"], np.int32),
+            op_kind=np.asarray(cols["kind"], np.int8),
+            op_target_actor=np.asarray(cols["ta"], np.int32),
+            op_target_ctr=np.asarray(cols["tc"], np.int32),
+            op_parent_actor=np.asarray(cols["pa"], np.int32),
+            op_parent_ctr=np.asarray(cols["pc"], np.int32),
+            op_value=np.asarray(cols["val"], np.int64),
+            actor_table=actor_table, value_pool=value_pool,
+        )

@@ -1,0 +1,575 @@
+"""Device-resident text/list CRDT document.
+
+This is the TPU-native replacement for the reference's per-op reconciliation
+of sequences (`backend/op_set.js` applyInsert/applyAssign + skip list): the
+document lives as a padded columnar element table; whole *batches* of changes
+merge in one step. Causal admission and register (LWW) resolution run
+vectorized on the host over numpy columns; RGA ordering and visible-index
+compaction run on device (`ops/linearize.py`, `ops/scan.py`).
+
+Semantics match the oracle exactly (see tests/test_engine_parity.py):
+- causal readiness gating with queueing of unready changes, idempotent dups
+- per-element multi-value registers: a set op survives until another op on the
+  same element causally overwrites it; winner = highest actor id; concurrent
+  survivors are conflicts
+- counter `inc` folds into causally-visible counter set ops
+- RGA concurrent-insert ordering (descending Lamport at each insertion point)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .._common import make_elem_id
+from .columnar import (HEAD_PARENT, KIND_DEL, KIND_INC, KIND_INS, KIND_SET,
+                       TextChangeBatch)
+
+_GROW = 1.5
+
+
+def _pack(actor_idx: np.ndarray, ctr: np.ndarray) -> np.ndarray:
+    """Pack (actor rank, counter) element ids into sortable int64 keys."""
+    return (actor_idx.astype(np.int64) << 32) | ctr.astype(np.int64)
+
+
+class DeviceTextDoc:
+    """One text/list object, columnar, merged in batches.
+
+    Element table layout (host numpy, mirrored to device for kernels):
+    slot 0 is the virtual head; live elements occupy 1..n_elems.
+    """
+
+    def __init__(self, obj_id: str = "text", capacity: int = 1024):
+        self.obj_id = obj_id
+        self.actor_table: list = []           # rank -> actor id (lex-ordered)
+        self._actor_rank: dict = {}
+        self.clock: dict = {}                 # actor id -> seq
+        self._all_deps: dict = {}             # (actor, seq) -> allDeps dict
+        self.queue: list = []                 # (batch, row) not causally ready
+        self.n_elems = 0                      # live element count (excl. head)
+
+        cap = max(capacity, 16)
+        self.parent = np.zeros(cap, np.int32)     # element slot of parent (0=head)
+        self.ctr = np.zeros(cap, np.int32)
+        self.actor = np.zeros(cap, np.int32)      # actor rank of inserting actor
+        # register state: up to one winner inline; extra survivors in overflow
+        self.value = np.zeros(cap, np.int64)      # codepoint or -(pool ref + 1)
+        self.has_value = np.zeros(cap, bool)
+        self.win_actor = np.full(cap, -1, np.int32)  # winning set op's actor rank
+        self.win_seq = np.zeros(cap, np.int32)
+        self.win_counter = np.zeros(cap, bool)       # winner has datatype counter
+        self.conflicts: dict = {}             # slot -> list of extra surviving ops
+        self.value_pool: list = []            # rich values (non-single-char)
+        # elem key -> slot index, as parallel sorted arrays (vectorized lookup)
+        self._keys_sorted = np.empty(0, np.int64)
+        self._slots_sorted = np.empty(0, np.int32)
+        self._pos_cache: Optional[np.ndarray] = None
+
+    # -- packed-key index ------------------------------------------------
+
+    def _lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Vectorized elem-key -> slot lookup (-1 where missing)."""
+        if len(self._keys_sorted) == 0:
+            return np.full(len(keys), -1, np.int32)
+        i = np.clip(np.searchsorted(self._keys_sorted, keys), 0,
+                    len(self._keys_sorted) - 1)
+        return np.where(self._keys_sorted[i] == keys,
+                        self._slots_sorted[i], -1).astype(np.int32)
+
+    def _index_add(self, keys: np.ndarray, slots: np.ndarray):
+        all_keys = np.concatenate([self._keys_sorted, keys])
+        all_slots = np.concatenate([self._slots_sorted, slots.astype(np.int32)])
+        order = np.argsort(all_keys, kind="stable")
+        self._keys_sorted = all_keys[order]
+        self._slots_sorted = all_slots[order]
+
+    def _index_rebuild(self):
+        n = self.n_elems
+        keys = _pack(self.actor[1:n + 1], self.ctr[1:n + 1])
+        slots = np.arange(1, n + 1, dtype=np.int32)
+        order = np.argsort(keys, kind="stable")
+        self._keys_sorted = keys[order]
+        self._slots_sorted = slots[order]
+
+    # ------------------------------------------------------------------
+    # actor interning (order-preserving: rank order == lexicographic order)
+    # ------------------------------------------------------------------
+
+    def _intern_actors(self, new_actors) -> Optional[np.ndarray]:
+        """Add actors; if rank order changes, return the old->new remap."""
+        missing = sorted(set(a for a in new_actors if a not in self._actor_rank))
+        if not missing:
+            return None
+        merged = sorted(set(self.actor_table) | set(missing))
+        remap = None
+        if self.actor_table and merged[: len(self.actor_table)] != self.actor_table:
+            old_to_new = {a: merged.index(a) for a in self.actor_table}
+            remap = np.asarray(
+                [old_to_new[a] for a in self.actor_table], np.int32)
+        self.actor_table = merged
+        self._actor_rank = {a: i for i, a in enumerate(merged)}
+        return remap
+
+    def _apply_remap(self, remap: np.ndarray):
+        n = self.n_elems + 1
+        live = self.actor[:n]
+        self.actor[:n] = remap[live]
+        win = self.win_actor[:n]
+        self.win_actor[:n] = np.where(win >= 0, remap[np.clip(win, 0, None)], -1)
+        for slot, ops in self.conflicts.items():
+            for op in ops:
+                op["actor_rank"] = int(remap[op["actor_rank"]])
+        self._index_rebuild()  # packed keys embed actor ranks
+        self._pos_cache = None
+
+    # ------------------------------------------------------------------
+    # causality
+    # ------------------------------------------------------------------
+
+    def _compute_all_deps(self, actor: str, seq: int, deps: dict) -> dict:
+        base = dict(deps)
+        if seq > 1:
+            base[actor] = seq - 1
+        out: dict = {}
+        for dep_actor, dep_seq in base.items():
+            if dep_seq <= 0:
+                continue
+            transitive = self._all_deps.get((dep_actor, dep_seq))
+            if transitive:
+                for a, s in transitive.items():
+                    if s > out.get(a, 0):
+                        out[a] = s
+            out[dep_actor] = dep_seq
+        return out
+
+    # ------------------------------------------------------------------
+    # batch application
+    # ------------------------------------------------------------------
+
+    def apply_changes(self, changes) -> "DeviceTextDoc":
+        return self.apply_batch(TextChangeBatch.from_changes(changes, self.obj_id))
+
+    def apply_batch(self, batch: TextChangeBatch) -> "DeviceTextDoc":
+        """Merge a columnar change batch (causally gated, idempotent)."""
+        # --- admission: schedule rows in causal rounds over a host clock ---
+        pending = list(range(batch.n_changes)) + self.queue
+        clock = dict(self.clock)
+        scheduled: set = set()  # (actor, seq) admitted in this call
+        rounds: list = []
+        while pending:
+            ready, not_ready = [], []
+            for item in pending:
+                b, row = (batch, item) if isinstance(item, int) else item
+                actor, seq = b.actors[row], int(b.seqs[row])
+                if seq <= clock.get(actor, 0) or (actor, seq) in scheduled:
+                    continue  # duplicate: idempotent skip (inconsistent reuse
+                    # of a seq by the same actor is not detected here; the
+                    # oracle backend raises on it)
+                deps = dict(b.deps[row])
+                deps[actor] = seq - 1
+                if all(clock.get(a, 0) >= s for a, s in deps.items()):
+                    ready.append((b, row))
+                    scheduled.add((actor, seq))
+                else:
+                    not_ready.append(item if not isinstance(item, int) else (b, row))
+            if not ready:
+                self.queue = not_ready
+                break
+            for b, row in ready:
+                clock[b.actors[row]] = int(b.seqs[row])
+            rounds.append(ready)
+            pending = not_ready
+        else:
+            self.queue = []
+
+        for ready in rounds:
+            self._apply_round(ready)
+        self._pos_cache = None
+        return self
+
+    def _apply_round(self, ready):
+        """Apply causally-ready (batch, row) pairs: all ops vectorized."""
+        # group rows per batch object so op columns slice cheaply
+        by_batch: dict = {}
+        for b, row in ready:
+            by_batch.setdefault(id(b), (b, []))[1].append(row)
+
+        for b, rows in by_batch.values():
+            rows_arr = np.asarray(sorted(rows), np.int32)
+            # update clocks + allDeps
+            for row in rows_arr:
+                actor, seq = b.actors[row], int(b.seqs[row])
+                self._all_deps[(actor, seq)] = self._compute_all_deps(
+                    actor, seq, b.deps[row])
+                self.clock[actor] = seq
+
+            # ops may reference elemIds minted by actors whose own changes sit
+            # in other rounds, so intern the batch's whole actor table
+            remap = self._intern_actors(b.actor_table)
+            if remap is not None:
+                self._apply_remap(remap)
+            batch_rank = np.asarray(
+                [self._actor_rank[a] for a in b.actor_table], np.int32)
+
+            if len(rows_arr) == b.n_changes:
+                mask = slice(None)  # whole batch ready: no filtering needed
+            else:
+                mask = np.isin(b.op_change, rows_arr)
+            kind = b.op_kind[mask]
+            target_a = batch_rank[b.op_target_actor[mask]]
+            target_c = b.op_target_ctr[mask]
+            parent_a_raw = b.op_parent_actor[mask]
+            parent_a = np.where(parent_a_raw == HEAD_PARENT, 0,
+                                batch_rank[np.clip(parent_a_raw, 0, None)])
+            parent_c = b.op_parent_ctr[mask]
+            value = b.op_value[mask]
+            op_row = b.op_change[mask]
+            row_rank = np.asarray([self._actor_rank[a] for a in b.actors], np.int32)
+            change_actor = row_rank[op_row]
+            change_seq = b.seqs[op_row]
+
+            self._apply_inserts(b, kind, target_a, target_c, parent_a_raw,
+                                parent_a, parent_c)
+            self._apply_assigns(b, kind, target_a, target_c, value,
+                                change_actor, change_seq, op_row)
+
+    def _grow(self, needed: int):
+        cap = len(self.parent)
+        if needed <= cap:
+            return
+        new_cap = cap
+        while new_cap < needed:
+            new_cap = int(new_cap * _GROW) + 64
+        for name in ("parent", "ctr", "actor", "value", "win_actor", "win_seq"):
+            arr = getattr(self, name)
+            grown = np.zeros(new_cap, arr.dtype)
+            grown[: len(arr)] = arr
+            setattr(self, name, grown)
+        for name in ("has_value", "win_counter"):
+            arr = getattr(self, name)
+            grown = np.zeros(new_cap, bool)
+            grown[: len(arr)] = arr
+            setattr(self, name, grown)
+
+    def _apply_inserts(self, b, kind, target_a, target_c, parent_a_raw,
+                       parent_a, parent_c):
+        ins = kind == KIND_INS
+        n_new = int(ins.sum())
+        if not n_new:
+            return
+        new_keys = _pack(target_a[ins], target_c[ins])
+        existing = self._lookup(new_keys)
+        uniq, counts = np.unique(new_keys, return_counts=True)
+        if (existing >= 0).any() or (counts > 1).any():
+            dup = int(new_keys[existing >= 0][0]) if (existing >= 0).any() \
+                else int(uniq[counts > 1][0])
+            raise ValueError(
+                "Duplicate list element ID "
+                f"{make_elem_id(self.actor_table[dup >> 32], dup & 0xFFFFFFFF)}")
+
+        start = self.n_elems + 1
+        self._grow(start + n_new)
+        sl = slice(start, start + n_new)
+        self.actor[sl] = target_a[ins]
+        self.ctr[sl] = target_c[ins]
+        self._index_add(new_keys, np.arange(start, start + n_new, dtype=np.int32))
+        self.n_elems += n_new
+
+        # resolve parent slots: head, existing element, or new element in batch
+        is_head = parent_a_raw[ins] == HEAD_PARENT
+        p_keys = _pack(parent_a[ins], parent_c[ins])
+        parent_slots = self._lookup(p_keys)
+        parent_slots = np.where(is_head, 0, parent_slots)
+        if (parent_slots < 0).any():
+            bad = int(p_keys[parent_slots < 0][0])
+            raise ValueError(
+                "ins references unknown parent element "
+                f"{make_elem_id(self.actor_table[bad >> 32], bad & 0xFFFFFFFF)}")
+        self.parent[sl] = parent_slots
+        self.win_actor[sl] = -1
+        self.has_value[sl] = False
+
+    def _apply_assigns(self, b, kind, target_a, target_c, value,
+                       change_actor, change_seq, op_row):
+        """set/del/inc ops with register semantics, vectorized fast path."""
+        assign = (kind == KIND_SET) | (kind == KIND_DEL) | (kind == KIND_INC)
+        if not assign.any():
+            return
+        keys = _pack(target_a[assign], target_c[assign])
+        slots = self._lookup(keys)
+        if (slots < 0).any():
+            bad = int(keys[slots < 0][0])
+            raise ValueError(
+                "assignment to unknown element "
+                f"{make_elem_id(self.actor_table[bad >> 32], bad & 0xFFFFFFFF)}")
+
+        a_kind = kind[assign]
+        a_value = value[assign]
+        a_actor = change_actor[assign]
+        a_seq = change_seq[assign]
+        a_row = op_row[assign]
+
+        # fast path: single 'set' on an element with no existing register and
+        # no other op in this round (the overwhelmingly common insert+set)
+        unique, counts = np.unique(slots, return_counts=True)
+        single = np.isin(slots, unique[counts == 1])
+        fast = single & (a_kind == KIND_SET) & ~self.has_value[slots] \
+            & (self.win_actor[slots] < 0)
+        if self.conflicts:
+            fast &= ~np.isin(slots, np.fromiter(self.conflicts, np.int32,
+                                                len(self.conflicts)))
+        f_slots = slots[fast]
+        self.value[f_slots] = a_value[fast]
+        self.has_value[f_slots] = True
+        self.win_actor[f_slots] = a_actor[fast]
+        self.win_seq[f_slots] = a_seq[fast]
+        self.win_counter[f_slots] = False
+        if b.value_pool:
+            rich = fast & (a_value < 0)
+            for s, v in zip(slots[rich], a_value[rich]):
+                entry = b.value_pool[-int(v) - 1]
+                self.value_pool.append(entry)
+                self.value[s] = -len(self.value_pool)
+                self.win_counter[s] = entry.get("datatype") == "counter"
+
+        # general path: everything else, in op order (small subset)
+        slow = ~fast
+        order = np.argsort(a_row[slow], kind="stable")
+        s_slots = slots[slow][order]
+        s_kind = a_kind[slow][order]
+        s_value = a_value[slow][order]
+        s_actor = a_actor[slow][order]
+        s_seq = a_seq[slow][order]
+        for i in range(len(s_slots)):
+            self._apply_one_assign(b, int(s_slots[i]), int(s_kind[i]),
+                                   int(s_value[i]), int(s_actor[i]), int(s_seq[i]))
+
+    # -- general register update (matches oracle applyAssign semantics) --
+
+    def _register_ops(self, slot: int) -> list:
+        """Current surviving ops at `slot` as a list of dicts (winner first)."""
+        ops = []
+        if self.has_value[slot] or self.win_actor[slot] >= 0:
+            ops.append({"actor_rank": int(self.win_actor[slot]),
+                        "seq": int(self.win_seq[slot]),
+                        "value": int(self.value[slot]),
+                        "counter": bool(self.win_counter[slot])})
+        ops.extend(self.conflicts.get(slot, []))
+        return ops
+
+    def _store_register(self, slot: int, ops: list):
+        ops.sort(key=lambda o: o["actor_rank"], reverse=True)
+        if ops:
+            winner = ops[0]
+            self.value[slot] = winner["value"]
+            self.win_actor[slot] = winner["actor_rank"]
+            self.win_seq[slot] = winner["seq"]
+            self.win_counter[slot] = winner["counter"]
+            self.has_value[slot] = True
+        else:
+            self.has_value[slot] = False
+            self.win_actor[slot] = -1
+            self.win_counter[slot] = False
+        extras = ops[1:]
+        if extras:
+            self.conflicts[slot] = extras
+        else:
+            self.conflicts.pop(slot, None)
+
+    def _apply_one_assign(self, b, slot: int, kind: int, value: int,
+                          actor_rank: int, seq: int):
+        actor_id = self.actor_table[actor_rank]
+        all_deps = self._all_deps.get((actor_id, seq), {})
+        ops = self._register_ops(slot)
+
+        if kind == KIND_INC:
+            for op in ops:
+                if op["counter"] and self._causally_covers(all_deps, op):
+                    entry = self.value_pool[-op["value"] - 1]
+                    new_entry = {"value": entry["value"] + value,
+                                 "datatype": "counter"}
+                    self.value_pool.append(new_entry)
+                    op["value"] = -len(self.value_pool)
+            self._store_register(slot, ops)
+            return
+
+        surviving = [op for op in ops if not self._causally_covers(all_deps, op)]
+        if kind == KIND_SET:
+            pooled = value
+            counter = False
+            if value < 0 and b is not None:
+                entry = b.value_pool[-value - 1]
+                self.value_pool.append(entry)
+                pooled = -len(self.value_pool)
+                counter = entry.get("datatype") == "counter"
+            surviving.append({"actor_rank": actor_rank, "seq": seq,
+                              "value": pooled, "counter": counter})
+        self._store_register(slot, surviving)
+
+    def _causally_covers(self, all_deps: dict, op: dict) -> bool:
+        if op["actor_rank"] < 0:
+            return True
+        return all_deps.get(self.actor_table[op["actor_rank"]], 0) >= op["seq"]
+
+    # ------------------------------------------------------------------
+    # materialization (device kernels)
+    # ------------------------------------------------------------------
+
+    use_condensed = True  # segment-condensed linearization (set False to force
+    # the element-wise kernel; parity tests exercise both)
+
+    def _positions(self) -> np.ndarray:
+        if self._pos_cache is None:
+            if self.n_elems == 0:
+                self._pos_cache = np.full(1, -1, np.int32)
+            elif self.use_condensed:
+                self._pos_cache = self._positions_condensed()
+            else:
+                self._pos_cache = self._positions_full()
+        return self._pos_cache
+
+    def _positions_full(self) -> np.ndarray:
+        import jax.numpy as jnp
+        from ..ops.linearize import pad_capacity, rga_linearize
+        n = self.n_elems + 1
+        cap = pad_capacity(n)
+
+        def padded(arr):
+            if len(arr) >= cap:
+                return arr[:cap]
+            out = np.zeros(cap, arr.dtype)
+            out[: len(arr)] = arr
+            return out
+
+        valid = np.zeros(cap, bool)
+        valid[:n] = True
+        pos = rga_linearize(jnp.asarray(padded(self.parent)),
+                            jnp.asarray(padded(self.ctr)),
+                            jnp.asarray(padded(self.actor)),
+                            jnp.asarray(valid))
+        return np.asarray(pos)[:n]
+
+    def _positions_condensed(self) -> np.ndarray:
+        """Chain-contracted linearization: host RLE + small device tree.
+
+        A chain edge i-1 -> i (element i inserted after slot i-1, and i is
+        slot i-1's maximal child) is contractible: the pair is always adjacent
+        in RGA order. Maximal chains are 'segments' — contiguous slot runs,
+        since batch ingestion appends runs in op order. The condensed tree
+        (one node per segment) goes through `rga_linearize_segments`; element
+        position = segment start + within-segment offset.
+        """
+        import jax.numpy as jnp
+        from ..ops.linearize import pad_capacity, rga_linearize_segments
+        n = self.n_elems + 1
+        parent = self.parent[:n]
+        ctr = self.ctr[:n]
+        actor = self.actor[:n]
+
+        # max child per slot: sort elements by (parent, (ctr, actor)) and take
+        # each group's last entry
+        packed = _pack(ctr[1:], actor[1:])
+        order = np.lexsort((packed, parent[1:]))
+        elems = np.arange(1, n, dtype=np.int32)
+        sorted_parents = parent[1:][order]
+        group_last = np.concatenate([sorted_parents[1:] != sorted_parents[:-1],
+                                     np.ones(1, bool)])
+        max_child = np.full(n, -1, np.int32)
+        max_child[sorted_parents[group_last]] = elems[order][group_last]
+
+        # contractible chain edges (never into the head)
+        chain = np.zeros(n, bool)
+        chain[1:] = (parent[1:] == elems - 1) & (elems - 1 != 0)
+        chain[1:] &= max_child[np.clip(elems - 1, 0, None)] == elems
+        seg_start = ~chain
+        seg_id = np.cumsum(seg_start) - 1          # head = segment 0
+        start_slots = np.nonzero(seg_start)[0]
+        n_segs = len(start_slots)
+        offset = np.arange(n) - start_slots[seg_id]
+        sizes = np.diff(np.append(start_slots, n)).astype(np.int32)
+        sizes[0] = 0  # the head segment contributes no elements
+
+        head_slots = start_slots.astype(np.int32)
+        seg_parent_slot = parent[head_slots]
+        seg_parent = seg_id[seg_parent_slot].astype(np.int32)
+        seg_attach = offset[seg_parent_slot].astype(np.int32)
+        seg_ctr = ctr[head_slots]
+        seg_actor = actor[head_slots]
+
+        cap = pad_capacity(n_segs)
+
+        def padded(arr, dtype):
+            out = np.zeros(cap, dtype)
+            out[:n_segs] = arr
+            return out
+
+        valid = np.zeros(cap, bool)
+        valid[:n_segs] = True
+        starts = rga_linearize_segments(
+            jnp.asarray(padded(seg_parent, np.int32)),
+            jnp.asarray(padded(seg_attach, np.int32)),
+            jnp.asarray(padded(seg_ctr, np.int32)),
+            jnp.asarray(padded(seg_actor, np.int32)),
+            jnp.asarray(padded(sizes, np.int32)),
+            jnp.asarray(valid))
+        starts = np.asarray(starts)[:n_segs]
+
+        pos = (starts[seg_id] + offset).astype(np.int32)
+        pos[0] = -1
+        return pos
+
+    def visible_order(self) -> np.ndarray:
+        """Slots of visible elements in list order."""
+        n = self.n_elems + 1
+        pos = self._positions()
+        if n <= 1:
+            return np.empty(0, np.int64)
+        # pos[1:] is a permutation of 0..n-2: invert it (counting sort)
+        inv = np.empty(n - 1, np.int64)
+        inv[pos[1:]] = np.arange(1, n)
+        return inv[self.has_value[inv]]
+
+    def text(self) -> str:
+        order = self.visible_order()
+        values = self.value[order]
+        if (values < 0).any():
+            # rich (non-single-char) values spliced in — rare path
+            return "".join(
+                chr(v) if v >= 0 else str(self.value_pool[-int(v) - 1]["value"])
+                for v in values)
+        if len(values) == 0:
+            return ""
+        if values.max(initial=0) < 128:
+            return values.astype(np.uint8).tobytes().decode("ascii")
+        return "".join(map(chr, values.astype(np.uint32)))
+
+    def values(self) -> list:
+        out = []
+        for slot in self.visible_order():
+            v = int(self.value[slot])
+            if v >= 0:
+                out.append(chr(v))
+            else:
+                out.append(self.value_pool[-v - 1]["value"])
+        return out
+
+    def elem_ids(self) -> list:
+        return [make_elem_id(self.actor_table[self.actor[s]], int(self.ctr[s]))
+                for s in self.visible_order()]
+
+    def conflicts_at(self, index: int):
+        slot = self.visible_order()[index]
+        extras = self.conflicts.get(int(slot))
+        if not extras:
+            return None
+        out = {}
+        for op in extras:
+            v = op["value"]
+            out[self.actor_table[op["actor_rank"]]] = (
+                chr(v) if v >= 0 else self.value_pool[-v - 1]["value"])
+        return out
+
+    def __len__(self) -> int:
+        return int(self.has_value[1: self.n_elems + 1].sum())

@@ -214,9 +214,12 @@ def change(doc, options=None, callback=None):
     callback(root_object_proxy(context))
 
     if not context.updated:
+        context.closed = True
         return doc, None
     update_parent_objects(doc._cache, context.updated, context.inbound)
-    return _make_change(doc, "change", context, options)
+    result = _make_change(doc, "change", context, options)
+    context.closed = True
+    return result
 
 
 def empty_change(doc, options=None):

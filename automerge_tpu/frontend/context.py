@@ -40,11 +40,21 @@ class Context:
         self.inbound: dict = dict(doc._inbound)
         self.ops: list = []
         self.diffs: list = []
+        self.closed = False  # set when the change block ends; later mutations
+        # through captured handles must raise, not silently vanish
+
+    def _check_open(self):
+        if self.closed:
+            raise TypeError(
+                "This object belongs to a change block that has finished; "
+                "objects cannot be modified outside of a change block")
 
     def add_op(self, operation: dict):
+        self._check_open()
         self.ops.append(operation)
 
     def apply(self, diff: dict):
+        self._check_open()
         self.diffs.append(diff)
         apply_diffs([diff], self.cache, self.updated, self.inbound)
 
@@ -72,9 +82,11 @@ class Context:
 
     def instantiate_proxy(self, object_id: str):
         """Proxy (or writeable view) for a document object inside the block."""
-        from .proxies import ListProxy, MapProxy
+        from .proxies import ListProxy, MapProxy, TextProxy
         obj = self.get_object(object_id)
-        if isinstance(obj, (Text, Table)):
+        if isinstance(obj, Text):
+            return TextProxy(self, object_id)
+        if isinstance(obj, Table):
             return obj.get_writeable(self)
         if isinstance(obj, ListDoc):
             return ListProxy(self, object_id)
@@ -258,5 +270,5 @@ class Context:
 
 
 def _is_proxy(value) -> bool:
-    from .proxies import ListProxy, MapProxy
-    return isinstance(value, (MapProxy, ListProxy))
+    from .proxies import ListProxy, MapProxy, TextProxy
+    return isinstance(value, (MapProxy, ListProxy, TextProxy))

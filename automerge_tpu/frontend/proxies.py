@@ -218,11 +218,69 @@ class ListProxy:
         return [_plain(v) for v in self]
 
 
+class TextProxy:
+    """Live view of a Text object inside a change block: reads always come
+    from the context's current overlay, so captured references never go stale."""
+
+    __slots__ = ("_context", "_object_id")
+
+    def __init__(self, context, object_id):
+        object.__setattr__(self, "_context", context)
+        object.__setattr__(self, "_object_id", object_id)
+
+    def _target(self):
+        return self._context.get_object(self._object_id)
+
+    def __len__(self):
+        return len(self._target())
+
+    def __getitem__(self, index):
+        return self._target()[index]
+
+    def get(self, index):
+        return self._target().get(index)
+
+    def get_elem_id(self, index):
+        return self._target().get_elem_id(index)
+
+    def __iter__(self):
+        return iter(self._target())
+
+    def __str__(self):
+        return str(self._target())
+
+    def __eq__(self, other):
+        return self._target() == other
+
+    def __repr__(self):
+        return f"TextProxy({str(self._target())!r})"
+
+    def to_spans(self):
+        return self._target().to_spans()
+
+    def to_json(self):
+        return str(self._target())
+
+    def set(self, index, value):
+        self._context.set_list_index(self._object_id, index, value)
+        return self
+
+    def insert_at(self, index, *values):
+        self._context.splice(self._object_id, index, 0, list(values))
+        return self
+
+    def delete_at(self, index, num_delete=1):
+        self._context.splice(self._object_id, index, num_delete, [])
+        return self
+
+
 def _plain(value):
     if isinstance(value, MapProxy):
         return value.to_dict()
     if isinstance(value, ListProxy):
         return value.to_list()
+    if isinstance(value, TextProxy):
+        return value._target()
     return value
 
 

@@ -395,7 +395,6 @@ class Table:
         instance._object_id = self._object_id
         instance._conflicts = self._conflicts
         instance._frozen = False
-        instance.entries = self.entries
         instance.context = context
         return instance
 
@@ -404,7 +403,12 @@ class Table:
 
 
 class WriteableTable(Table):
-    """Table view inside a change block (frontend/table.js:210-240)."""
+    """Table view inside a change block: reads come from the context's current
+    overlay, so captured references never go stale."""
+
+    @property
+    def entries(self) -> dict:
+        return self.context.get_object(self._object_id).entries
 
     def by_id(self, row_id: str):
         entry = self.entries.get(row_id)

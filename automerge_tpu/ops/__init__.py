@@ -1,0 +1,2 @@
+from .linearize import rga_linearize  # noqa: F401
+from .scan import segment_starts, visible_index  # noqa: F401

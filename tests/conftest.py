@@ -1,8 +1,34 @@
 import os
+import sys
 
-# Force a deterministic 8-device virtual CPU mesh for sharding tests; must be
-# set before jax is imported anywhere in the test process.
+# The test suite targets a deterministic 8-device virtual CPU mesh: the
+# sharding tests need multiple devices, and unit tests must not depend on
+# TPU-tunnel health or remote-compile latency. The axon TPU plugin registers
+# itself from sitecustomize at interpreter start and, once registered, jax
+# initializes it regardless of JAX_PLATFORMS — so when it is present, the
+# whole pytest process re-execs with the plugin disabled (restoring pytest's
+# captured fds first). Set AUTOMERGE_TPU_TESTS_ON_TPU=1 to run on the real
+# chip instead.
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def pytest_configure(config):
+    if (os.environ.get("PALLAS_AXON_POOL_IPS")
+            and os.environ.get("AUTOMERGE_TPU_TESTS_ON_TPU") != "1"):
+        capman = config.pluginmanager.getplugin("capturemanager")
+        if capman is not None:
+            capman.stop_global_capturing()
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+        env["XLA_FLAGS"] = flags
+        os.execve(sys.executable,
+                  [sys.executable, "-m", "pytest", *config.invocation_params.args],
+                  env)

@@ -1,0 +1,206 @@
+"""Device engine vs oracle: bit-exact parity on text documents.
+
+The correctness bar from BASELINE.md: the columnar engine must produce exactly
+the oracle backend's materialization — same visible values, same element ids,
+same conflicts — for any causally-valid change history.
+"""
+
+import random
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import Text
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.engine import DeviceTextDoc
+
+
+def text_changes_of(doc, key="t"):
+    """Extract all changes and the text object id from a facade doc."""
+    changes = am.get_all_changes(doc)
+    obj_id = doc[key]._object_id
+    # keep only ops touching the text object (drop the makeText/link ops)
+    out = []
+    for ch in changes:
+        ops = [op for op in ch["ops"]
+               if op.get("obj") == obj_id and not op["action"].startswith("make")]
+        out.append({**ch, "ops": ops})
+    return out, obj_id
+
+
+def oracle_view(doc, key="t"):
+    text = doc[key]
+    values = [e["value"] for e in text.elems]
+    elem_ids = [e["elemId"] for e in text.elems]
+    conflicts = [e.get("conflicts") for e in text.elems]
+    return values, elem_ids, conflicts
+
+
+def engine_view(doc, key="t"):
+    changes, obj_id = text_changes_of(doc, key)
+    eng = DeviceTextDoc(obj_id)
+    eng.apply_changes(changes)
+    n = len(eng)
+    confs = [eng.conflicts_at(i) for i in range(n)]
+    return eng.values(), eng.elem_ids(), confs, eng
+
+
+def assert_parity(doc, key="t"):
+    o_vals, o_ids, o_confs = oracle_view(doc, key)
+    e_vals, e_ids, e_confs, _ = engine_view(doc, key)
+    assert e_vals == o_vals
+    assert e_ids == o_ids
+    for oc, ec in zip(o_confs, e_confs):
+        # oracle text conflicts are raw diff lists [{actor, value, ...}]
+        oc_cmp = {c["actor"]: c["value"] for c in (oc or [])}
+        assert (ec or {}) == oc_cmp
+
+
+def test_simple_typing():
+    doc = am.change(am.init("actor-1"), lambda d: d.__setitem__("t", Text("hello")))
+    doc = am.change(doc, lambda d: d["t"].insert_at(5, " ", "w", "o"))
+    assert_parity(doc)
+
+
+def test_deletes():
+    doc = am.change(am.init("actor-1"), lambda d: d.__setitem__("t", Text("abcdef")))
+    doc = am.change(doc, lambda d: d["t"].delete_at(1, 3))
+    assert_parity(doc)
+
+
+def test_set_overwrite():
+    doc = am.change(am.init("actor-1"), lambda d: d.__setitem__("t", Text("cat")))
+    doc = am.change(doc, lambda d: d["t"].set(1, "u"))
+    assert_parity(doc)
+
+
+def test_concurrent_same_position_conflict():
+    base = am.change(am.init("aa"), lambda d: d.__setitem__("t", Text("xy")))
+    other = am.merge(am.init("bb"), base)
+    a = am.change(base, lambda d: d["t"].set(0, "A"))
+    b = am.change(other, lambda d: d["t"].set(0, "B"))
+    merged = am.merge(a, b)
+    assert_parity(merged)
+    # explicit conflict check
+    _, _, confs, eng = engine_view(merged)
+    assert confs[0] is not None
+
+
+def test_concurrent_insert_and_delete():
+    base = am.change(am.init("aa"), lambda d: d.__setitem__("t", Text("abc")))
+    other = am.merge(am.init("bb"), base)
+    a = am.change(base, lambda d: d["t"].delete_at(1))
+    b = am.change(other, lambda d: d["t"].insert_at(2, "Z"))
+    assert_parity(am.merge(a, b))
+    assert_parity(am.merge(b, a))
+
+
+def test_concurrent_set_vs_delete_add_wins():
+    base = am.change(am.init("aa"), lambda d: d.__setitem__("t", Text("abc")))
+    other = am.merge(am.init("bb"), base)
+    a = am.change(base, lambda d: d["t"].delete_at(1))
+    b = am.change(other, lambda d: d["t"].set(1, "X"))
+    m = am.merge(a, b)
+    assert_parity(m)
+    assert str(m["t"]) == "aXc"
+
+
+def test_out_of_order_delivery_queues():
+    doc = am.change(am.init("actor-1"), lambda d: d.__setitem__("t", Text("ab")))
+    doc2 = am.change(doc, lambda d: d["t"].insert_at(2, "c"))
+    doc3 = am.change(doc2, lambda d: d["t"].insert_at(3, "d"))
+    changes, obj_id = text_changes_of(doc3)
+    eng = DeviceTextDoc(obj_id)
+    eng.apply_changes([changes[2]])         # seq 3 first: queued
+    assert eng.text() == ""
+    eng.apply_changes([changes[0], changes[1]])
+    assert eng.text() == "abcd"
+    assert eng.queue == []
+
+
+def test_duplicate_changes_idempotent():
+    doc = am.change(am.init("actor-1"), lambda d: d.__setitem__("t", Text("hi")))
+    changes, obj_id = text_changes_of(doc)
+    eng = DeviceTextDoc(obj_id)
+    eng.apply_changes(changes)
+    eng.apply_changes(changes)  # again
+    assert eng.text() == "hi"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_histories_parity(seed):
+    rng = random.Random(7000 + seed)
+    n_actors = rng.randint(2, 4)
+    base = am.change(am.init("base"), lambda d: d.__setitem__("t", Text("seed")))
+    base_changes = am.get_all_changes(base)
+    docs = [am.apply_changes(am.init(f"actor-{i}"), base_changes)
+            for i in range(n_actors)]
+
+    for _ in range(5):
+        for i in range(n_actors):
+            def edit(d, i=i):
+                t = d["t"]
+                for _ in range(rng.randrange(1, 4)):
+                    r = rng.random()
+                    if r < 0.5 or len(t) == 0:
+                        t.insert_at(rng.randint(0, len(t)), rng.choice("abcxyz"))
+                    elif r < 0.75:
+                        t.delete_at(rng.randrange(len(t)))
+                    else:
+                        t.set(rng.randrange(len(t)), rng.choice("ABC"))
+            if rng.random() < 0.85:
+                docs[i] = am.change(docs[i], edit)
+        i, j = rng.sample(range(n_actors), 2)
+        docs[i] = am.merge(docs[i], docs[j])
+
+    merged = docs[0]
+    for d in docs[1:]:
+        merged = am.merge(merged, d)
+    assert_parity(merged)
+
+
+def test_counter_in_list():
+    doc = am.change(am.init("actor-1"),
+                    lambda d: d.__setitem__("t", [am.Counter(5)]))
+    doc = am.change(doc, lambda d: d["t"][0].increment(3))
+    changes, obj_id = text_changes_of(doc, "t")
+    eng = DeviceTextDoc(obj_id)
+    eng.apply_changes(changes)
+    assert eng.values() == [8]
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_condensed_equals_full_kernel(seed):
+    """The chain-condensed linearization must agree with the element-wise
+    kernel (and therefore the oracle) on arbitrary histories."""
+    rng = random.Random(4200 + seed)
+    base = am.change(am.init("base"), lambda d: d.__setitem__("t", Text("xy")))
+    docs = [am.apply_changes(am.init(f"a{i}"), am.get_all_changes(base))
+            for i in range(3)]
+    for _ in range(4):
+        for i in range(3):
+            def edit(d):
+                t = d["t"]
+                for _ in range(rng.randrange(1, 4)):
+                    r = rng.random()
+                    if r < 0.6 or len(t) == 0:
+                        t.insert_at(rng.randint(0, len(t)), rng.choice("abc"))
+                    elif r < 0.8:
+                        t.delete_at(rng.randrange(len(t)))
+                    else:
+                        t.set(rng.randrange(len(t)), "X")
+            docs[i] = am.change(docs[i], edit)
+        i, j = rng.sample(range(3), 2)
+        docs[i] = am.merge(docs[i], docs[j])
+    merged = docs[0]
+    for d in docs[1:]:
+        merged = am.merge(merged, d)
+    changes, obj_id = text_changes_of(merged)
+    e1 = DeviceTextDoc(obj_id)
+    e1.use_condensed = True
+    e1.apply_changes(changes)
+    e2 = DeviceTextDoc(obj_id)
+    e2.use_condensed = False
+    e2.apply_changes(changes)
+    assert e1.text() == e2.text() == str(merged["t"])
+    assert e1.elem_ids() == e2.elem_ids()

@@ -1,0 +1,150 @@
+"""Device RGA linearization vs the oracle's tree walk.
+
+Property test: build random concurrent-insert histories through the oracle
+backend, extract the element table, and check that `rga_linearize` produces
+exactly the oracle's RGA order (including tombstones).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import frontend as Frontend
+
+
+def oracle_order(doc, list_key):
+    """All elemIds of doc[list_key] in oracle RGA order (tombstones included)."""
+    state = Frontend.get_backend_state(doc)
+    index = state.read_index()
+    obj_id = doc[list_key]._object_id
+    order = []
+    elem = "_head"
+    while True:
+        elem = index.get_next(obj_id, elem)
+        if elem is None:
+            return order, index, obj_id
+        order.append(elem)
+
+
+def element_table(index, obj_id, pad_to=None):
+    """Extract (parent, ctr, actor_rank, valid, elem_ids) arrays, head at 0."""
+    from automerge_tpu._common import parse_elem_id
+    rec = index.by_object[obj_id]
+    elem_ids = list(rec.insertion.keys())
+    actors = sorted({parse_elem_id(e)[0] for e in elem_ids})
+    actor_rank = {a: i for i, a in enumerate(actors)}
+    slot = {e: i + 1 for i, e in enumerate(elem_ids)}
+    n = 1 + len(elem_ids)
+    cap = pad_to or n
+    parent = np.zeros(cap, dtype=np.int32)
+    ctr = np.zeros(cap, dtype=np.int32)
+    actor = np.zeros(cap, dtype=np.int32)
+    valid = np.zeros(cap, dtype=bool)
+    valid[0] = True
+    for e, i in slot.items():
+        op = rec.insertion[e]
+        a, c = parse_elem_id(e)
+        parent[i] = 0 if op["key"] == "_head" else slot[op["key"]]
+        ctr[i] = c
+        actor[i] = actor_rank[a]
+        valid[i] = True
+    return parent, ctr, actor, valid, elem_ids
+
+
+def device_order(index, obj_id, pad_to=None):
+    from automerge_tpu.ops import rga_linearize
+    from automerge_tpu.ops.linearize import pad_capacity
+    import jax.numpy as jnp
+    if pad_to is None:
+        pad_to = pad_capacity(1 + len(index.by_object[obj_id].insertion))
+    parent, ctr, actor, valid, elem_ids = element_table(index, obj_id, pad_to)
+    pos = np.asarray(rga_linearize(jnp.asarray(parent), jnp.asarray(ctr),
+                                   jnp.asarray(actor), jnp.asarray(valid)))
+    n_live = len(elem_ids)
+    order = [None] * n_live
+    for i, e in enumerate(elem_ids):
+        p = pos[i + 1]
+        assert 0 <= p < n_live, f"element {e} got position {p}"
+        order[p] = e
+    return order
+
+
+def random_history(seed, n_actors=3, n_rounds=5, edits_per_round=4):
+    rng = random.Random(seed)
+    base = am.change(am.init("base"), lambda d: d.__setitem__("xs", ["s0", "s1"]))
+    base_changes = am.get_all_changes(base)
+    docs = [am.apply_changes(am.init(f"actor-{i}"), base_changes)
+            for i in range(n_actors)]
+    for _ in range(n_rounds):
+        for i, doc in enumerate(docs):
+            def edit(d):
+                for _ in range(rng.randrange(1, edits_per_round + 1)):
+                    xs = d["xs"]
+                    if len(xs) and rng.random() < 0.25:
+                        xs.delete_at(rng.randrange(len(xs)))
+                    else:
+                        xs.insert(rng.randint(0, len(xs)), f"a{i}-{rng.randrange(1000)}")
+            docs[i] = am.change(doc, edit)
+        i, j = rng.sample(range(n_actors), 2)
+        docs[i] = am.merge(docs[i], docs[j])
+    merged = docs[0]
+    for d in docs[1:]:
+        merged = am.merge(merged, d)
+    return merged
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_linearize_matches_oracle(seed):
+    doc = random_history(seed)
+    expected, index, obj_id = oracle_order(doc, "xs")
+    got = device_order(index, obj_id)
+    assert got == expected
+
+
+def test_linearize_with_padding():
+    doc = random_history(99)
+    expected, index, obj_id = oracle_order(doc, "xs")
+    got = device_order(index, obj_id, pad_to=128)
+    assert got == expected
+
+
+def test_linearize_sequential_typing_chain():
+    # worst case for tree depth: each insert's parent is the previous element
+    doc = am.init("typist")
+    doc = am.change(doc, lambda d: d.__setitem__("xs", []))
+    for i in range(40):
+        doc = am.change(doc, lambda d, i=i: d["xs"].append(i))
+    expected, index, obj_id = oracle_order(doc, "xs")
+    got = device_order(index, obj_id)
+    assert got == expected
+
+
+def test_linearize_empty_list():
+    import jax.numpy as jnp
+    from automerge_tpu.ops import rga_linearize
+    pos = rga_linearize(jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.int32),
+                        jnp.zeros(4, jnp.int32),
+                        jnp.array([True, False, False, False]))
+    assert int(pos[0]) == -1
+
+
+def test_visible_index_matches_numpy():
+    import jax.numpy as jnp
+    from automerge_tpu.ops import visible_index
+    rng = np.random.default_rng(3)
+    n = 64
+    pos = rng.permutation(n).astype(np.int32)
+    visible = rng.random(n) < 0.6
+    vis_rank, n_visible = visible_index(jnp.asarray(pos), jnp.asarray(visible))
+    # shadow model: rank among visible elements ordered by position
+    order = np.argsort(pos)
+    expected = np.zeros(n, np.int32)
+    r = 0
+    for i in order:
+        expected[i] = r
+        if visible[i]:
+            r += 1
+    assert int(n_visible) == int(visible.sum())
+    assert np.array_equal(np.asarray(vis_rank)[visible], expected[visible])
