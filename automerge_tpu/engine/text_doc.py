@@ -62,36 +62,38 @@ class DeviceTextDoc:
         self.win_counter = np.zeros(cap, bool)       # winner has datatype counter
         self.conflicts: dict = {}             # slot -> list of extra surviving ops
         self.value_pool: list = []            # rich values (non-single-char)
-        # elem key -> slot index, as parallel sorted arrays (vectorized lookup)
-        self._keys_sorted = np.empty(0, np.int64)
-        self._slots_sorted = np.empty(0, np.int32)
+        # elem key -> slot index, as a small list of sorted runs (keys are
+        # unique across runs; a batch appends one run, consolidated lazily)
+        self._key_runs: list = []             # [(keys_sorted, slots_sorted)]
         self._pos_cache: Optional[np.ndarray] = None
 
     # -- packed-key index ------------------------------------------------
 
     def _lookup(self, keys: np.ndarray) -> np.ndarray:
         """Vectorized elem-key -> slot lookup (-1 where missing)."""
-        if len(self._keys_sorted) == 0:
-            return np.full(len(keys), -1, np.int32)
-        i = np.clip(np.searchsorted(self._keys_sorted, keys), 0,
-                    len(self._keys_sorted) - 1)
-        return np.where(self._keys_sorted[i] == keys,
-                        self._slots_sorted[i], -1).astype(np.int32)
+        out = np.full(len(keys), -1, np.int32)
+        for run_keys, run_slots in self._key_runs:
+            if len(run_keys) == 0:
+                continue
+            i = np.minimum(np.searchsorted(run_keys, keys), len(run_keys) - 1)
+            hit = run_keys[i] == keys
+            out = np.where(hit, run_slots[i], out)
+        return out
 
-    def _index_add(self, keys: np.ndarray, slots: np.ndarray):
-        all_keys = np.concatenate([self._keys_sorted, keys])
-        all_slots = np.concatenate([self._slots_sorted, slots.astype(np.int32)])
-        order = np.argsort(all_keys, kind="stable")
-        self._keys_sorted = all_keys[order]
-        self._slots_sorted = all_slots[order]
+    def _index_add_sorted(self, keys_sorted: np.ndarray, slots_sorted: np.ndarray):
+        self._key_runs.append((keys_sorted, slots_sorted.astype(np.int32)))
+        if len(self._key_runs) > 4:  # amortized consolidation
+            all_keys = np.concatenate([r[0] for r in self._key_runs])
+            all_slots = np.concatenate([r[1] for r in self._key_runs])
+            order = np.argsort(all_keys, kind="stable")
+            self._key_runs = [(all_keys[order], all_slots[order])]
 
     def _index_rebuild(self):
         n = self.n_elems
         keys = _pack(self.actor[1:n + 1], self.ctr[1:n + 1])
         slots = np.arange(1, n + 1, dtype=np.int32)
         order = np.argsort(keys, kind="stable")
-        self._keys_sorted = keys[order]
-        self._slots_sorted = slots[order]
+        self._key_runs = [(keys[order], slots[order])]
 
     # ------------------------------------------------------------------
     # actor interning (order-preserving: rank order == lexicographic order)
@@ -230,9 +232,10 @@ class DeviceTextDoc:
             change_actor = row_rank[op_row]
             change_seq = b.seqs[op_row]
 
-            self._apply_inserts(b, kind, target_a, target_c, parent_a_raw,
-                                parent_a, parent_c)
-            self._apply_assigns(b, kind, target_a, target_c, value,
+            target_keys = _pack(target_a, target_c)  # packed once, shared
+            self._apply_inserts(b, kind, target_keys, target_a, target_c,
+                                parent_a_raw, parent_a, parent_c)
+            self._apply_assigns(b, kind, target_keys, value,
                                 change_actor, change_seq, op_row)
 
     def _grow(self, needed: int):
@@ -253,18 +256,24 @@ class DeviceTextDoc:
             grown[: len(arr)] = arr
             setattr(self, name, grown)
 
-    def _apply_inserts(self, b, kind, target_a, target_c, parent_a_raw,
-                       parent_a, parent_c):
+    def _apply_inserts(self, b, kind, target_keys, target_a, target_c,
+                       parent_a_raw, parent_a, parent_c):
         ins = kind == KIND_INS
         n_new = int(ins.sum())
         if not n_new:
             return
-        new_keys = _pack(target_a[ins], target_c[ins])
-        existing = self._lookup(new_keys)
-        uniq, counts = np.unique(new_keys, return_counts=True)
-        if (existing >= 0).any() or (counts > 1).any():
-            dup = int(new_keys[existing >= 0][0]) if (existing >= 0).any() \
-                else int(uniq[counts > 1][0])
+        new_keys = target_keys[ins]
+        new_slots = np.arange(self.n_elems + 1, self.n_elems + 1 + n_new,
+                              dtype=np.int32)
+        order = np.argsort(new_keys, kind="stable")
+        keys_sorted = new_keys[order]
+        in_batch_dup = (keys_sorted[1:] == keys_sorted[:-1]).any() if n_new > 1 else False
+        existing = self._lookup(keys_sorted)
+        if in_batch_dup or (existing >= 0).any():
+            if in_batch_dup:
+                dup = int(keys_sorted[:-1][keys_sorted[1:] == keys_sorted[:-1]][0])
+            else:
+                dup = int(keys_sorted[existing >= 0][0])
             raise ValueError(
                 "Duplicate list element ID "
                 f"{make_elem_id(self.actor_table[dup >> 32], dup & 0xFFFFFFFF)}")
@@ -274,7 +283,7 @@ class DeviceTextDoc:
         sl = slice(start, start + n_new)
         self.actor[sl] = target_a[ins]
         self.ctr[sl] = target_c[ins]
-        self._index_add(new_keys, np.arange(start, start + n_new, dtype=np.int32))
+        self._index_add_sorted(keys_sorted, new_slots[order])
         self.n_elems += n_new
 
         # resolve parent slots: head, existing element, or new element in batch
@@ -291,13 +300,13 @@ class DeviceTextDoc:
         self.win_actor[sl] = -1
         self.has_value[sl] = False
 
-    def _apply_assigns(self, b, kind, target_a, target_c, value,
+    def _apply_assigns(self, b, kind, target_keys, value,
                        change_actor, change_seq, op_row):
         """set/del/inc ops with register semantics, vectorized fast path."""
-        assign = (kind == KIND_SET) | (kind == KIND_DEL) | (kind == KIND_INC)
+        assign = kind != KIND_INS
         if not assign.any():
             return
-        keys = _pack(target_a[assign], target_c[assign])
+        keys = target_keys[assign]
         slots = self._lookup(keys)
         if (slots < 0).any():
             bad = int(keys[slots < 0][0])
@@ -313,8 +322,8 @@ class DeviceTextDoc:
 
         # fast path: single 'set' on an element with no existing register and
         # no other op in this round (the overwhelmingly common insert+set)
-        unique, counts = np.unique(slots, return_counts=True)
-        single = np.isin(slots, unique[counts == 1])
+        counts = np.bincount(slots, minlength=self.n_elems + 1)
+        single = counts[slots] == 1
         fast = single & (a_kind == KIND_SET) & ~self.has_value[slots] \
             & (self.win_actor[slots] < 0)
         if self.conflicts:

@@ -1,0 +1,1 @@
+from .mesh import batched_merge_step, make_mesh, sharded_merge_step  # noqa: F401

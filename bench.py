@@ -1,0 +1,119 @@
+"""Headline benchmark: ops/sec merged into a large Text document.
+
+BASELINE.json north star: merge 10k concurrent 1k-op changes into a 1M-op
+Text CRDT in <100 ms on one TPU v5e chip (= 100M ops/sec), bit-exact with the
+reference semantics. The reference publishes no numbers (BASELINE.md), so
+vs_baseline is measured against that target rate.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Persistent XLA compilation cache: the first driver run pays the (slow on
+# TPU) compile; subsequent runs in fresh processes reuse it.
+os.makedirs(os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"),
+            exist_ok=True)
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from automerge_tpu.engine import DeviceTextDoc, TextChangeBatch  # noqa: E402
+from automerge_tpu.engine.columnar import HEAD_PARENT, KIND_INS, KIND_SET
+
+BASE_LEN = 1_000_000     # existing document: 1M characters
+N_ACTORS = 10_000        # concurrent changes to merge
+OPS_PER_CHANGE = 1_000   # ops per change (ins+set pairs -> 500 chars each)
+TARGET_OPS_PER_SEC = (N_ACTORS * OPS_PER_CHANGE) / 0.1  # north star: <100 ms
+
+
+def base_batch(obj_id: str, n: int) -> TextChangeBatch:
+    """One bulk change typing an n-char document (a single run)."""
+    ta = np.zeros(2 * n, np.int32)
+    tc = np.zeros(2 * n, np.int32)
+    pa = np.full(2 * n, HEAD_PARENT, np.int32)
+    pc = np.zeros(2 * n, np.int32)
+    val = np.zeros(2 * n, np.int64)
+    kind = np.tile(np.array([KIND_INS, KIND_SET], np.int8), n)
+    ctrs = np.arange(1, n + 1, dtype=np.int32)
+    tc[0::2] = ctrs
+    tc[1::2] = ctrs
+    pa[2::2] = 0
+    pc[2::2] = ctrs[:-1]
+    val[1::2] = 97 + (ctrs % 26)
+    return TextChangeBatch(
+        obj_id=obj_id, actors=["base"], seqs=np.array([1], np.int32),
+        deps=[{}], messages=[None],
+        op_change=np.zeros(2 * n, np.int32), op_kind=kind,
+        op_target_actor=ta, op_target_ctr=tc,
+        op_parent_actor=pa, op_parent_ctr=pc, op_value=val,
+        actor_table=["base"], value_pool=[])
+
+
+def merge_batch(obj_id: str, n_actors: int, ops_per_change: int,
+                base_n: int, seed: int = 0) -> TextChangeBatch:
+    """n_actors concurrent changes, each a typing run of ops_per_change ops
+    starting at a Zipfian-hot position in the base document."""
+    rng = np.random.default_rng(seed)
+    run = ops_per_change // 2            # ins+set pairs
+    n_ops = n_actors * run * 2
+    actors = [f"actor-{i:06d}" for i in range(n_actors)]
+    op_change = np.repeat(np.arange(n_actors, dtype=np.int32), run * 2)
+    kind = np.tile(np.array([KIND_INS, KIND_SET], np.int8), n_actors * run)
+    ta = np.repeat(np.arange(n_actors, dtype=np.int32), run * 2)
+    tc = np.zeros(n_ops, np.int32)
+    pa = np.zeros(n_ops, np.int32)
+    pc = np.zeros(n_ops, np.int32)
+    val = np.zeros(n_ops, np.int64)
+    ctrs = np.arange(1, run + 1, dtype=np.int32) + base_n + 1
+    targets = rng.zipf(1.2, n_actors).clip(1, base_n)  # hot-region targets
+    for a in range(n_actors):
+        s = a * run * 2
+        tc[s: s + 2 * run: 2] = ctrs
+        tc[s + 1: s + 2 * run: 2] = ctrs
+        pa[s] = n_actors                  # 'base' in the actor table
+        pc[s] = int(targets[a])
+        pa[s + 2: s + 2 * run: 2] = a
+        pc[s + 2: s + 2 * run: 2] = ctrs[:-1]
+        val[s + 1: s + 2 * run: 2] = 97 + (a % 26)
+    return TextChangeBatch(
+        obj_id=obj_id, actors=actors, seqs=np.ones(n_actors, np.int32),
+        deps=[{"base": 1}] * n_actors, messages=[None] * n_actors,
+        op_change=op_change, op_kind=kind, op_target_actor=ta,
+        op_target_ctr=tc, op_parent_actor=pa, op_parent_ctr=pc,
+        op_value=val, actor_table=actors + ["base"], value_pool=[])
+
+
+def main():
+    doc = DeviceTextDoc("bench-text")
+    doc.apply_batch(base_batch("bench-text", BASE_LEN))
+    doc.text()  # warm: first linearize pays jit compile
+
+    batch = merge_batch("bench-text", N_ACTORS, OPS_PER_CHANGE, BASE_LEN)
+    n_ops = batch.n_ops
+
+    t0 = time.perf_counter()
+    doc.apply_batch(batch)
+    text = doc.text()
+    elapsed = time.perf_counter() - t0
+
+    assert len(text) == BASE_LEN + N_ACTORS * (OPS_PER_CHANGE // 2)
+    ops_per_sec = n_ops / elapsed
+
+    print(json.dumps({
+        "metric": "ops_per_sec_merged_text_10k_actors_1M_doc",
+        "value": round(ops_per_sec),
+        "unit": "ops/s",
+        "vs_baseline": round(ops_per_sec / TARGET_OPS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
