@@ -14,6 +14,11 @@ import re
 # The root object of every document (src/common.js:1).
 ROOT_ID = "00000000-0000-0000-0000-000000000000"
 
+# Columnar op kinds shared by the engine's batch encoding and the device
+# ingest kernels (ops/ingest.py). Values are part of the columnar format.
+KIND_INS, KIND_SET, KIND_DEL, KIND_INC = 0, 1, 2, 3
+HEAD_PARENT = -1  # parent-actor encoding for the virtual list head ('_head')
+
 # elemId = "<actorId>:<counter>" — counter is a Lamport timestamp unique per list.
 _ELEM_ID_RE = re.compile(r"^(.*):(\d+)$")
 

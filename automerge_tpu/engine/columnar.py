@@ -16,10 +16,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from .._common import parse_elem_id
-
-KIND_INS, KIND_SET, KIND_DEL, KIND_INC = 0, 1, 2, 3
-HEAD_PARENT = -1  # parent actor idx encoding for '_head'
+from .._common import (HEAD_PARENT, KIND_DEL, KIND_INC, KIND_INS,  # noqa: F401
+                       KIND_SET, parse_elem_id)
 
 
 @dataclass

@@ -1,11 +1,13 @@
 """Device-resident text/list CRDT document.
 
 This is the TPU-native replacement for the reference's per-op reconciliation
-of sequences (`backend/op_set.js` applyInsert/applyAssign + skip list): the
-document lives as a padded columnar element table; whole *batches* of changes
-merge in one step. Causal admission and register (LWW) resolution run
-vectorized on the host over numpy columns; RGA ordering and visible-index
-compaction run on device (`ops/linearize.py`, `ops/scan.py`).
+of sequences (`backend/op_set.js` applyInsert/applyAssign + skip list,
+/root/reference/backend/op_set.js:63-283, /root/reference/backend/
+skip_list.js): the document lives as padded columnar element tables in device
+memory; whole *batches* of changes merge in single jitted programs
+(`ops/ingest.py`), and materialization (RGA order + visible compaction) is a
+second device program — the host only orchestrates causal admission and the
+rare slow register cases.
 
 Semantics match the oracle exactly (see tests/test_engine_parity.py):
 - causal readiness gating with queueing of unready changes, idempotent dups
@@ -14,6 +16,14 @@ Semantics match the oracle exactly (see tests/test_engine_parity.py):
   survivors are conflicts
 - counter `inc` folds into causally-visible counter set ops
 - RGA concurrent-insert ordering (descending Lamport at each insertion point)
+
+Division of labor per causally-ready round:
+- device (`ingest_round`): insert placement, elemId index merge, reference
+  resolution, LWW fast path, segment census — O(ops) scatters/gathers plus
+  one O(ops log ops) sort, at HBM bandwidth
+- host: vector clocks, transitive deps, actor interning, and the slow-mask
+  register residue (dels, counter incs, genuine concurrent conflicts) against
+  the host-held conflict/value-pool state
 """
 
 from __future__ import annotations
@@ -22,26 +32,28 @@ from typing import Optional
 
 import numpy as np
 
-from .._common import make_elem_id
-from .columnar import (HEAD_PARENT, KIND_DEL, KIND_INC, KIND_INS, KIND_SET,
-                       TextChangeBatch)
-
-_GROW = 1.5
+from .._common import KIND_DEL, KIND_INC, KIND_INS, KIND_SET, make_elem_id
+from .columnar import TextChangeBatch
 
 
-def _pack(actor_idx: np.ndarray, ctr: np.ndarray) -> np.ndarray:
+def _pack_np(actor_idx: np.ndarray, ctr: np.ndarray) -> np.ndarray:
     """Pack (actor rank, counter) element ids into sortable int64 keys."""
     return (actor_idx.astype(np.int64) << 32) | ctr.astype(np.int64)
 
 
 class DeviceTextDoc:
-    """One text/list object, columnar, merged in batches.
+    """One text/list object, columnar, merged in batches on device.
 
-    Element table layout (host numpy, mirrored to device for kernels):
-    slot 0 is the virtual head; live elements occupy 1..n_elems.
+    Element table layout: slot 0 is the virtual head; live elements occupy
+    1..n_elems in insertion order. All tables live in device memory; host
+    numpy mirrors are fetched lazily for accessors and the slow path.
     """
 
+    use_condensed = True  # chain-condensed linearization (set False to force
+    # the element-wise kernel; parity tests exercise both)
+
     def __init__(self, obj_id: str = "text", capacity: int = 1024):
+        from ..ops.ingest import bucket
         self.obj_id = obj_id
         self.actor_table: list = []           # rank -> actor id (lex-ordered)
         self._actor_rank: dict = {}
@@ -49,51 +61,50 @@ class DeviceTextDoc:
         self._all_deps: dict = {}             # (actor, seq) -> allDeps dict
         self.queue: list = []                 # (batch, row) not causally ready
         self.n_elems = 0                      # live element count (excl. head)
-
-        cap = max(capacity, 16)
-        self.parent = np.zeros(cap, np.int32)     # element slot of parent (0=head)
-        self.ctr = np.zeros(cap, np.int32)
-        self.actor = np.zeros(cap, np.int32)      # actor rank of inserting actor
-        # register state: up to one winner inline; extra survivors in overflow
-        self.value = np.zeros(cap, np.int64)      # codepoint or -(pool ref + 1)
-        self.has_value = np.zeros(cap, bool)
-        self.win_actor = np.full(cap, -1, np.int32)  # winning set op's actor rank
-        self.win_seq = np.zeros(cap, np.int32)
-        self.win_counter = np.zeros(cap, bool)       # winner has datatype counter
-        self.conflicts: dict = {}             # slot -> list of extra surviving ops
+        self.conflicts: dict = {}             # slot -> extra surviving ops
         self.value_pool: list = []            # rich values (non-single-char)
-        # elem key -> slot index, as a small list of sorted runs (keys are
-        # unique across runs; a batch appends one run, consolidated lazily)
-        self._key_runs: list = []             # [(keys_sorted, slots_sorted)]
+        self._cap = bucket(max(capacity, 16))
+        self._dev: Optional[dict] = None      # device arrays (lazy)
+        self._n_segs = 0                      # from last ingest stats
+        self._host: Optional[dict] = None     # numpy mirrors (lazy)
+        self._mat: Optional[tuple] = None     # (pos, codes, n_vis) device
         self._pos_cache: Optional[np.ndarray] = None
 
-    # -- packed-key index ------------------------------------------------
+    # ------------------------------------------------------------------
+    # device state
+    # ------------------------------------------------------------------
 
-    def _lookup(self, keys: np.ndarray) -> np.ndarray:
-        """Vectorized elem-key -> slot lookup (-1 where missing)."""
-        out = np.full(len(keys), -1, np.int32)
-        for run_keys, run_slots in self._key_runs:
-            if len(run_keys) == 0:
-                continue
-            i = np.minimum(np.searchsorted(run_keys, keys), len(run_keys) - 1)
-            hit = run_keys[i] == keys
-            out = np.where(hit, run_slots[i], out)
-        return out
+    def _ensure_dev(self) -> dict:
+        if self._dev is None:
+            import jax.numpy as jnp
+            from ..ops.ingest import INF_KEY
+            cap = self._cap
+            self._dev = {
+                "parent": jnp.zeros(cap, jnp.int32),
+                "ctr": jnp.zeros(cap, jnp.int32),
+                "actor": jnp.zeros(cap, jnp.int32),
+                "value": jnp.zeros(cap, jnp.int32),
+                "has_value": jnp.zeros(cap, bool),
+                "win_actor": jnp.full(cap, -1, jnp.int32),
+                "win_seq": jnp.zeros(cap, jnp.int32),
+                "win_counter": jnp.zeros(cap, bool),
+                "idx_keys": jnp.full(cap, INF_KEY, jnp.int64),
+                "idx_slots": jnp.zeros(cap, jnp.int32),
+            }
+        return self._dev
 
-    def _index_add_sorted(self, keys_sorted: np.ndarray, slots_sorted: np.ndarray):
-        self._key_runs.append((keys_sorted, slots_sorted.astype(np.int32)))
-        if len(self._key_runs) > 4:  # amortized consolidation
-            all_keys = np.concatenate([r[0] for r in self._key_runs])
-            all_slots = np.concatenate([r[1] for r in self._key_runs])
-            order = np.argsort(all_keys, kind="stable")
-            self._key_runs = [(all_keys[order], all_slots[order])]
+    def _invalidate(self):
+        self._host = None
+        self._mat = None
+        self._pos_cache = None
 
-    def _index_rebuild(self):
-        n = self.n_elems
-        keys = _pack(self.actor[1:n + 1], self.ctr[1:n + 1])
-        slots = np.arange(1, n + 1, dtype=np.int32)
-        order = np.argsort(keys, kind="stable")
-        self._key_runs = [(keys[order], slots[order])]
+    def _mirrors(self) -> dict:
+        """Host numpy mirrors of the element tables (fetched on demand)."""
+        if self._host is None:
+            dev = self._ensure_dev()
+            self._host = {k: np.asarray(dev[k]) for k in
+                          ("parent", "ctr", "actor", "value", "has_value")}
+        return self._host
 
     # ------------------------------------------------------------------
     # actor interning (order-preserving: rank order == lexicographic order)
@@ -105,26 +116,28 @@ class DeviceTextDoc:
         if not missing:
             return None
         merged = sorted(set(self.actor_table) | set(missing))
+        new_rank = {a: i for i, a in enumerate(merged)}
         remap = None
         if self.actor_table and merged[: len(self.actor_table)] != self.actor_table:
-            old_to_new = {a: merged.index(a) for a in self.actor_table}
             remap = np.asarray(
-                [old_to_new[a] for a in self.actor_table], np.int32)
+                [new_rank[a] for a in self.actor_table], np.int32)
         self.actor_table = merged
-        self._actor_rank = {a: i for i, a in enumerate(merged)}
+        self._actor_rank = new_rank
         return remap
 
     def _apply_remap(self, remap: np.ndarray):
-        n = self.n_elems + 1
-        live = self.actor[:n]
-        self.actor[:n] = remap[live]
-        win = self.win_actor[:n]
-        self.win_actor[:n] = np.where(win >= 0, remap[np.clip(win, 0, None)], -1)
-        for slot, ops in self.conflicts.items():
+        import jax.numpy as jnp
+        from ..ops.ingest import remap_actors
+        dev = self._ensure_dev()
+        actor_n, wa_n, idx_keys, idx_slots = remap_actors(
+            dev["actor"], dev["win_actor"], dev["ctr"],
+            jnp.asarray(remap), np.int32(self.n_elems))
+        dev.update(actor=actor_n, win_actor=wa_n,
+                   idx_keys=idx_keys, idx_slots=idx_slots)
+        for ops in self.conflicts.values():
             for op in ops:
                 op["actor_rank"] = int(remap[op["actor_rank"]])
-        self._index_rebuild()  # packed keys embed actor ranks
-        self._pos_cache = None
+        self._invalidate()
 
     # ------------------------------------------------------------------
     # causality
@@ -188,11 +201,11 @@ class DeviceTextDoc:
 
         for ready in rounds:
             self._apply_round(ready)
-        self._pos_cache = None
+        self._invalidate()
         return self
 
     def _apply_round(self, ready):
-        """Apply causally-ready (batch, row) pairs: all ops vectorized."""
+        """Apply causally-ready (batch, row) pairs: one device program each."""
         # group rows per batch object so op columns slice cheaply
         by_batch: dict = {}
         for b, row in ready:
@@ -212,210 +225,193 @@ class DeviceTextDoc:
             remap = self._intern_actors(b.actor_table)
             if remap is not None:
                 self._apply_remap(remap)
-            batch_rank = np.asarray(
-                [self._actor_rank[a] for a in b.actor_table], np.int32)
 
             if len(rows_arr) == b.n_changes:
                 mask = slice(None)  # whole batch ready: no filtering needed
             else:
                 mask = np.isin(b.op_change, rows_arr)
-            kind = b.op_kind[mask]
-            target_a = batch_rank[b.op_target_actor[mask]]
-            target_c = b.op_target_ctr[mask]
-            parent_a_raw = b.op_parent_actor[mask]
-            parent_a = np.where(parent_a_raw == HEAD_PARENT, 0,
-                                batch_rank[np.clip(parent_a_raw, 0, None)])
-            parent_c = b.op_parent_ctr[mask]
-            value = b.op_value[mask]
-            op_row = b.op_change[mask]
-            row_rank = np.asarray([self._actor_rank[a] for a in b.actors], np.int32)
-            change_actor = row_rank[op_row]
-            change_seq = b.seqs[op_row]
+            if b.n_ops:
+                self._ingest(b, mask)
 
-            target_keys = _pack(target_a, target_c)  # packed once, shared
-            self._apply_inserts(b, kind, target_keys, target_a, target_c,
-                                parent_a_raw, parent_a, parent_c)
-            self._apply_assigns(b, kind, target_keys, value,
-                                change_actor, change_seq, op_row)
+    def _ingest(self, b: TextChangeBatch, mask):
+        """One causally-ready round of one batch through the device kernel."""
+        import jax.numpy as jnp
+        from ..ops.ingest import bucket, ingest_round
 
-    def _grow(self, needed: int):
-        cap = len(self.parent)
-        if needed <= cap:
+        kind = b.op_kind[mask]
+        n_ops = len(kind)
+        if n_ops == 0:
             return
-        new_cap = cap
-        while new_cap < needed:
-            new_cap = int(new_cap * _GROW) + 64
-        for name in ("parent", "ctr", "actor", "value", "win_actor", "win_seq"):
-            arr = getattr(self, name)
-            grown = np.zeros(new_cap, arr.dtype)
-            grown[: len(arr)] = arr
-            setattr(self, name, grown)
-        for name in ("has_value", "win_counter"):
-            arr = getattr(self, name)
-            grown = np.zeros(new_cap, bool)
-            grown[: len(arr)] = arr
-            setattr(self, name, grown)
+        ta = b.op_target_actor[mask]
+        tc = b.op_target_ctr[mask]
+        pa = b.op_parent_actor[mask]
+        pc = b.op_parent_ctr[mask]
+        val64 = b.op_value[mask]
+        op_row = b.op_change[mask]
 
-    def _apply_inserts(self, b, kind, target_keys, target_a, target_c,
-                       parent_a_raw, parent_a, parent_c):
-        ins = kind == KIND_INS
-        n_new = int(ins.sum())
-        if not n_new:
-            return
-        new_keys = target_keys[ins]
-        new_slots = np.arange(self.n_elems + 1, self.n_elems + 1 + n_new,
-                              dtype=np.int32)
-        order = np.argsort(new_keys, kind="stable")
-        keys_sorted = new_keys[order]
-        in_batch_dup = (keys_sorted[1:] == keys_sorted[:-1]).any() if n_new > 1 else False
-        existing = self._lookup(keys_sorted)
-        if in_batch_dup or (existing >= 0).any():
-            if in_batch_dup:
-                dup = int(keys_sorted[:-1][keys_sorted[1:] == keys_sorted[:-1]][0])
-            else:
-                dup = int(keys_sorted[existing >= 0][0])
-            raise ValueError(
-                "Duplicate list element ID "
-                f"{make_elem_id(self.actor_table[dup >> 32], dup & 0xFFFFFFFF)}")
+        n_ins = int(np.count_nonzero(kind == KIND_INS))
+        needed = self.n_elems + 1 + n_ins
+        out_cap = max(bucket(needed), self._cap)
+        M = bucket(n_ops, 128)
 
-        start = self.n_elems + 1
-        self._grow(start + n_new)
-        sl = slice(start, start + n_new)
-        self.actor[sl] = target_a[ins]
-        self.ctr[sl] = target_c[ins]
-        self._index_add_sorted(keys_sorted, new_slots[order])
-        self.n_elems += n_new
+        def pad(arr, fill, dtype):
+            out = np.full(M, fill, dtype)
+            out[:n_ops] = arr
+            return out
 
-        # resolve parent slots: head, existing element, or new element in batch
-        is_head = parent_a_raw[ins] == HEAD_PARENT
-        p_keys = _pack(parent_a[ins], parent_c[ins])
-        parent_slots = self._lookup(p_keys)
-        parent_slots = np.where(is_head, 0, parent_slots)
-        if (parent_slots < 0).any():
-            bad = int(p_keys[parent_slots < 0][0])
-            raise ValueError(
-                "ins references unknown parent element "
-                f"{make_elem_id(self.actor_table[bad >> 32], bad & 0xFFFFFFFF)}")
-        self.parent[sl] = parent_slots
-        self.win_actor[sl] = -1
-        self.has_value[sl] = False
-
-    def _apply_assigns(self, b, kind, target_keys, value,
-                       change_actor, change_seq, op_row):
-        """set/del/inc ops with register semantics, vectorized fast path."""
-        assign = kind != KIND_INS
-        if not assign.any():
-            return
-        keys = target_keys[assign]
-        slots = self._lookup(keys)
-        if (slots < 0).any():
-            bad = int(keys[slots < 0][0])
-            raise ValueError(
-                "assignment to unknown element "
-                f"{make_elem_id(self.actor_table[bad >> 32], bad & 0xFFFFFFFF)}")
-
-        a_kind = kind[assign]
-        a_value = value[assign]
-        a_actor = change_actor[assign]
-        a_seq = change_seq[assign]
-        a_row = op_row[assign]
-
-        # fast path: single 'set' on an element with no existing register and
-        # no other op in this round (the overwhelmingly common insert+set)
-        counts = np.bincount(slots, minlength=self.n_elems + 1)
-        single = counts[slots] == 1
-        fast = single & (a_kind == KIND_SET) & ~self.has_value[slots] \
-            & (self.win_actor[slots] < 0)
+        A = bucket(len(b.actor_table), 64)
+        batch_rank = np.zeros(A, np.int32)
+        batch_rank[: len(b.actor_table)] = [
+            self._actor_rank[a] for a in b.actor_table]
+        R = bucket(b.n_changes, 64)
+        row_actor = np.zeros(R, np.int32)
+        row_actor[: b.n_changes] = [self._actor_rank[a] for a in b.actors]
+        row_seq = np.zeros(R, np.int32)
+        row_seq[: b.n_changes] = b.seqs
+        K = bucket(max(len(self.conflicts), 1), 64)
+        conflict_slots = np.full(K, out_cap, np.int32)
         if self.conflicts:
-            fast &= ~np.isin(slots, np.fromiter(self.conflicts, np.int32,
-                                                len(self.conflicts)))
-        f_slots = slots[fast]
-        self.value[f_slots] = a_value[fast]
-        self.has_value[f_slots] = True
-        self.win_actor[f_slots] = a_actor[fast]
-        self.win_seq[f_slots] = a_seq[fast]
-        self.win_counter[f_slots] = False
-        if b.value_pool:
-            rich = fast & (a_value < 0)
-            for s, v in zip(slots[rich], a_value[rich]):
-                entry = b.value_pool[-int(v) - 1]
-                self.value_pool.append(entry)
-                self.value[s] = -len(self.value_pool)
-                self.win_counter[s] = entry.get("datatype") == "counter"
+            conflict_slots[: len(self.conflicts)] = list(self.conflicts)
 
-        # general path: everything else, in op order (small subset)
-        slow = ~fast
-        order = np.argsort(a_row[slow], kind="stable")
-        s_slots = slots[slow][order]
-        s_kind = a_kind[slow][order]
-        s_value = a_value[slow][order]
-        s_actor = a_actor[slow][order]
-        s_seq = a_seq[slow][order]
-        for i in range(len(s_slots)):
-            self._apply_one_assign(b, int(s_slots[i]), int(s_kind[i]),
-                                   int(s_value[i]), int(s_actor[i]), int(s_seq[i]))
+        dev = self._ensure_dev()
+        (parent_n, ctr_n, actor_n, value_n, has_n, wa_n, ws_n, wc_n,
+         idx_keys, idx_slots, slow, tslot, stats) = ingest_round(
+            dev["parent"], dev["ctr"], dev["actor"], dev["value"],
+            dev["has_value"], dev["win_actor"], dev["win_seq"],
+            dev["win_counter"], dev["idx_keys"], dev["idx_slots"],
+            np.int32(self.n_elems),
+            jnp.asarray(pad(kind, -1, np.int8)),
+            jnp.asarray(pad(ta, 0, np.int32)),
+            jnp.asarray(pad(tc, 0, np.int32)),
+            jnp.asarray(pad(pa, 0, np.int32)),
+            jnp.asarray(pad(pc, 0, np.int32)),
+            jnp.asarray(pad(np.clip(val64, -2**31, 2**31 - 1), 0, np.int32)),
+            jnp.asarray(pad(op_row, 0, np.int32)),
+            jnp.asarray(batch_rank), jnp.asarray(row_actor),
+            jnp.asarray(row_seq), jnp.asarray(conflict_slots),
+            out_cap=out_cap)
 
-    # -- general register update (matches oracle applyAssign semantics) --
+        # errors checked BEFORE committing: a raising batch leaves the doc
+        # untouched (matches the oracle's pre-mutation validation)
+        stats = np.asarray(stats)  # sync: kernel done
+        if stats[0]:
+            raise ValueError(
+                f"Duplicate list element ID in changes for {self.obj_id}")
+        if stats[1]:
+            raise ValueError(
+                f"ins references unknown parent element in {self.obj_id}")
+        if stats[2]:
+            raise ValueError(
+                f"assignment to unknown element in {self.obj_id}")
 
-    def _register_ops(self, slot: int) -> list:
-        """Current surviving ops at `slot` as a list of dicts (winner first)."""
-        ops = []
-        if self.has_value[slot] or self.win_actor[slot] >= 0:
-            ops.append({"actor_rank": int(self.win_actor[slot]),
-                        "seq": int(self.win_seq[slot]),
-                        "value": int(self.value[slot]),
-                        "counter": bool(self.win_counter[slot])})
-        ops.extend(self.conflicts.get(slot, []))
-        return ops
+        self._dev = {
+            "parent": parent_n, "ctr": ctr_n, "actor": actor_n,
+            "value": value_n, "has_value": has_n, "win_actor": wa_n,
+            "win_seq": ws_n, "win_counter": wc_n,
+            "idx_keys": idx_keys, "idx_slots": idx_slots,
+        }
+        self._cap = out_cap
+        self.n_elems += n_ins
+        self._invalidate()
+        self._n_segs = int(stats[4])
 
-    def _store_register(self, slot: int, ops: list):
-        ops.sort(key=lambda o: o["actor_rank"], reverse=True)
-        if ops:
-            winner = ops[0]
-            self.value[slot] = winner["value"]
-            self.win_actor[slot] = winner["actor_rank"]
-            self.win_seq[slot] = winner["seq"]
-            self.win_counter[slot] = winner["counter"]
-            self.has_value[slot] = True
-        else:
-            self.has_value[slot] = False
-            self.win_actor[slot] = -1
-            self.win_counter[slot] = False
-        extras = ops[1:]
-        if extras:
-            self.conflicts[slot] = extras
-        else:
-            self.conflicts.pop(slot, None)
+        if stats[5]:
+            slow_np = np.asarray(slow)[:n_ops]
+            tslot_np = np.asarray(tslot)[:n_ops]
+            idxs = np.nonzero(slow_np)[0]
+            row_rank = row_actor[: b.n_changes]
+            self._apply_slow(
+                b, tslot_np[idxs], kind[idxs], val64[idxs],
+                row_rank[op_row[idxs]], np.asarray(b.seqs)[op_row[idxs]])
 
-    def _apply_one_assign(self, b, slot: int, kind: int, value: int,
-                          actor_rank: int, seq: int):
-        actor_id = self.actor_table[actor_rank]
-        all_deps = self._all_deps.get((actor_id, seq), {})
-        ops = self._register_ops(slot)
+    # ------------------------------------------------------------------
+    # slow register path (host; matches oracle applyAssign semantics)
+    # ------------------------------------------------------------------
 
-        if kind == KIND_INC:
-            for op in ops:
-                if op["counter"] and self._causally_covers(all_deps, op):
-                    entry = self.value_pool[-op["value"] - 1]
-                    new_entry = {"value": entry["value"] + value,
-                                 "datatype": "counter"}
-                    self.value_pool.append(new_entry)
-                    op["value"] = -len(self.value_pool)
-            self._store_register(slot, ops)
-            return
+    def _apply_slow(self, b, slots, kinds, values, actor_ranks, seqs):
+        """Resolve non-fast assigns against gathered register state."""
+        import jax.numpy as jnp
+        from ..ops.ingest import bucket, gather_registers, scatter_registers
 
-        surviving = [op for op in ops if not self._causally_covers(all_deps, op)]
-        if kind == KIND_SET:
-            pooled = value
-            counter = False
-            if value < 0 and b is not None:
-                entry = b.value_pool[-value - 1]
-                self.value_pool.append(entry)
-                pooled = -len(self.value_pool)
-                counter = entry.get("datatype") == "counter"
-            surviving.append({"actor_rank": actor_rank, "seq": seq,
-                              "value": pooled, "counter": counter})
-        self._store_register(slot, surviving)
+        dev = self._dev
+        uniq = np.unique(slots)
+        S = bucket(len(uniq), 64)
+        slots_p = np.full(S, self._cap, np.int32)
+        slots_p[: len(uniq)] = uniq
+        g_v, g_h, g_wa, g_ws, g_wc = (
+            np.asarray(x) for x in gather_registers(
+                dev["value"], dev["has_value"], dev["win_actor"],
+                dev["win_seq"], dev["win_counter"], jnp.asarray(slots_p)))
+
+        regs: dict = {}
+        for i, s in enumerate(uniq):
+            s = int(s)
+            ops = []
+            if g_h[i] or g_wa[i] >= 0:
+                ops.append({"actor_rank": int(g_wa[i]), "seq": int(g_ws[i]),
+                            "value": int(g_v[i]), "counter": bool(g_wc[i])})
+            ops.extend(self.conflicts.get(s, []))
+            regs[s] = ops
+
+        for j in range(len(slots)):
+            slot = int(slots[j])
+            kind = int(kinds[j])
+            value = int(values[j])
+            actor_rank = int(actor_ranks[j])
+            seq = int(seqs[j])
+            actor_id = self.actor_table[actor_rank]
+            all_deps = self._all_deps.get((actor_id, seq), {})
+            ops = regs[slot]
+
+            if kind == KIND_INC:
+                for op in ops:
+                    if op["counter"] and self._causally_covers(all_deps, op):
+                        entry = self.value_pool[-op["value"] - 1]
+                        self.value_pool.append(
+                            {"value": entry["value"] + value,
+                             "datatype": "counter"})
+                        op["value"] = -len(self.value_pool)
+                continue
+
+            surviving = [op for op in ops
+                         if not self._causally_covers(all_deps, op)]
+            if kind == KIND_SET:
+                pooled, counter = value, False
+                if value < 0:
+                    entry = b.value_pool[-value - 1]
+                    self.value_pool.append(entry)
+                    pooled = -len(self.value_pool)
+                    counter = entry.get("datatype") == "counter"
+                surviving.append({"actor_rank": actor_rank, "seq": seq,
+                                  "value": pooled, "counter": counter})
+            regs[slot] = surviving
+
+        # finalize: winner = highest actor rank; extras become conflicts
+        w_v = np.zeros(S, np.int32)
+        w_h = np.zeros(S, bool)
+        w_wa = np.full(S, -1, np.int32)
+        w_ws = np.zeros(S, np.int32)
+        w_wc = np.zeros(S, bool)
+        for i, s in enumerate(uniq):
+            s = int(s)
+            ops = sorted(regs[s], key=lambda o: o["actor_rank"], reverse=True)
+            if ops:
+                w = ops[0]
+                w_v[i], w_h[i] = w["value"], True
+                w_wa[i], w_ws[i], w_wc[i] = w["actor_rank"], w["seq"], w["counter"]
+            if ops[1:]:
+                self.conflicts[s] = ops[1:]
+            else:
+                self.conflicts.pop(s, None)
+
+        out = scatter_registers(
+            dev["value"], dev["has_value"], dev["win_actor"], dev["win_seq"],
+            dev["win_counter"], jnp.asarray(slots_p), jnp.asarray(w_v),
+            jnp.asarray(w_h), jnp.asarray(w_wa), jnp.asarray(w_ws),
+            jnp.asarray(w_wc))
+        dev["value"], dev["has_value"], dev["win_actor"], dev["win_seq"], \
+            dev["win_counter"] = out
+        self._invalidate()
 
     def _causally_covers(self, all_deps: dict, op: dict) -> bool:
         if op["actor_rank"] < 0:
@@ -426,15 +422,32 @@ class DeviceTextDoc:
     # materialization (device kernels)
     # ------------------------------------------------------------------
 
-    use_condensed = True  # segment-condensed linearization (set False to force
-    # the element-wise kernel; parity tests exercise both)
+    def _materialize(self):
+        """(pos, codes, n_vis) device arrays via the condensed kernel."""
+        if self._mat is None:
+            from ..ops.ingest import bucket, materialize_text
+            dev = self._ensure_dev()
+            S = bucket(self._n_segs + 2, 64)
+            while True:
+                pos, codes, n_vis, n_segs = materialize_text(
+                    dev["parent"], dev["ctr"], dev["actor"], dev["value"],
+                    dev["has_value"], np.int32(self.n_elems), S=S)
+                n_segs = int(n_segs)
+                if n_segs + 2 <= S:
+                    break
+                # stale census (an actor remap can break chain edges): retry
+                S = bucket(n_segs + 2, 64)
+            self._n_segs = n_segs
+            self._mat = (pos, codes, n_vis)
+        return self._mat
 
     def _positions(self) -> np.ndarray:
         if self._pos_cache is None:
             if self.n_elems == 0:
                 self._pos_cache = np.full(1, -1, np.int32)
             elif self.use_condensed:
-                self._pos_cache = self._positions_condensed()
+                pos, _, _ = self._materialize()
+                self._pos_cache = np.asarray(pos)[: self.n_elems + 1]
             else:
                 self._pos_cache = self._positions_full()
         return self._pos_cache
@@ -442,6 +455,7 @@ class DeviceTextDoc:
     def _positions_full(self) -> np.ndarray:
         import jax.numpy as jnp
         from ..ops.linearize import pad_capacity, rga_linearize
+        h = self._mirrors()
         n = self.n_elems + 1
         cap = pad_capacity(n)
 
@@ -454,110 +468,50 @@ class DeviceTextDoc:
 
         valid = np.zeros(cap, bool)
         valid[:n] = True
-        pos = rga_linearize(jnp.asarray(padded(self.parent)),
-                            jnp.asarray(padded(self.ctr)),
-                            jnp.asarray(padded(self.actor)),
+        pos = rga_linearize(jnp.asarray(padded(h["parent"])),
+                            jnp.asarray(padded(h["ctr"])),
+                            jnp.asarray(padded(h["actor"])),
                             jnp.asarray(valid))
         return np.asarray(pos)[:n]
-
-    def _positions_condensed(self) -> np.ndarray:
-        """Chain-contracted linearization: host RLE + small device tree.
-
-        A chain edge i-1 -> i (element i inserted after slot i-1, and i is
-        slot i-1's maximal child) is contractible: the pair is always adjacent
-        in RGA order. Maximal chains are 'segments' — contiguous slot runs,
-        since batch ingestion appends runs in op order. The condensed tree
-        (one node per segment) goes through `rga_linearize_segments`; element
-        position = segment start + within-segment offset.
-        """
-        import jax.numpy as jnp
-        from ..ops.linearize import pad_capacity, rga_linearize_segments
-        n = self.n_elems + 1
-        parent = self.parent[:n]
-        ctr = self.ctr[:n]
-        actor = self.actor[:n]
-
-        # max child per slot: sort elements by (parent, (ctr, actor)) and take
-        # each group's last entry
-        packed = _pack(ctr[1:], actor[1:])
-        order = np.lexsort((packed, parent[1:]))
-        elems = np.arange(1, n, dtype=np.int32)
-        sorted_parents = parent[1:][order]
-        group_last = np.concatenate([sorted_parents[1:] != sorted_parents[:-1],
-                                     np.ones(1, bool)])
-        max_child = np.full(n, -1, np.int32)
-        max_child[sorted_parents[group_last]] = elems[order][group_last]
-
-        # contractible chain edges (never into the head)
-        chain = np.zeros(n, bool)
-        chain[1:] = (parent[1:] == elems - 1) & (elems - 1 != 0)
-        chain[1:] &= max_child[np.clip(elems - 1, 0, None)] == elems
-        seg_start = ~chain
-        seg_id = np.cumsum(seg_start) - 1          # head = segment 0
-        start_slots = np.nonzero(seg_start)[0]
-        n_segs = len(start_slots)
-        offset = np.arange(n) - start_slots[seg_id]
-        sizes = np.diff(np.append(start_slots, n)).astype(np.int32)
-        sizes[0] = 0  # the head segment contributes no elements
-
-        head_slots = start_slots.astype(np.int32)
-        seg_parent_slot = parent[head_slots]
-        seg_parent = seg_id[seg_parent_slot].astype(np.int32)
-        seg_attach = offset[seg_parent_slot].astype(np.int32)
-        seg_ctr = ctr[head_slots]
-        seg_actor = actor[head_slots]
-
-        cap = pad_capacity(n_segs)
-
-        def padded(arr, dtype):
-            out = np.zeros(cap, dtype)
-            out[:n_segs] = arr
-            return out
-
-        valid = np.zeros(cap, bool)
-        valid[:n_segs] = True
-        starts = rga_linearize_segments(
-            jnp.asarray(padded(seg_parent, np.int32)),
-            jnp.asarray(padded(seg_attach, np.int32)),
-            jnp.asarray(padded(seg_ctr, np.int32)),
-            jnp.asarray(padded(seg_actor, np.int32)),
-            jnp.asarray(padded(sizes, np.int32)),
-            jnp.asarray(valid))
-        starts = np.asarray(starts)[:n_segs]
-
-        pos = (starts[seg_id] + offset).astype(np.int32)
-        pos[0] = -1
-        return pos
 
     def visible_order(self) -> np.ndarray:
         """Slots of visible elements in list order."""
         n = self.n_elems + 1
-        pos = self._positions()
         if n <= 1:
             return np.empty(0, np.int64)
+        pos = self._positions()
+        h = self._mirrors()
         # pos[1:] is a permutation of 0..n-2: invert it (counting sort)
         inv = np.empty(n - 1, np.int64)
         inv[pos[1:]] = np.arange(1, n)
-        return inv[self.has_value[inv]]
+        return inv[h["has_value"][inv]]
 
     def text(self) -> str:
-        order = self.visible_order()
-        values = self.value[order]
+        if self.n_elems == 0:
+            return ""
+        if self.use_condensed:
+            _, codes, n_vis = self._materialize()
+            n_vis = int(n_vis)
+            values = np.asarray(codes)[:n_vis]
+        else:
+            order = self.visible_order()
+            values = self._mirrors()["value"][order]
+        if len(values) == 0:
+            return ""
         if (values < 0).any():
             # rich (non-single-char) values spliced in — rare path
             return "".join(
                 chr(v) if v >= 0 else str(self.value_pool[-int(v) - 1]["value"])
                 for v in values)
-        if len(values) == 0:
-            return ""
         if values.max(initial=0) < 128:
             return values.astype(np.uint8).tobytes().decode("ascii")
         return "".join(map(chr, values.astype(np.uint32)))
 
     def values(self) -> list:
+        h = self._mirrors()
         out = []
         for slot in self.visible_order():
-            v = int(self.value[slot])
+            v = int(h["value"][slot])
             if v >= 0:
                 out.append(chr(v))
             else:
@@ -565,7 +519,8 @@ class DeviceTextDoc:
         return out
 
     def elem_ids(self) -> list:
-        return [make_elem_id(self.actor_table[self.actor[s]], int(self.ctr[s]))
+        h = self._mirrors()
+        return [make_elem_id(self.actor_table[h["actor"][s]], int(h["ctr"][s]))
                 for s in self.visible_order()]
 
     def conflicts_at(self, index: int):
@@ -581,4 +536,7 @@ class DeviceTextDoc:
         return out
 
     def __len__(self) -> int:
-        return int(self.has_value[1: self.n_elems + 1].sum())
+        if self.n_elems == 0:
+            return 0
+        h = self._mirrors()
+        return int(h["has_value"][1: self.n_elems + 1].sum())

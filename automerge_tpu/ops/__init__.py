@@ -1,2 +1,8 @@
-from .linearize import rga_linearize  # noqa: F401
-from .scan import segment_starts, visible_index  # noqa: F401
+import jax
+
+# Packed elemId keys are (actor_rank << 32 | ctr) int64 (ops/ingest.py): the
+# device engine needs real 64-bit integers. Set before any kernel traces.
+jax.config.update("jax_enable_x64", True)
+
+from .linearize import rga_linearize  # noqa: E402,F401
+from .scan import segment_starts, visible_index  # noqa: E402,F401

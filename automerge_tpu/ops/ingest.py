@@ -1,0 +1,328 @@
+"""Device-side batch ingestion for the columnar text/list engine.
+
+The reference applies ops one at a time (`applyOps`/`applyInsert`/
+`applyAssign`, /root/reference/backend/op_set.js:63-283), with an
+order-statistic skip list for elemId↔index queries. Here one causally-ready
+*round* of changes — often millions of ops — is a single jitted XLA program:
+
+- insert slots are a prefix sum over the ins mask (op order == slot order);
+- the elemId→slot index is a sorted packed-key array, maintained by a
+  two-pointer merge (two `searchsorted` + scatters, no monolithic re-sort);
+- parent/target resolution is one batched binary search over the merged
+  index (covers in-round references: a change may target elements that
+  another change in the same round inserted);
+- LWW register fast path: single `set` on an element with an empty register
+  resolves with pure scatters. Everything else (dels, counter incs,
+  concurrent multi-writer registers, rich values) is flagged into a `slow`
+  mask the host resolves against its conflict/value-pool state — exactly the
+  reference's applyAssign semantics, just partitioned so the device does the
+  overwhelmingly common case at memory bandwidth.
+
+The kernel also recomputes the chain-segment census (`n_segs`) used to size
+the condensed linearization (see `materialize_text`), so materialization
+needs no extra host↔device round trip.
+
+All shapes are static; callers bucket capacities with `bucket()` so XLA
+retraces rarely. Packed elemId keys are (actor_rank << 32 | ctr) int64 —
+actor ranks are assigned in lexicographic order of actor-id strings, so
+integer compares reproduce the reference's string tie-breaks
+(op_set.js:245,432-436).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .._common import HEAD_PARENT, KIND_DEL, KIND_INC, KIND_INS, KIND_SET
+
+# Packed-key sentinel: larger than any real (actor_rank, ctr) key.
+INF_KEY = jnp.int64(1) << 62
+_SENT32 = (1 << 31) - 1
+
+
+def bucket(n: int, minimum: int = 256) -> int:
+    """Half-octave size buckets (2^k and 3·2^(k-1)): ≤25% padding waste."""
+    cap = minimum
+    while cap < n:
+        cap = cap * 3 // 2 if (cap & (cap - 1)) == 0 else (cap // 3) * 4
+    return cap
+
+
+def _pack(actor: jax.Array, ctr: jax.Array) -> jax.Array:
+    return (actor.astype(jnp.int64) << 32) | ctr.astype(jnp.int64)
+
+
+def _segment_census(parent, ctr, actor, n_live, cap):
+    """Chain-contraction structure of the element table.
+
+    A slot i continues a chain iff its parent is slot i-1 and it is i-1's
+    Lamport-maximal child (so the pair is always adjacent in RGA order).
+    Returns (is_elem, seg_start, seg_head, offset, rank_incl, n_segs).
+    """
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    is_elem = (idx >= 1) & (idx <= n_live)
+    pk2 = jnp.where(is_elem, _pack(ctr, actor), -1)
+    maxkey = jnp.full(cap, -1, jnp.int64).at[
+        jnp.where(is_elem, parent, cap)].max(pk2, mode="drop")
+    prev_max = jnp.concatenate([jnp.full(1, -1, jnp.int64), maxkey[:-1]])
+    chain = is_elem & (parent == idx - 1) & (idx - 1 >= 1) & (pk2 == prev_max)
+    seg_start = is_elem & ~chain
+    rank_incl = jnp.cumsum(seg_start.astype(jnp.int32))
+    seg_head = jax.lax.cummax(jnp.where(seg_start, idx, 0))
+    offset = idx - seg_head
+    n_segs = rank_incl[-1]
+    return is_elem, seg_start, seg_head, offset, rank_incl, n_segs
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def ingest_round(
+    # document state, capacity C (all device arrays)
+    parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
+    idx_keys, idx_slots,          # sorted packed-key index, INF-padded, [C]
+    n_elems,                      # live element count (scalar i32)
+    # batch op columns, capacity M (padded with kind = -1)
+    op_kind, op_ta, op_tc, op_pa, op_pc, op_value, op_row,
+    # batch tables
+    batch_rank,                   # [A] batch actor idx -> global rank
+    row_actor, row_seq,           # [R] per-change global rank / seq
+    conflict_slots,               # [K] slots with host-held conflicts (pad C)
+    *, out_cap: int,
+):
+    """Apply one causally-ready round of ops. Returns the updated state at
+    capacity `out_cap`, a slow-op mask for the host, and a stats vector
+    [dups, missing_parents, missing_targets, n_new, n_segs, n_slow]."""
+    C = parent.shape[0]
+    M = op_kind.shape[0]
+    kind = op_kind.astype(jnp.int32)
+    is_ins = kind == KIND_INS
+    is_assign = (kind == KIND_SET) | (kind == KIND_DEL) | (kind == KIND_INC)
+
+    g_ta = batch_rank[jnp.clip(op_ta, 0, None)]
+
+    # --- insert slot assignment: op order == slot order (prefix sum) ---
+    new_slot = n_elems + jnp.cumsum(is_ins.astype(jnp.int32))
+    n_new = jnp.sum(is_ins.astype(jnp.int32))
+
+    # --- sort new element keys (two i32 keys: no 64-bit sort) ---
+    sort_a = jnp.where(is_ins, g_ta, _SENT32)
+    sort_c = jnp.where(is_ins, op_tc, _SENT32)
+    sa, sc, sslot = jax.lax.sort((sort_a, sort_c, new_slot), num_keys=2)
+    skeys = jnp.where(sa == _SENT32, INF_KEY, _pack(sa, sc))
+
+    # --- merge the sorted new keys into the sorted index (no re-sort) ---
+    posA = jnp.arange(C, dtype=jnp.int32) + jnp.searchsorted(
+        skeys, idx_keys, side="left").astype(jnp.int32)
+    posB = jnp.arange(M, dtype=jnp.int32) + jnp.searchsorted(
+        idx_keys, skeys, side="right").astype(jnp.int32)
+    total = C + M
+    mk = jnp.full(total, INF_KEY, jnp.int64).at[posA].set(idx_keys).at[posB].set(skeys)
+    ms = jnp.zeros(total, jnp.int32).at[posA].set(idx_slots).at[posB].set(sslot)
+    n_dup = jnp.sum((mk[1:] == mk[:-1]) & (mk[:-1] < INF_KEY))
+    if total >= out_cap:
+        # all real keys fit in the prefix: live + new <= out_cap by contract
+        out_keys, out_slots = mk[:out_cap], ms[:out_cap]
+    else:
+        pad = out_cap - total
+        out_keys = jnp.concatenate([mk, jnp.full(pad, INF_KEY, jnp.int64)])
+        out_slots = jnp.concatenate([ms, jnp.zeros(pad, jnp.int32)])
+
+    # --- one binary search resolves every op's reference ---
+    is_head = op_pa == HEAD_PARENT
+    g_pa = batch_rank[jnp.clip(op_pa, 0, None)]
+    q_key = jnp.where(is_ins, _pack(g_pa, op_pc), _pack(g_ta, op_tc))
+    qi = jnp.clip(jnp.searchsorted(out_keys, q_key, side="left").astype(jnp.int32),
+                  0, out_cap - 1)
+    q_found = out_keys[qi] == q_key
+    q_slot = jnp.where(q_found, out_slots[qi], out_cap)
+
+    n_missing_parent = jnp.sum(is_ins & ~is_head & ~q_found)
+    n_missing_target = jnp.sum(is_assign & ~q_found)
+
+    # --- extend tables to out_cap and scatter the new elements ---
+    def ext(a, fill):
+        if C >= out_cap:
+            return a
+        return jnp.concatenate(
+            [a, jnp.full(out_cap - C, fill, a.dtype)])
+
+    ins_idx = jnp.where(is_ins, new_slot, out_cap)  # OOB sentinel drops pads
+    parent_n = ext(parent, 0).at[ins_idx].set(
+        jnp.where(is_head, 0, q_slot).astype(jnp.int32), mode="drop")
+    ctr_n = ext(ctr, 0).at[ins_idx].set(op_tc, mode="drop")
+    actor_n = ext(actor, 0).at[ins_idx].set(g_ta, mode="drop")
+    value_n = ext(value, 0).at[ins_idx].set(0, mode="drop")
+    has_n = ext(has_value, False).at[ins_idx].set(False, mode="drop")
+    wa_n = ext(win_actor, -1).at[ins_idx].set(-1, mode="drop")
+    ws_n = ext(win_seq, 0).at[ins_idx].set(0, mode="drop")
+    wc_n = ext(win_counter, False).at[ins_idx].set(False, mode="drop")
+
+    # --- register fast path ---
+    tslot = jnp.where(is_assign, q_slot, out_cap)
+    tclip = jnp.clip(tslot, 0, out_cap - 1)
+    counts = jnp.zeros(out_cap + 1, jnp.int32).at[
+        jnp.clip(tslot, 0, out_cap)].add(is_assign.astype(jnp.int32))
+    cmask = jnp.zeros(out_cap + 1, bool).at[
+        jnp.clip(conflict_slots, 0, out_cap)].set(True)
+    fast = (is_assign & (kind == KIND_SET) & q_found
+            & (counts[tclip] == 1) & ~has_n[tclip] & (wa_n[tclip] < 0)
+            & ~cmask[tclip] & (op_value >= 0))
+    f_idx = jnp.where(fast, tslot, out_cap)
+    value_n = value_n.at[f_idx].set(op_value, mode="drop")
+    has_n = has_n.at[f_idx].set(True, mode="drop")
+    wa_n = wa_n.at[f_idx].set(row_actor[op_row], mode="drop")
+    ws_n = ws_n.at[f_idx].set(row_seq[op_row], mode="drop")
+    wc_n = wc_n.at[f_idx].set(False, mode="drop")
+    slow = is_assign & ~fast
+
+    # --- segment census on the post-round table (for materialization) ---
+    n_live = n_elems + n_new
+    _, _, _, _, _, n_segs = _segment_census(
+        parent_n, ctr_n, actor_n, n_live, out_cap)
+
+    stats = jnp.stack([
+        n_dup.astype(jnp.int32), n_missing_parent.astype(jnp.int32),
+        n_missing_target.astype(jnp.int32), n_new,
+        n_segs, jnp.sum(slow.astype(jnp.int32))])
+    return (parent_n, ctr_n, actor_n, value_n, has_n, wa_n, ws_n, wc_n,
+            out_keys, out_slots, slow, tslot, stats)
+
+
+def _linearize_segments(parent, attach_off, ctr, actor, weight, valid):
+    """Condensed-tree linearization (see ops/linearize.py for the derivation):
+    per-parent children sort descending by (attach, ctr, actor), successor
+    chain by pointer doubling, weighted list ranking."""
+    import math
+    n = parent.shape[0]
+    steps = max(1, math.ceil(math.log2(max(2, n))))
+    idx = jnp.arange(n, dtype=jnp.int32)
+    is_seg = valid & (idx != 0)
+    big = jnp.int32(n + 1)
+
+    sort_parent = jnp.where(is_seg, parent, big)
+    neg_off = jnp.where(is_seg, -attach_off, big)
+    neg_ctr = jnp.where(is_seg, -ctr, big)
+    neg_actor = jnp.where(is_seg, -actor, big)
+    p_s, _, _, _, idx_s = jax.lax.sort(
+        (sort_parent, neg_off, neg_ctr, neg_actor, idx), num_keys=4)
+
+    in_group = p_s < big
+    same_next = jnp.concatenate(
+        [(p_s[1:] == p_s[:-1]) & in_group[1:], jnp.zeros(1, bool)])
+    next_in_sorted = jnp.concatenate([idx_s[1:], jnp.full(1, -1, idx_s.dtype)])
+    next_sib = jnp.full((n,), -1, jnp.int32)
+    next_sib = next_sib.at[idx_s].set(jnp.where(same_next, next_in_sorted, -1))
+
+    group_start = jnp.concatenate(
+        [jnp.ones(1, bool), p_s[1:] != p_s[:-1]]) & in_group
+    first_child = jnp.full((n,), -1, jnp.int32)
+    first_child = first_child.at[jnp.where(group_start, p_s, big - 1)].set(
+        jnp.where(group_start, idx_s, -1), mode="drop")
+
+    has_next = next_sib >= 0
+    safe_parent = jnp.where(is_seg, parent, 0)
+    anc = jnp.where(has_next | (idx == 0), idx, safe_parent)
+    anc = jax.lax.fori_loop(0, steps, lambda _, a: a[a], anc)
+
+    succ = jnp.where(first_child >= 0, first_child, next_sib[anc])
+
+    end = jnp.int32(n)
+    nxt = jnp.where(succ >= 0, succ, end)
+    nxt = jnp.where(is_seg | (idx == 0), nxt, idx)
+    nxt = jnp.concatenate([nxt, jnp.full(1, end, jnp.int32)])
+    dist = jnp.where(is_seg, weight, 0).astype(jnp.int32)
+    dist = jnp.concatenate([dist, jnp.zeros(1, jnp.int32)])
+
+    def rank_step(_, carry):
+        d, nx = carry
+        return d + d[nx], nx[nx]
+
+    dist, nxt = jax.lax.fori_loop(0, steps + 1, rank_step, (dist, nxt))
+    start = dist[0] - dist[:n]
+    return jnp.where(is_seg, start, jnp.where(idx == 0, 0, big))
+
+
+@partial(jax.jit, static_argnames=("S",))
+def materialize_text(parent, ctr, actor, value, has_value, n_elems, *, S: int):
+    """RGA positions + visible compaction, fully on device.
+
+    Chain segments are contracted host-free: the census is recomputed (cheap
+    elementwise + one scatter-max), segments compact into S nodes (S is a
+    static bucket ≥ n_segs+1, known from ingest stats), the condensed tree
+    linearizes in O(S log S), and element position = segment start + offset.
+
+    Returns (pos[C], codes[C], n_vis): `pos` includes tombstones (head = -1,
+    padding > n), `codes` is visible values scattered into list order.
+    """
+    C = parent.shape[0]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    is_elem, seg_start, seg_head, offset, rank_incl, n_segs = _segment_census(
+        parent, ctr, actor, n_elems, C)
+
+    heads = jnp.zeros(S, jnp.int32).at[
+        jnp.where(seg_start, rank_incl, S)].set(idx, mode="drop")
+    node_of = rank_incl[seg_head]              # node id of each slot's segment
+    sizes = jnp.zeros(C, jnp.int32).at[seg_head].add(is_elem.astype(jnp.int32))
+
+    p_slot = parent[heads]
+    node_parent = node_of[p_slot]
+    attach = offset[p_slot]
+    nctr = ctr[heads]
+    nactor = actor[heads]
+    weight = sizes[heads]
+    valid = jnp.arange(S, dtype=jnp.int32) <= n_segs
+    starts = _linearize_segments(node_parent, attach, nctr, nactor, weight, valid)
+
+    pos = jnp.where(is_elem, starts[node_of] + offset,
+                    jnp.where(idx == 0, -1, C + 1))
+
+    vis = has_value & is_elem
+    slot_p = jnp.clip(pos + 1, 0, C + 1)
+    by_pos = jnp.zeros(C + 2, jnp.int32).at[slot_p].add(vis.astype(jnp.int32))
+    cum = jnp.cumsum(by_pos)
+    vis_rank = cum[slot_p] - by_pos[slot_p]
+    codes = jnp.full(C, -1, value.dtype).at[
+        jnp.where(vis, vis_rank, C)].set(value, mode="drop")
+    # n_segs returned so the host can detect S overflow (e.g. an actor remap
+    # changed Lamport sibling order and broke chain edges) and retry bigger
+    return pos, codes, cum[C + 1], n_segs
+
+
+@jax.jit
+def remap_actors(actor, win_actor, ctr, remap, n_elems):
+    """Re-rank actor ids after interning breaks lexicographic rank order.
+
+    Rebuilds the packed-key index (ranks are embedded in keys). Rare: only
+    when a new actor id sorts before an existing one.
+    """
+    C = actor.shape[0]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    live = (idx >= 1) & (idx <= n_elems)
+    hi = remap.shape[0] - 1
+    actor_n = jnp.where(live, remap[jnp.clip(actor, 0, hi)], actor)
+    wa_n = jnp.where(win_actor >= 0, remap[jnp.clip(win_actor, 0, hi)],
+                     win_actor)
+    keys = jnp.where(live, _pack(actor_n, ctr), INF_KEY)
+    sk, ss = jax.lax.sort((keys, idx), num_keys=1)
+    return actor_n, wa_n, sk, ss
+
+
+@jax.jit
+def gather_registers(value, has_value, win_actor, win_seq, win_counter, slots):
+    """Fetch register state at `slots` (clipped; caller masks) for the host
+    slow path."""
+    s = jnp.clip(slots, 0, value.shape[0] - 1)
+    return (value[s], has_value[s], win_actor[s], win_seq[s], win_counter[s])
+
+
+@jax.jit
+def scatter_registers(value, has_value, win_actor, win_seq, win_counter,
+                      slots, v, h, wa, ws, wc):
+    """Write back host-resolved registers (OOB sentinel slots drop)."""
+    return (value.at[slots].set(v, mode="drop"),
+            has_value.at[slots].set(h, mode="drop"),
+            win_actor.at[slots].set(wa, mode="drop"),
+            win_seq.at[slots].set(ws, mode="drop"),
+            win_counter.at[slots].set(wc, mode="drop"))

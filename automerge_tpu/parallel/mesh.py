@@ -31,9 +31,11 @@ def make_mesh(n_devices: int | None = None, doc_axis: int | None = None) -> Mesh
     devices = jax.devices()[:n_devices] if n_devices else jax.devices()
     n = len(devices)
     if doc_axis is None:
-        doc_axis = n
-        while doc_axis > 1 and n % doc_axis:
-            doc_axis -= 1
+        # balanced factorization: largest divisor of n that is <= sqrt(n),
+        # so the elem (sequence-parallel) axis is exercised whenever n > 1
+        doc_axis = max(d for d in range(1, int(n ** 0.5) + 1) if n % d == 0)
+    if n % doc_axis:
+        raise ValueError(f"doc_axis {doc_axis} does not divide {n} devices")
     elem_axis = n // doc_axis
     import numpy as np
     dev_grid = np.asarray(devices).reshape(doc_axis, elem_axis)
