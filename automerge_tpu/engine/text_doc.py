@@ -4,10 +4,10 @@ This is the TPU-native replacement for the reference's per-op reconciliation
 of sequences (`backend/op_set.js` applyInsert/applyAssign + skip list,
 /root/reference/backend/op_set.js:63-283, /root/reference/backend/
 skip_list.js): the document lives as padded columnar element tables in device
-memory; whole *batches* of changes merge in single jitted programs
-(`ops/ingest.py`), and materialization (RGA order + visible compaction) is a
-second device program — the host only orchestrates causal admission and the
-rare slow register cases.
+memory; whole *batches* of changes merge in jitted programs (`ops/ingest.py`),
+and materialization (RGA order + visible compaction) is a second device
+program — the host orchestrates causal admission, elemId reference
+resolution, and the rare slow register cases.
 
 Semantics match the oracle exactly (see tests/test_engine_parity.py):
 - causal readiness gating with queueing of unready changes, idempotent dups
@@ -18,12 +18,18 @@ Semantics match the oracle exactly (see tests/test_engine_parity.py):
 - RGA concurrent-insert ordering (descending Lamport at each insertion point)
 
 Division of labor per causally-ready round:
-- device (`ingest_round`): insert placement, elemId index merge, reference
-  resolution, LWW fast path, segment census — O(ops) scatters/gathers plus
-  one O(ops log ops) sort, at HBM bandwidth
-- host: vector clocks, transitive deps, actor interning, and the slow-mask
-  register residue (dels, counter incs, genuine concurrent conflicts) against
-  the host-held conflict/value-pool state
+- host (numpy, C-speed): vector clocks, transitive deps, actor interning,
+  typing-run detection over the op columns, elemId->slot resolution against
+  a compressed range index (engine/host_index.py), and the slow-mask
+  register residue (dels, counter incs, genuine concurrent conflicts)
+  against the host-held conflict/value-pool state
+- device: run expansion + irregular-op scatters + LWW register fast path
+  (`expand_runs`/`apply_residual`) and materialization (`materialize_text`)
+  — all int32, no sorts over elements, O(ops) at HBM bandwidth
+
+The run condensation is the key throughput lever: a typing run of k
+characters costs ~20 bytes of descriptor + 4k bytes of value blob on the
+wire to the device, instead of 2k op rows.
 """
 
 from __future__ import annotations
@@ -32,13 +38,11 @@ from typing import Optional
 
 import numpy as np
 
-from .._common import KIND_DEL, KIND_INC, KIND_INS, KIND_SET, make_elem_id
+from .._common import (HEAD_PARENT, KIND_DEL, KIND_INC, KIND_INS, KIND_SET,
+                       make_elem_id)
 from .columnar import TextChangeBatch
-
-
-def _pack_np(actor_idx: np.ndarray, ctr: np.ndarray) -> np.ndarray:
-    """Pack (actor rank, counter) element ids into sortable int64 keys."""
-    return (actor_idx.astype(np.int64) << 32) | ctr.astype(np.int64)
+from .host_index import (DuplicateElemId, ElemRangeIndex, pack_keys,
+                         unpack_key)
 
 
 class DeviceTextDoc:
@@ -52,9 +56,13 @@ class DeviceTextDoc:
     use_condensed = True  # chain-condensed linearization (set False to force
     # the element-wise kernel; parity tests exercise both)
 
+    _TABLE_KEYS = ("parent", "ctr", "actor", "value", "has_value",
+                   "win_actor", "win_seq", "win_counter", "chain")
+
     def __init__(self, obj_id: str = "text", capacity: int = 1024):
         from ..ops.ingest import bucket
         self.obj_id = obj_id
+        self.all_ascii = True                 # every value ever set is 7-bit
         self.actor_table: list = []           # rank -> actor id (lex-ordered)
         self._actor_rank: dict = {}
         self.clock: dict = {}                 # actor id -> seq
@@ -63,9 +71,10 @@ class DeviceTextDoc:
         self.n_elems = 0                      # live element count (excl. head)
         self.conflicts: dict = {}             # slot -> extra surviving ops
         self.value_pool: list = []            # rich values (non-single-char)
+        self.index = ElemRangeIndex()         # elemId -> slot (host)
         self._cap = bucket(max(capacity, 16))
         self._dev: Optional[dict] = None      # device arrays (lazy)
-        self._n_segs = 0                      # from last ingest stats
+        self._seg_bound = 2                   # upper bound for S sizing
         self._host: Optional[dict] = None     # numpy mirrors (lazy)
         self._mat: Optional[tuple] = None     # (pos, codes, n_vis) device
         self._pos_cache: Optional[np.ndarray] = None
@@ -77,7 +86,6 @@ class DeviceTextDoc:
     def _ensure_dev(self) -> dict:
         if self._dev is None:
             import jax.numpy as jnp
-            from ..ops.ingest import INF_KEY
             cap = self._cap
             self._dev = {
                 "parent": jnp.zeros(cap, jnp.int32),
@@ -88,8 +96,7 @@ class DeviceTextDoc:
                 "win_actor": jnp.full(cap, -1, jnp.int32),
                 "win_seq": jnp.zeros(cap, jnp.int32),
                 "win_counter": jnp.zeros(cap, bool),
-                "idx_keys": jnp.full(cap, INF_KEY, jnp.int64),
-                "idx_slots": jnp.zeros(cap, jnp.int32),
+                "chain": jnp.zeros(cap, bool),
             }
         return self._dev
 
@@ -129,11 +136,11 @@ class DeviceTextDoc:
         import jax.numpy as jnp
         from ..ops.ingest import remap_actors
         dev = self._ensure_dev()
-        actor_n, wa_n, idx_keys, idx_slots = remap_actors(
-            dev["actor"], dev["win_actor"], dev["ctr"],
-            jnp.asarray(remap), np.int32(self.n_elems))
-        dev.update(actor=actor_n, win_actor=wa_n,
-                   idx_keys=idx_keys, idx_slots=idx_slots)
+        actor_n, wa_n = remap_actors(
+            dev["actor"], dev["win_actor"], jnp.asarray(remap),
+            np.int32(self.n_elems))
+        dev.update(actor=actor_n, win_actor=wa_n)
+        self.index.remap_actors(remap.astype(np.int64))
         for ops in self.conflicts.values():
             for op in ops:
                 op["actor_rank"] = int(remap[op["actor_rank"]])
@@ -234,11 +241,12 @@ class DeviceTextDoc:
                 self._ingest(b, mask)
 
     def _ingest(self, b: TextChangeBatch, mask):
-        """One causally-ready round of one batch through the device kernel."""
+        """One causally-ready round of one batch: host resolution + at most
+        two device programs (run expansion, residual ops)."""
         import jax.numpy as jnp
-        from ..ops.ingest import bucket, ingest_round
+        from ..ops.ingest import apply_residual, bucket, expand_runs
 
-        kind = b.op_kind[mask]
+        kind = np.ascontiguousarray(b.op_kind[mask])
         n_ops = len(kind)
         if n_ops == 0:
             return
@@ -249,80 +257,235 @@ class DeviceTextDoc:
         val64 = b.op_value[mask]
         op_row = b.op_change[mask]
 
-        n_ins = int(np.count_nonzero(kind == KIND_INS))
-        needed = self.n_elems + 1 + n_ins
+        batch_rank = np.asarray(
+            [self._actor_rank[a] for a in b.actor_table], np.int64)
+        row_actor_rank = np.asarray(
+            [self._actor_rank[a] for a in b.actors], np.int32)
+        row_seq = np.asarray(b.seqs, np.int32)
+
+        is_ins = kind == KIND_INS
+        n_ins = int(is_ins.sum())
+        # slot assignment: op order == slot order
+        new_slot = np.where(is_ins, self.n_elems + np.cumsum(is_ins), 0)
+
+        # --- typing-run detection: INS immediately followed by its SET,
+        # chained with consecutive counters (the dominant text workload) ---
+        is_pair = np.zeros(n_ops, bool)
+        if n_ops >= 2:
+            is_pair[:-1] = ((kind[:-1] == KIND_INS) & (kind[1:] == KIND_SET)
+                            & (op_row[1:] == op_row[:-1])
+                            & (ta[1:] == ta[:-1]) & (tc[1:] == tc[:-1])
+                            & (val64[1:] >= 0) & (val64[1:] < 2**31))
+        cont = np.zeros(n_ops, bool)
+        if n_ops >= 3:
+            cont[2:] = (is_pair[2:] & is_pair[:-2]
+                        & (op_row[2:] == op_row[:-2]) & (ta[2:] == ta[:-2])
+                        & (tc[2:] == tc[:-2] + 1) & (pa[2:] == ta[:-2])
+                        & (pc[2:] == tc[:-2]))
+        run_head = is_pair & ~cont
+        covered = np.zeros(n_ops, bool)
+        covered[is_pair] = True
+        covered[1:] |= is_pair[:-1]
+        residual = ~covered
+
+        hpos = np.flatnonzero(run_head)
+        n_runs = len(hpos)
+        pair_pos = np.flatnonzero(is_pair)
+        n_pairs = len(pair_pos)
+
+        rpos = np.flatnonzero(residual)
+        res_kind = kind[rpos]
+        res_is_ins = res_kind == KIND_INS
+        n_res_ins = int(res_is_ins.sum())
+
+        # --- elemId index: stage this round's minted ranges (commit later) ---
+        if n_runs:
+            run_ctr0 = tc[hpos].astype(np.int64)
+            run_actor_g = batch_rank[ta[hpos]]
+            run_len = np.diff(np.append(
+                np.searchsorted(pair_pos, hpos), n_pairs)).astype(np.int64)
+            run_slot0 = new_slot[hpos].astype(np.int64)
+            new_starts = [pack_keys(run_actor_g, run_ctr0)]
+            new_lens = [run_len]
+            new_slots = [run_slot0]
+        else:
+            run_len = np.empty(0, np.int64)
+            new_starts, new_lens, new_slots = [], [], []
+        if n_res_ins:
+            ri = rpos[res_is_ins]
+            new_starts.append(pack_keys(batch_rank[ta[ri]], tc[ri].astype(np.int64)))
+            new_lens.append(np.ones(n_res_ins, np.int64))
+            new_slots.append(new_slot[ri].astype(np.int64))
+        def decode(key: int) -> str:
+            rank, k_ctr = unpack_key(key)
+            return make_elem_id(self.actor_table[rank], k_ctr)
+
+        if new_starts:
+            try:
+                merged_index = self.index.merge(
+                    np.concatenate(new_starts), np.concatenate(new_lens),
+                    np.concatenate(new_slots))
+            except DuplicateElemId as e:
+                raise ValueError(
+                    f"Duplicate list element ID {decode(e.key)} "
+                    f"in {self.obj_id}") from None
+        else:
+            merged_index = self.index
+
+        def resolve_parent(p_actor, p_ctr):
+            """Parent refs -> slots (HEAD_PARENT -> slot 0)."""
+            is_head = p_actor == HEAD_PARENT
+            keys = pack_keys(batch_rank[np.where(is_head, 0, p_actor)],
+                             p_ctr.astype(np.int64))
+            slots, found = merged_index.lookup(keys)
+            missing = ~(found | is_head)
+            if missing.any():
+                raise ValueError(
+                    "ins references unknown parent element "
+                    f"{decode(int(keys[np.flatnonzero(missing)[0]]))} "
+                    f"in {self.obj_id}")
+            return np.where(is_head, 0, slots)
+
+        run_parent_slot = (resolve_parent(pa[hpos], pc[hpos])
+                           if n_runs else np.empty(0, np.int64))
+
+        res_parent_slot = res_target_slot = None
+        if len(rpos):
+            res_parent_slot = np.zeros(len(rpos), np.int64)
+            if n_res_ins:
+                res_parent_slot[res_is_ins] = resolve_parent(
+                    pa[rpos[res_is_ins]], pc[rpos[res_is_ins]])
+            res_is_assign = ~res_is_ins
+            res_target_slot = np.zeros(len(rpos), np.int64)
+            if res_is_assign.any():
+                ai = rpos[res_is_assign]
+                keys = pack_keys(batch_rank[ta[ai]], tc[ai].astype(np.int64))
+                slots, found = merged_index.lookup(keys)
+                if not found.all():
+                    bad = int(keys[np.flatnonzero(~found)[0]])
+                    raise ValueError(
+                        f"assignment to unknown element {decode(bad)} "
+                        f"in {self.obj_id}")
+                res_target_slot[res_is_assign] = slots
+
+        # --- all validity checks passed: commit index + run device programs
+        self.index = merged_index
+        dense = n_runs > 0 and n_res_ins == 0  # new slots form one window
+        N = bucket(n_pairs, 256) if n_runs else 0
+        needed = self.n_elems + 1 + (N if dense else n_ins)
         out_cap = max(bucket(needed), self._cap)
-        M = bucket(n_ops, 128)
-
-        def pad(arr, fill, dtype):
-            out = np.full(M, fill, dtype)
-            out[:n_ops] = arr
-            return out
-
-        A = bucket(len(b.actor_table), 64)
-        batch_rank = np.zeros(A, np.int32)
-        batch_rank[: len(b.actor_table)] = [
-            self._actor_rank[a] for a in b.actor_table]
-        R = bucket(b.n_changes, 64)
-        row_actor = np.zeros(R, np.int32)
-        row_actor[: b.n_changes] = [self._actor_rank[a] for a in b.actors]
-        row_seq = np.zeros(R, np.int32)
-        row_seq[: b.n_changes] = b.seqs
-        K = bucket(max(len(self.conflicts), 1), 64)
-        conflict_slots = np.full(K, out_cap, np.int32)
-        if self.conflicts:
-            conflict_slots[: len(self.conflicts)] = list(self.conflicts)
-
         dev = self._ensure_dev()
-        (parent_n, ctr_n, actor_n, value_n, has_n, wa_n, ws_n, wc_n,
-         idx_keys, idx_slots, slow, tslot, stats) = ingest_round(
-            dev["parent"], dev["ctr"], dev["actor"], dev["value"],
-            dev["has_value"], dev["win_actor"], dev["win_seq"],
-            dev["win_counter"], dev["idx_keys"], dev["idx_slots"],
-            np.int32(self.n_elems),
-            jnp.asarray(pad(kind, -1, np.int8)),
-            jnp.asarray(pad(ta, 0, np.int32)),
-            jnp.asarray(pad(tc, 0, np.int32)),
-            jnp.asarray(pad(pa, 0, np.int32)),
-            jnp.asarray(pad(pc, 0, np.int32)),
-            jnp.asarray(pad(np.clip(val64, -2**31, 2**31 - 1), 0, np.int32)),
-            jnp.asarray(pad(op_row, 0, np.int32)),
-            jnp.asarray(batch_rank), jnp.asarray(row_actor),
-            jnp.asarray(row_seq), jnp.asarray(conflict_slots),
-            out_cap=out_cap)
+        tables = tuple(dev[k] for k in self._TABLE_KEYS)
 
-        # errors checked BEFORE committing: a raising batch leaves the doc
-        # untouched (matches the oracle's pre-mutation validation)
-        stats = np.asarray(stats)  # sync: kernel done
-        if stats[0]:
-            raise ValueError(
-                f"Duplicate list element ID in changes for {self.obj_id}")
-        if stats[1]:
-            raise ValueError(
-                f"ins references unknown parent element in {self.obj_id}")
-        if stats[2]:
-            raise ValueError(
-                f"assignment to unknown element in {self.obj_id}")
+        if n_runs:
+            from ..ops.ingest import expand_runs_dense
+            R = bucket(n_runs, 64)
 
-        self._dev = {
-            "parent": parent_n, "ctr": ctr_n, "actor": actor_n,
-            "value": value_n, "has_value": has_n, "win_actor": wa_n,
-            "win_seq": ws_n, "win_counter": wc_n,
-            "idx_keys": idx_keys, "idx_slots": idx_slots,
-        }
+            def padr(arr, fill, dtype=np.int32):
+                out = np.full(R, fill, dtype)
+                out[:n_runs] = arr
+                return jnp.asarray(out)
+
+            blob_vals = val64[pair_pos + 1]
+            if self.all_ascii and not (blob_vals < 128).all():
+                self.all_ascii = False
+            blob = np.zeros(N, np.int32 if blob_vals.max(initial=0) > 255
+                            else np.uint8)
+            blob[:n_pairs] = blob_vals
+            elem_base = np.full(R, N, np.int32)
+            elem_base[:n_runs] = np.cumsum(run_len) - run_len
+            run_args = (
+                padr(new_slot[hpos], 0), padr(run_parent_slot, 0),
+                padr(tc[hpos], 0), padr(batch_rank[ta[hpos]], 0),
+                padr(row_actor_rank[op_row[hpos]], 0),
+                padr(row_seq[op_row[hpos]], 0), jnp.asarray(elem_base),
+                padr(np.ones(n_runs, bool), False, bool),
+                jnp.asarray(blob), np.int32(n_pairs))
+            if dense:
+                tables = expand_runs_dense(
+                    *tables, *run_args, np.int32(self.n_elems + 1),
+                    out_cap=out_cap)
+            else:
+                tables = expand_runs(*tables, *run_args, out_cap=out_cap)
+
+        slow_np = tslot_np = None
+        if len(rpos):
+            M = bucket(len(rpos), 128)
+
+            def padm(arr, fill, dtype=np.int32):
+                out = np.full(M, fill, dtype)
+                out[: len(rpos)] = arr
+                return jnp.asarray(out)
+
+            K = bucket(max(len(self.conflicts), 1), 64)
+            conflict_slots = np.full(K, out_cap, np.int32)
+            if self.conflicts:
+                conflict_slots[: len(self.conflicts)] = list(self.conflicts)
+
+            res_vals = val64[rpos]
+            if self.all_ascii and not np.logical_or(
+                    res_kind != KIND_SET, (res_vals >= 0) & (res_vals < 128)
+            ).all():
+                self.all_ascii = False
+            out = apply_residual(
+                *tables,
+                padm(res_kind, -1, np.int8),
+                padm(np.where(res_is_ins, res_parent_slot, res_target_slot),
+                     out_cap),
+                padm(np.where(res_is_ins, new_slot[rpos], out_cap), out_cap),
+                padm(tc[rpos], 0), padm(batch_rank[ta[rpos]], 0),
+                padm(np.clip(res_vals, -2**31, 2**31 - 1), 0),
+                padm(row_actor_rank[op_row[rpos]], 0),
+                padm(row_seq[op_row[rpos]], 0),
+                jnp.asarray(conflict_slots), out_cap=out_cap)
+            tables = out[:9]
+            slow_dev, tslot_dev, n_slow = out[9], out[10], out[11]
+            if int(n_slow):
+                slow_np = np.asarray(slow_dev)[: len(rpos)]
+                tslot_np = np.asarray(tslot_dev)[: len(rpos)]
+        elif n_runs == 0:
+            return
+
+        # break chain bits of elements that lost Lamport-max-child status to
+        # this round's inserts (R-sized; keeps materialize census-free)
+        touch_p, touch_c, touch_a = [], [], []
+        if n_runs:
+            touch_p.append(run_parent_slot)
+            touch_c.append(tc[hpos].astype(np.int64))
+            touch_a.append(batch_rank[ta[hpos]])
+        if n_res_ins:
+            ri = rpos[res_is_ins]
+            touch_p.append(res_parent_slot[res_is_ins])
+            touch_c.append(tc[ri].astype(np.int64))
+            touch_a.append(batch_rank[ta[ri]])
+        if touch_p:
+            from ..ops.ingest import break_chains
+            T = bucket(sum(len(x) for x in touch_p), 64)
+
+            def padt(parts, fill):
+                arr = np.concatenate(parts)
+                out = np.full(T, fill, np.int32)
+                out[: len(arr)] = arr
+                return jnp.asarray(out)
+
+            chain_n = break_chains(
+                tables[8], tables[0], tables[1], tables[2],
+                padt(touch_p, 0), padt(touch_c, -1), padt(touch_a, -1))
+            tables = tables[:8] + (chain_n,)
+
+        self._dev = dict(zip(self._TABLE_KEYS, tables))
         self._cap = out_cap
         self.n_elems += n_ins
+        # every inserted run/element can split at most one existing segment
+        self._seg_bound += 3 * (n_runs + n_res_ins) + 2
         self._invalidate()
-        self._n_segs = int(stats[4])
 
-        if stats[5]:
-            slow_np = np.asarray(slow)[:n_ops]
-            tslot_np = np.asarray(tslot)[:n_ops]
+        if slow_np is not None:
             idxs = np.nonzero(slow_np)[0]
-            row_rank = row_actor[: b.n_changes]
+            ops_idx = rpos[idxs]
             self._apply_slow(
-                b, tslot_np[idxs], kind[idxs], val64[idxs],
-                row_rank[op_row[idxs]], np.asarray(b.seqs)[op_row[idxs]])
+                b, tslot_np[idxs], kind[ops_idx], val64[ops_idx],
+                row_actor_rank[op_row[ops_idx]], row_seq[op_row[ops_idx]])
 
     # ------------------------------------------------------------------
     # slow register path (host; matches oracle applyAssign semantics)
@@ -422,23 +585,26 @@ class DeviceTextDoc:
     # materialization (device kernels)
     # ------------------------------------------------------------------
 
-    def _materialize(self):
-        """(pos, codes, n_vis) device arrays via the condensed kernel."""
-        if self._mat is None:
-            from ..ops.ingest import bucket, materialize_text
-            dev = self._ensure_dev()
-            S = bucket(self._n_segs + 2, 64)
-            while True:
-                pos, codes, n_vis, n_segs = materialize_text(
-                    dev["parent"], dev["ctr"], dev["actor"], dev["value"],
-                    dev["has_value"], np.int32(self.n_elems), S=S)
-                n_segs = int(n_segs)
-                if n_segs + 2 <= S:
-                    break
-                # stale census (an actor remap can break chain edges): retry
-                S = bucket(n_segs + 2, 64)
-            self._n_segs = n_segs
-            self._mat = (pos, codes, n_vis)
+    def _materialize(self, with_pos: bool = True):
+        """Cached device materialization. `with_pos=False` runs the cheaper
+        codes-only kernel (enough for `text()`)."""
+        if self._mat is not None and (len(self._mat) == 5 or not with_pos):
+            return self._mat
+        from ..ops.ingest import bucket, materialize_codes, materialize_text
+        dev = self._ensure_dev()
+        fn = materialize_text if with_pos else materialize_codes
+        S = bucket(self._seg_bound + 2, 64)
+        while True:
+            out = fn(dev["parent"], dev["ctr"], dev["actor"], dev["value"],
+                     dev["has_value"], dev["chain"], np.int32(self.n_elems),
+                     S=S)
+            n_segs = int(out[-1])
+            if n_segs + 2 <= S:
+                break
+            # bound was stale (e.g. a partial-round estimate)
+            S = bucket(n_segs + 2, 64)
+        self._seg_bound = n_segs  # tighten for the next materialize
+        self._mat = out
         return self._mat
 
     def _positions(self) -> np.ndarray:
@@ -446,7 +612,7 @@ class DeviceTextDoc:
             if self.n_elems == 0:
                 self._pos_cache = np.full(1, -1, np.int32)
             elif self.use_condensed:
-                pos, _, _ = self._materialize()
+                pos = self._materialize(with_pos=True)[0]
                 self._pos_cache = np.asarray(pos)[: self.n_elems + 1]
             else:
                 self._pos_cache = self._positions_full()
@@ -490,8 +656,11 @@ class DeviceTextDoc:
         if self.n_elems == 0:
             return ""
         if self.use_condensed:
-            _, codes, n_vis = self._materialize()
-            n_vis = int(n_vis)
+            out = self._materialize(with_pos=False)
+            codes, codes_u8, n_vis = out[-4], out[-3], int(out[-2])
+            if self.all_ascii:
+                return (np.asarray(codes_u8)[:n_vis].tobytes()
+                        .decode("ascii"))
             values = np.asarray(codes)[:n_vis]
         else:
             order = self.visible_order()

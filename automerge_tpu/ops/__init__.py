@@ -1,8 +1,6 @@
-import jax
+"""Device kernels. Everything here is int32/int8/bool by design: the TPU
+emulates int64 (measured 10-30x slower sorts/searches on v5e), so 64-bit
+packed elemId keys live exclusively on the host (engine/host_index.py)."""
 
-# Packed elemId keys are (actor_rank << 32 | ctr) int64 (ops/ingest.py): the
-# device engine needs real 64-bit integers. Set before any kernel traces.
-jax.config.update("jax_enable_x64", True)
-
-from .linearize import rga_linearize  # noqa: E402,F401
-from .scan import segment_starts, visible_index  # noqa: E402,F401
+from .linearize import rga_linearize  # noqa: F401
+from .scan import segment_starts, visible_index  # noqa: F401

@@ -2,31 +2,30 @@
 
 The reference applies ops one at a time (`applyOps`/`applyInsert`/
 `applyAssign`, /root/reference/backend/op_set.js:63-283), with an
-order-statistic skip list for elemId↔index queries. Here one causally-ready
-*round* of changes — often millions of ops — is a single jitted XLA program:
+order-statistic skip list for elemId<->index queries. Here one causally-ready
+*round* of changes — often millions of ops — updates the device tables in at
+most two jitted XLA programs, all int32/int8/bool (the TPU emulates int64;
+int64 sorts and searches measured 10-30x slower on v5e):
 
-- insert slots are a prefix sum over the ins mask (op order == slot order);
-- the elemId→slot index is a sorted packed-key array, maintained by a
-  two-pointer merge (two `searchsorted` + scatters, no monolithic re-sort);
-- parent/target resolution is one batched binary search over the merged
-  index (covers in-round references: a change may target elements that
-  another change in the same round inserted);
-- LWW register fast path: single `set` on an element with an empty register
-  resolves with pure scatters. Everything else (dels, counter incs,
-  concurrent multi-writer registers, rich values) is flagged into a `slow`
-  mask the host resolves against its conflict/value-pool state — exactly the
-  reference's applyAssign semantics, just partitioned so the device does the
-  overwhelmingly common case at memory bandwidth.
+- **expand_runs**: the bulk path. Typing runs (ins+set chains with
+  consecutive counters) arrive as ~20-byte descriptors plus a value blob;
+  the kernel expands them into element-table rows with one cummax (run-of-
+  element) and a handful of scatters — O(elements) at HBM bandwidth, no
+  sort, no searchsorted. Host<->device traffic is bytes-per-run, not
+  bytes-per-op.
+- **apply_residual**: everything irregular (bare inserts, dels, incs,
+  assigns to old elements, pooled values). References are pre-resolved to
+  slot numbers on the host (engine/host_index.py), so the kernel is pure
+  scatters: place inserts, run the LWW register fast path, and flag the
+  genuinely contended registers into a `slow` mask the host resolves
+  against its conflict/value-pool state — exactly the reference's
+  applyAssign semantics, partitioned so the device does the common case.
 
-The kernel also recomputes the chain-segment census (`n_segs`) used to size
-the condensed linearization (see `materialize_text`), so materialization
-needs no extra host↔device round trip.
+`materialize_text` turns the tables into list positions + visible values via
+the chain-condensed RGA linearization (see ops/linearize.py).
 
-All shapes are static; callers bucket capacities with `bucket()` so XLA
-retraces rarely. Packed elemId keys are (actor_rank << 32 | ctr) int64 —
-actor ranks are assigned in lexicographic order of actor-id strings, so
-integer compares reproduce the reference's string tie-breaks
-(op_set.js:245,432-436).
+All shapes are static; callers bucket sizes with `bucket()` so XLA retraces
+rarely.
 """
 
 from __future__ import annotations
@@ -36,164 +35,202 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from .._common import HEAD_PARENT, KIND_DEL, KIND_INC, KIND_INS, KIND_SET
-
-# Packed-key sentinel: larger than any real (actor_rank, ctr) key.
-INF_KEY = jnp.int64(1) << 62
-_SENT32 = (1 << 31) - 1
+from .._common import KIND_DEL, KIND_INC, KIND_INS, KIND_SET  # noqa: F401
 
 
 def bucket(n: int, minimum: int = 256) -> int:
-    """Half-octave size buckets (2^k and 3·2^(k-1)): ≤25% padding waste."""
+    """Half-octave size buckets (2^k and 3·2^(k-1)): <=25% padding waste."""
     cap = minimum
     while cap < n:
         cap = cap * 3 // 2 if (cap & (cap - 1)) == 0 else (cap // 3) * 4
     return cap
 
 
-def _pack(actor: jax.Array, ctr: jax.Array) -> jax.Array:
-    return (actor.astype(jnp.int64) << 32) | ctr.astype(jnp.int64)
-
-
-def _segment_census(parent, ctr, actor, n_live, cap):
-    """Chain-contraction structure of the element table.
-
-    A slot i continues a chain iff its parent is slot i-1 and it is i-1's
-    Lamport-maximal child (so the pair is always adjacent in RGA order).
-    Returns (is_elem, seg_start, seg_head, offset, rank_incl, n_segs).
-    """
-    idx = jnp.arange(cap, dtype=jnp.int32)
-    is_elem = (idx >= 1) & (idx <= n_live)
-    pk2 = jnp.where(is_elem, _pack(ctr, actor), -1)
-    maxkey = jnp.full(cap, -1, jnp.int64).at[
-        jnp.where(is_elem, parent, cap)].max(pk2, mode="drop")
-    prev_max = jnp.concatenate([jnp.full(1, -1, jnp.int64), maxkey[:-1]])
-    chain = is_elem & (parent == idx - 1) & (idx - 1 >= 1) & (pk2 == prev_max)
-    seg_start = is_elem & ~chain
-    rank_incl = jnp.cumsum(seg_start.astype(jnp.int32))
-    seg_head = jax.lax.cummax(jnp.where(seg_start, idx, 0))
-    offset = idx - seg_head
-    n_segs = rank_incl[-1]
-    return is_elem, seg_start, seg_head, offset, rank_incl, n_segs
+def _ext(a, fill, out_cap):
+    C = a.shape[0]
+    if C >= out_cap:
+        return a
+    return jnp.concatenate([a, jnp.full(out_cap - C, fill, a.dtype)])
 
 
 @partial(jax.jit, static_argnames=("out_cap",))
-def ingest_round(
-    # document state, capacity C (all device arrays)
+def expand_runs(
+    # document tables, capacity C
     parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
-    idx_keys, idx_slots,          # sorted packed-key index, INF-padded, [C]
-    n_elems,                      # live element count (scalar i32)
-    # batch op columns, capacity M (padded with kind = -1)
-    op_kind, op_ta, op_tc, op_pa, op_pc, op_value, op_row,
-    # batch tables
-    batch_rank,                   # [A] batch actor idx -> global rank
-    row_actor, row_seq,           # [R] per-change global rank / seq
+    chain,
+    # run descriptors, capacity R (padding: len=0, elem_base=N sentinel)
+    run_head_slot, run_parent_slot, run_ctr0, run_actor, run_win_actor,
+    run_win_seq, run_elem_base, run_has_value,
+    # value blob in run-element order, capacity N
+    blob,
+    n_run_elems,                  # scalar i32: live prefix of the blob
+    *, out_cap: int,
+):
+    """Expand run descriptors into element-table rows (see module docstring).
+
+    Element j of run r lands at slot run_head_slot[r]+j with parent
+    slot-1 (or run_parent_slot for j=0), counter run_ctr0[r]+j, and — when
+    run_has_value[r] — an LWW register won by the run's change. Interior
+    elements start with their chain bit set (they are their predecessor's
+    only — hence Lamport-max — child at insert time; `break_chains` clears
+    bits as concurrent children arrive)."""
+    R = run_head_slot.shape[0]
+    N = blob.shape[0]
+
+    # run-of-element: scatter run ids at each run's first element, cummax
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    run_of = jnp.zeros(N, jnp.int32).at[run_elem_base].max(ridx, mode="drop")
+    run_of = jax.lax.cummax(run_of)
+
+    j = jnp.arange(N, dtype=jnp.int32)
+    live = j < n_run_elems
+    off = j - run_elem_base[run_of]
+    slot = run_head_slot[run_of] + off
+    tgt = jnp.where(live, slot, out_cap)        # OOB sentinel drops padding
+
+    parent_e = jnp.where(off == 0, run_parent_slot[run_of], slot - 1)
+    has = run_has_value[run_of]
+
+    parent_n = _ext(parent, 0, out_cap).at[tgt].set(parent_e, mode="drop")
+    ctr_n = _ext(ctr, 0, out_cap).at[tgt].set(run_ctr0[run_of] + off, mode="drop")
+    actor_n = _ext(actor, 0, out_cap).at[tgt].set(run_actor[run_of], mode="drop")
+    value_n = _ext(value, 0, out_cap).at[tgt].set(
+        blob.astype(value.dtype), mode="drop")
+    has_n = _ext(has_value, False, out_cap).at[tgt].set(has, mode="drop")
+    wa_n = _ext(win_actor, -1, out_cap).at[tgt].set(
+        jnp.where(has, run_win_actor[run_of], -1), mode="drop")
+    ws_n = _ext(win_seq, 0, out_cap).at[tgt].set(
+        jnp.where(has, run_win_seq[run_of], 0), mode="drop")
+    wc_n = _ext(win_counter, False, out_cap).at[tgt].set(False, mode="drop")
+    chain_n = _ext(chain, False, out_cap).at[tgt].set(off > 0, mode="drop")
+    return (parent_n, ctr_n, actor_n, value_n, has_n, wa_n, ws_n, wc_n,
+            chain_n)
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def expand_runs_dense(
+    parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
+    chain,
+    run_head_slot, run_parent_slot, run_ctr0, run_actor, run_win_actor,
+    run_win_seq, run_elem_base, run_has_value,
+    blob, n_run_elems, base_slot,
+    *, out_cap: int,
+):
+    """`expand_runs` for the common case where the round mints no residual
+    inserts, so the new elements occupy one contiguous slot window
+    [base_slot, base_slot + n_run_elems). The element columns are computed
+    densely in run-element space and written with dynamic_update_slice —
+    contiguous stores instead of 9 scatters. Caller guarantees
+    base_slot + N <= out_cap (N = padded blob length)."""
+    R = run_head_slot.shape[0]
+    N = blob.shape[0]
+
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    run_of = jnp.zeros(N, jnp.int32).at[run_elem_base].max(ridx, mode="drop")
+    run_of = jax.lax.cummax(run_of)
+
+    j = jnp.arange(N, dtype=jnp.int32)
+    off = j - run_elem_base[run_of]
+    slot = base_slot + j
+    parent_e = jnp.where(off == 0, run_parent_slot[run_of], slot - 1)
+    has = run_has_value[run_of] & (j < n_run_elems)
+
+    def dus(table, col, fill):
+        return jax.lax.dynamic_update_slice(
+            _ext(table, fill, out_cap), col.astype(table.dtype), (base_slot,))
+
+    return (dus(parent, parent_e, 0),
+            dus(ctr, run_ctr0[run_of] + off, 0),
+            dus(actor, run_actor[run_of], 0),
+            dus(value, blob, 0),
+            dus(has_value, has, False),
+            dus(win_actor, jnp.where(has, run_win_actor[run_of], -1), -1),
+            dus(win_seq, jnp.where(has, run_win_seq[run_of], 0), 0),
+            dus(win_counter, jnp.zeros(N, bool), False),
+            dus(chain, (off > 0) & (j < n_run_elems), False))
+
+
+@jax.jit
+def break_chains(chain, parent, ctr, actor, p_slots, h_ctr, h_actor):
+    """Clear the chain bit of slot p+1 for every touched parent p whose new
+    child Lamport-exceeds (ctr, actor) of p+1.
+
+    This is the incremental form of the reference's `insertionsAfter`
+    ordering (/root/reference/backend/op_set.js:440-454): slot p+1 heads its
+    own segment once it is no longer p's Lamport-maximal child. Breaks are
+    sticky — Lamport maxima only grow — so bits never need re-setting.
+    R-sized work per round instead of a full O(C) census per materialize."""
+    C = chain.shape[0]
+    q = jnp.clip(p_slots + 1, 0, C - 1)
+    cq = ctr[q]
+    aq = actor[q]
+    brk = (p_slots >= 1) & ((h_ctr > cq) | ((h_ctr == cq) & (h_actor > aq)))
+    return chain.at[jnp.where(brk, q, C)].set(False, mode="drop")
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def apply_residual(
+    # document tables (post expand_runs), capacity C == out_cap
+    parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
+    chain,
+    # residual op columns, capacity M (padding: kind=-1, slots=out_cap)
+    op_kind,        # int8
+    op_slot,        # ins: resolved parent slot (0 = head); assigns: target slot
+    op_new_slot,    # ins: assigned element slot; else out_cap
+    op_ctr, op_actor,             # ins: minted elemId (global actor rank)
+    op_value,                     # int32 (negatives = host value-pool refs)
+    op_win_actor, op_win_seq,     # the op's change (global rank, seq)
     conflict_slots,               # [K] slots with host-held conflicts (pad C)
     *, out_cap: int,
 ):
-    """Apply one causally-ready round of ops. Returns the updated state at
-    capacity `out_cap`, a slow-op mask for the host, and a stats vector
-    [dups, missing_parents, missing_targets, n_new, n_segs, n_slow]."""
-    C = parent.shape[0]
+    """Place irregular inserts and run the LWW register fast path.
+
+    Returns updated tables + (slow, tslot, n_slow): ops needing host
+    resolution (multi-writer rounds, occupied registers, dels, incs, pooled
+    values) in op order."""
     M = op_kind.shape[0]
     kind = op_kind.astype(jnp.int32)
     is_ins = kind == KIND_INS
     is_assign = (kind == KIND_SET) | (kind == KIND_DEL) | (kind == KIND_INC)
 
-    g_ta = batch_rank[jnp.clip(op_ta, 0, None)]
+    ins_idx = jnp.where(is_ins, op_new_slot, out_cap)
+    parent_n = _ext(parent, 0, out_cap).at[ins_idx].set(op_slot, mode="drop")
+    ctr_n = _ext(ctr, 0, out_cap).at[ins_idx].set(op_ctr, mode="drop")
+    actor_n = _ext(actor, 0, out_cap).at[ins_idx].set(op_actor, mode="drop")
+    value_n = _ext(value, 0, out_cap).at[ins_idx].set(0, mode="drop")
+    has_n = _ext(has_value, False, out_cap).at[ins_idx].set(False, mode="drop")
+    wa_n = _ext(win_actor, -1, out_cap).at[ins_idx].set(-1, mode="drop")
+    ws_n = _ext(win_seq, 0, out_cap).at[ins_idx].set(0, mode="drop")
+    wc_n = _ext(win_counter, False, out_cap).at[ins_idx].set(False, mode="drop")
+    chain_n = _ext(chain, False, out_cap).at[ins_idx].set(False, mode="drop")
 
-    # --- insert slot assignment: op order == slot order (prefix sum) ---
-    new_slot = n_elems + jnp.cumsum(is_ins.astype(jnp.int32))
-    n_new = jnp.sum(is_ins.astype(jnp.int32))
-
-    # --- sort new element keys (two i32 keys: no 64-bit sort) ---
-    sort_a = jnp.where(is_ins, g_ta, _SENT32)
-    sort_c = jnp.where(is_ins, op_tc, _SENT32)
-    sa, sc, sslot = jax.lax.sort((sort_a, sort_c, new_slot), num_keys=2)
-    skeys = jnp.where(sa == _SENT32, INF_KEY, _pack(sa, sc))
-
-    # --- merge the sorted new keys into the sorted index (no re-sort) ---
-    posA = jnp.arange(C, dtype=jnp.int32) + jnp.searchsorted(
-        skeys, idx_keys, side="left").astype(jnp.int32)
-    posB = jnp.arange(M, dtype=jnp.int32) + jnp.searchsorted(
-        idx_keys, skeys, side="right").astype(jnp.int32)
-    total = C + M
-    mk = jnp.full(total, INF_KEY, jnp.int64).at[posA].set(idx_keys).at[posB].set(skeys)
-    ms = jnp.zeros(total, jnp.int32).at[posA].set(idx_slots).at[posB].set(sslot)
-    n_dup = jnp.sum((mk[1:] == mk[:-1]) & (mk[:-1] < INF_KEY))
-    if total >= out_cap:
-        # all real keys fit in the prefix: live + new <= out_cap by contract
-        out_keys, out_slots = mk[:out_cap], ms[:out_cap]
-    else:
-        pad = out_cap - total
-        out_keys = jnp.concatenate([mk, jnp.full(pad, INF_KEY, jnp.int64)])
-        out_slots = jnp.concatenate([ms, jnp.zeros(pad, jnp.int32)])
-
-    # --- one binary search resolves every op's reference ---
-    is_head = op_pa == HEAD_PARENT
-    g_pa = batch_rank[jnp.clip(op_pa, 0, None)]
-    q_key = jnp.where(is_ins, _pack(g_pa, op_pc), _pack(g_ta, op_tc))
-    qi = jnp.clip(jnp.searchsorted(out_keys, q_key, side="left").astype(jnp.int32),
-                  0, out_cap - 1)
-    q_found = out_keys[qi] == q_key
-    q_slot = jnp.where(q_found, out_slots[qi], out_cap)
-
-    n_missing_parent = jnp.sum(is_ins & ~is_head & ~q_found)
-    n_missing_target = jnp.sum(is_assign & ~q_found)
-
-    # --- extend tables to out_cap and scatter the new elements ---
-    def ext(a, fill):
-        if C >= out_cap:
-            return a
-        return jnp.concatenate(
-            [a, jnp.full(out_cap - C, fill, a.dtype)])
-
-    ins_idx = jnp.where(is_ins, new_slot, out_cap)  # OOB sentinel drops pads
-    parent_n = ext(parent, 0).at[ins_idx].set(
-        jnp.where(is_head, 0, q_slot).astype(jnp.int32), mode="drop")
-    ctr_n = ext(ctr, 0).at[ins_idx].set(op_tc, mode="drop")
-    actor_n = ext(actor, 0).at[ins_idx].set(g_ta, mode="drop")
-    value_n = ext(value, 0).at[ins_idx].set(0, mode="drop")
-    has_n = ext(has_value, False).at[ins_idx].set(False, mode="drop")
-    wa_n = ext(win_actor, -1).at[ins_idx].set(-1, mode="drop")
-    ws_n = ext(win_seq, 0).at[ins_idx].set(0, mode="drop")
-    wc_n = ext(win_counter, False).at[ins_idx].set(False, mode="drop")
-
-    # --- register fast path ---
-    tslot = jnp.where(is_assign, q_slot, out_cap)
+    # register fast path: single uncontended plain set on an empty register
+    tslot = jnp.where(is_assign, op_slot, out_cap)
     tclip = jnp.clip(tslot, 0, out_cap - 1)
     counts = jnp.zeros(out_cap + 1, jnp.int32).at[
         jnp.clip(tslot, 0, out_cap)].add(is_assign.astype(jnp.int32))
     cmask = jnp.zeros(out_cap + 1, bool).at[
         jnp.clip(conflict_slots, 0, out_cap)].set(True)
-    fast = (is_assign & (kind == KIND_SET) & q_found
+    fast = (is_assign & (kind == KIND_SET)
             & (counts[tclip] == 1) & ~has_n[tclip] & (wa_n[tclip] < 0)
             & ~cmask[tclip] & (op_value >= 0))
     f_idx = jnp.where(fast, tslot, out_cap)
     value_n = value_n.at[f_idx].set(op_value, mode="drop")
     has_n = has_n.at[f_idx].set(True, mode="drop")
-    wa_n = wa_n.at[f_idx].set(row_actor[op_row], mode="drop")
-    ws_n = ws_n.at[f_idx].set(row_seq[op_row], mode="drop")
+    wa_n = wa_n.at[f_idx].set(op_win_actor, mode="drop")
+    ws_n = ws_n.at[f_idx].set(op_win_seq, mode="drop")
     wc_n = wc_n.at[f_idx].set(False, mode="drop")
+
     slow = is_assign & ~fast
-
-    # --- segment census on the post-round table (for materialization) ---
-    n_live = n_elems + n_new
-    _, _, _, _, _, n_segs = _segment_census(
-        parent_n, ctr_n, actor_n, n_live, out_cap)
-
-    stats = jnp.stack([
-        n_dup.astype(jnp.int32), n_missing_parent.astype(jnp.int32),
-        n_missing_target.astype(jnp.int32), n_new,
-        n_segs, jnp.sum(slow.astype(jnp.int32))])
+    n_slow = jnp.sum(slow.astype(jnp.int32))
     return (parent_n, ctr_n, actor_n, value_n, has_n, wa_n, ws_n, wc_n,
-            out_keys, out_slots, slow, tslot, stats)
+            chain_n, slow, tslot, n_slow)
 
 
 def _linearize_segments(parent, attach_off, ctr, actor, weight, valid):
-    """Condensed-tree linearization (see ops/linearize.py for the derivation):
-    per-parent children sort descending by (attach, ctr, actor), successor
-    chain by pointer doubling, weighted list ranking."""
+    """Condensed-tree linearization (see ops/linearize.py for the
+    derivation): per-parent children sort descending by (attach, ctr, actor),
+    successor chain by pointer doubling, weighted list ranking."""
     import math
     n = parent.shape[0]
     steps = max(1, math.ceil(math.log2(max(2, n))))
@@ -244,59 +281,102 @@ def _linearize_segments(parent, attach_off, ctr, actor, weight, valid):
     return jnp.where(is_seg, start, jnp.where(idx == 0, 0, big))
 
 
-@partial(jax.jit, static_argnames=("S",))
-def materialize_text(parent, ctr, actor, value, has_value, n_elems, *, S: int):
-    """RGA positions + visible compaction, fully on device.
+def _materialize_core(parent, ctr, actor, value, has_value, chain, n_elems,
+                      S, with_pos):
+    """RGA positions + visible compaction from the maintained chain bits.
 
-    Chain segments are contracted host-free: the census is recomputed (cheap
-    elementwise + one scatter-max), segments compact into S nodes (S is a
-    static bucket ≥ n_segs+1, known from ingest stats), the condensed tree
-    linearizes in O(S log S), and element position = segment start + offset.
-
-    Returns (pos[C], codes[C], n_vis): `pos` includes tombstones (head = -1,
-    padding > n), `codes` is visible values scattered into list order.
+    Segments (maximal chain runs, contiguous in slot space) compact into S
+    nodes (S is a static bucket >= n_segs+1, estimated by the host), the
+    condensed tree linearizes in O(S log S), and element position = segment
+    start + offset. Visible ranks come from one visibility prefix-sum in
+    slot order plus a per-segment base computed in segment space — the
+    device-native replacement for the reference skip list's index queries
+    (/root/reference/backend/skip_list.js:260-305).
     """
     C = parent.shape[0]
     idx = jnp.arange(C, dtype=jnp.int32)
-    is_elem, seg_start, seg_head, offset, rank_incl, n_segs = _segment_census(
-        parent, ctr, actor, n_elems, C)
+    is_elem = (idx >= 1) & (idx <= n_elems)
+    seg_start = is_elem & ~chain
+    rank_incl = jnp.cumsum(seg_start.astype(jnp.int32))  # node id per slot
+    seg_head = jax.lax.cummax(jnp.where(seg_start, idx, 0))
+    offset = idx - seg_head
+    n_segs = rank_incl[-1]
 
     heads = jnp.zeros(S, jnp.int32).at[
         jnp.where(seg_start, rank_incl, S)].set(idx, mode="drop")
-    node_of = rank_incl[seg_head]              # node id of each slot's segment
-    sizes = jnp.zeros(C, jnp.int32).at[seg_head].add(is_elem.astype(jnp.int32))
+
+    # segment ranks are assigned in slot order, so heads is sorted by slot
+    # and each segment's size is the gap to the next head
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    valid = sidx <= n_segs
+    live_seg = valid & (sidx >= 1)
+    next_head = jnp.where((sidx + 1 <= n_segs) & (sidx + 1 < S),
+                          heads[jnp.clip(sidx + 1, 0, S - 1)], n_elems + 1)
 
     p_slot = parent[heads]
-    node_parent = node_of[p_slot]
+    node_parent = rank_incl[p_slot]
     attach = offset[p_slot]
     nctr = ctr[heads]
     nactor = actor[heads]
-    weight = sizes[heads]
-    valid = jnp.arange(S, dtype=jnp.int32) <= n_segs
+    weight = jnp.where(live_seg, next_head - heads, 0)
     starts = _linearize_segments(node_parent, attach, nctr, nactor, weight, valid)
 
-    pos = jnp.where(is_elem, starts[node_of] + offset,
-                    jnp.where(idx == 0, -1, C + 1))
-
+    # visible ranking, segment-space: rank = (visible in segments placed
+    # earlier) + (visible before me inside my segment)
     vis = has_value & is_elem
-    slot_p = jnp.clip(pos + 1, 0, C + 1)
-    by_pos = jnp.zeros(C + 2, jnp.int32).at[slot_p].add(vis.astype(jnp.int32))
-    cum = jnp.cumsum(by_pos)
-    vis_rank = cum[slot_p] - by_pos[slot_p]
+    cumvis = jnp.cumsum(vis.astype(jnp.int32))           # inclusive
+    n_vis = cumvis[C - 1]
+    head_pre = cumvis[heads] - vis[heads].astype(jnp.int32)
+    last = jnp.clip(next_head - 1, 0, C - 1)
+    seg_vis = jnp.where(live_seg, cumvis[last] - head_pre, 0)
+
+    big = jnp.int32(C + 2)
+    order_key = jnp.where(live_seg, starts, big)
+    _, perm = jax.lax.sort((order_key, sidx), num_keys=1)
+    sv_perm = seg_vis[perm]
+    base_perm = jnp.cumsum(sv_perm) - sv_perm            # exclusive, by pos
+    rank_base = jnp.zeros(S, jnp.int32).at[perm].set(base_perm)
+    seg_base = rank_base - head_pre                      # one combined table
+    vis_rank = seg_base[rank_incl] + cumvis - vis.astype(jnp.int32)
+
     codes = jnp.full(C, -1, value.dtype).at[
         jnp.where(vis, vis_rank, C)].set(value, mode="drop")
-    # n_segs returned so the host can detect S overflow (e.g. an actor remap
-    # changed Lamport sibling order and broke chain edges) and retry bigger
-    return pos, codes, cum[C + 1], n_segs
+    codes_u8 = jnp.clip(codes, 0, 255).astype(jnp.uint8)
+
+    if with_pos:
+        pos = jnp.where(is_elem, starts[rank_incl] + offset,
+                        jnp.where(idx == 0, -1, C + 1))
+        return pos, codes, codes_u8, n_vis, n_segs
+    return codes, codes_u8, n_vis, n_segs
+
+
+@partial(jax.jit, static_argnames=("S",))
+def materialize_text(parent, ctr, actor, value, has_value, chain, n_elems,
+                     *, S: int):
+    """Full materialization: (pos, codes, codes_u8, n_vis, n_segs). `pos`
+    includes tombstones (head = -1, padding > n); `codes` is visible values
+    scattered into list order (the u8 view is the 4x-cheaper transfer when
+    the host knows all values are 7-bit). The host retries with a bigger S
+    when n_segs+1 > S."""
+    return _materialize_core(parent, ctr, actor, value, has_value, chain,
+                             n_elems, S, with_pos=True)
+
+
+@partial(jax.jit, static_argnames=("S",))
+def materialize_codes(parent, ctr, actor, value, has_value, chain, n_elems,
+                      *, S: int):
+    """Codes-only materialization for `text()`: skips the per-element
+    position gather."""
+    return _materialize_core(parent, ctr, actor, value, has_value, chain,
+                             n_elems, S, with_pos=False)
 
 
 @jax.jit
-def remap_actors(actor, win_actor, ctr, remap, n_elems):
+def remap_actors(actor, win_actor, remap, n_elems):
     """Re-rank actor ids after interning breaks lexicographic rank order.
 
-    Rebuilds the packed-key index (ranks are embedded in keys). Rare: only
-    when a new actor id sorts before an existing one.
-    """
+    Rare: only when a new actor id sorts before an existing one. The host
+    remaps its range index separately (host_index.ElemRangeIndex.remap)."""
     C = actor.shape[0]
     idx = jnp.arange(C, dtype=jnp.int32)
     live = (idx >= 1) & (idx <= n_elems)
@@ -304,9 +384,7 @@ def remap_actors(actor, win_actor, ctr, remap, n_elems):
     actor_n = jnp.where(live, remap[jnp.clip(actor, 0, hi)], actor)
     wa_n = jnp.where(win_actor >= 0, remap[jnp.clip(win_actor, 0, hi)],
                      win_actor)
-    keys = jnp.where(live, _pack(actor_n, ctr), INF_KEY)
-    sk, ss = jax.lax.sort((keys, idx), num_keys=1)
-    return actor_n, wa_n, sk, ss
+    return actor_n, wa_n
 
 
 @jax.jit

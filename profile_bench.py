@@ -1,0 +1,47 @@
+"""Stage-by-stage timing of the headline bench (not part of the suite)."""
+import os, time
+os.makedirs(".jax_cache", exist_ok=True)
+import jax
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+import numpy as np
+from bench import BASE_LEN, N_ACTORS, OPS_PER_CHANGE, base_batch, merge_batch, run_once
+from automerge_tpu.engine import DeviceTextDoc
+
+t = time.perf_counter
+def lap(msg, t0):
+    t1 = t(); print(f"{msg}: {t1-t0:.3f}s", flush=True); return t1
+
+batch = merge_batch("bench-text", N_ACTORS, OPS_PER_CHANGE, BASE_LEN)
+run_once(batch)  # warm compiles
+
+t0 = t()
+doc = DeviceTextDoc("bench-text")
+doc.apply_batch(base_batch("bench-text", BASE_LEN))
+doc.text()
+t0 = lap("base build+text (warm)", t0)
+
+# instrument second pass manually
+import automerge_tpu.engine.text_doc as td
+
+orig_ingest = td.DeviceTextDoc._ingest
+orig_mat = td.DeviceTextDoc._materialize
+
+def timed_ingest(self, b, mask):
+    t0 = t(); r = orig_ingest(self, b, mask)
+    print(f"  _ingest: {t()-t0:.3f}s", flush=True); return r
+
+def timed_mat(self, with_pos=True):
+    t0 = t(); r = orig_mat(self, with_pos)
+    if t()-t0 > 0.01: print(f"  _materialize: {t()-t0:.3f}s", flush=True)
+    return r
+
+td.DeviceTextDoc._ingest = timed_ingest
+td.DeviceTextDoc._materialize = timed_mat
+
+t0 = t()
+doc.apply_batch(batch)
+t0 = lap("apply_batch total", t0)
+text = doc.text()
+t0 = lap("text() total", t0)
+print("len", len(text))
