@@ -1,2 +1,4 @@
-from .columnar import TextChangeBatch  # noqa: F401
+from .columnar import MapChangeBatch, TextChangeBatch  # noqa: F401
+from .doc_set import DeviceTextDocSet  # noqa: F401
+from .map_doc import DeviceMapDoc  # noqa: F401
 from .text_doc import DeviceTextDoc  # noqa: F401

@@ -1,0 +1,275 @@
+"""Shared machinery for device-resident CRDT documents.
+
+Both device engines (text/list: `text_doc.py`, map/counter: `map_doc.py`)
+share the host-side orchestration the reference implements per-op in
+`backend/op_set.js`:
+
+- causal admission: changes schedule into causally-ready rounds against a
+  host vector clock, with queueing of unready changes and idempotent
+  duplicate skips (`applyQueuedOps`/`causallyReady`,
+  /root/reference/backend/op_set.js:20-27,329-345)
+- order-preserving actor interning: actor-id strings map to dense ranks in
+  lexicographic order, so int32 comparisons on device reproduce the
+  reference's string tie-breaks (op_set.js:245,432-436)
+- the slow register path: multi-writer LWW registers, counter increments,
+  and deletions resolve on the host against the conflict/value-pool state
+  (`applyAssign`, op_set.js:196-258) — the device flags them, the host
+  resolves, one scatter writes the winners back.
+
+Subclasses implement `_ingest(batch, mask)` (one causally-ready round ->
+device programs) and `_remap_device(remap)` (re-rank actor columns after an
+interning order change).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .._common import KIND_INC, KIND_SET
+
+
+class CausalDeviceDoc:
+    """Base: causal batch admission + registers + actor interning."""
+
+    batch_type = None  # subclass: columnar batch class (has .from_changes)
+
+    def __init__(self, obj_id: str):
+        self.obj_id = obj_id
+        self.actor_table: list = []           # rank -> actor id (lex-ordered)
+        self._actor_rank: dict = {}
+        self.clock: dict = {}                 # actor id -> seq
+        self._all_deps: dict = {}             # (actor, seq) -> allDeps dict
+        self.queue: list = []                 # (batch, row) not causally ready
+        self.conflicts: dict = {}             # slot -> extra surviving ops
+        self.value_pool: list = []            # rich values (non-inline)
+        self._dev: Optional[dict] = None      # device arrays (lazy)
+        self._host: Optional[dict] = None     # numpy mirrors (lazy)
+
+    # ------------------------------------------------------------------
+    # actor interning (order-preserving: rank order == lexicographic order)
+    # ------------------------------------------------------------------
+
+    def _intern_actors(self, new_actors) -> Optional[np.ndarray]:
+        """Add actors; if rank order changes, return the old->new remap."""
+        missing = sorted(set(a for a in new_actors if a not in self._actor_rank))
+        if not missing:
+            return None
+        merged = sorted(set(self.actor_table) | set(missing))
+        new_rank = {a: i for i, a in enumerate(merged)}
+        remap = None
+        if self.actor_table and merged[: len(self.actor_table)] != self.actor_table:
+            remap = np.asarray(
+                [new_rank[a] for a in self.actor_table], np.int32)
+        self.actor_table = merged
+        self._actor_rank = new_rank
+        return remap
+
+    def _apply_remap(self, remap: np.ndarray):
+        self._remap_device(remap)
+        for ops in self.conflicts.values():
+            for op in ops:
+                op["actor_rank"] = int(remap[op["actor_rank"]])
+        self._invalidate()
+
+    # ------------------------------------------------------------------
+    # causality
+    # ------------------------------------------------------------------
+
+    def _compute_all_deps(self, actor: str, seq: int, deps: dict) -> dict:
+        base = dict(deps)
+        if seq > 1:
+            base[actor] = seq - 1
+        out: dict = {}
+        for dep_actor, dep_seq in base.items():
+            if dep_seq <= 0:
+                continue
+            transitive = self._all_deps.get((dep_actor, dep_seq))
+            if transitive:
+                for a, s in transitive.items():
+                    if s > out.get(a, 0):
+                        out[a] = s
+            out[dep_actor] = dep_seq
+        return out
+
+    def _causally_covers(self, all_deps: dict, op: dict) -> bool:
+        if op["actor_rank"] < 0:
+            return True
+        return all_deps.get(self.actor_table[op["actor_rank"]], 0) >= op["seq"]
+
+    # ------------------------------------------------------------------
+    # batch application
+    # ------------------------------------------------------------------
+
+    def apply_changes(self, changes):
+        return self.apply_batch(
+            type(self).batch_type.from_changes(changes, self.obj_id))
+
+    def apply_batch(self, batch):
+        """Merge a columnar change batch (causally gated, idempotent)."""
+        # --- admission: schedule rows in causal rounds over a host clock ---
+        pending = list(range(batch.n_changes)) + self.queue
+        clock = dict(self.clock)
+        scheduled: set = set()  # (actor, seq) admitted in this call
+        rounds: list = []
+        while pending:
+            ready, not_ready = [], []
+            for item in pending:
+                b, row = (batch, item) if isinstance(item, int) else item
+                actor, seq = b.actors[row], int(b.seqs[row])
+                if seq <= clock.get(actor, 0) or (actor, seq) in scheduled:
+                    continue  # duplicate: idempotent skip (inconsistent reuse
+                    # of a seq by the same actor is not detected here; the
+                    # oracle backend raises on it)
+                deps = dict(b.deps[row])
+                deps[actor] = seq - 1
+                if all(clock.get(a, 0) >= s for a, s in deps.items()):
+                    ready.append((b, row))
+                    scheduled.add((actor, seq))
+                else:
+                    not_ready.append(item if not isinstance(item, int) else (b, row))
+            if not ready:
+                self.queue = not_ready
+                break
+            for b, row in ready:
+                clock[b.actors[row]] = int(b.seqs[row])
+            rounds.append(ready)
+            pending = not_ready
+        else:
+            self.queue = []
+
+        for ready in rounds:
+            self._apply_round(ready)
+        self._invalidate()
+        return self
+
+    def _apply_round(self, ready):
+        """Apply causally-ready (batch, row) pairs: one device program each."""
+        by_batch: dict = {}
+        for b, row in ready:
+            by_batch.setdefault(id(b), (b, []))[1].append(row)
+
+        for b, rows in by_batch.values():
+            rows_arr = np.asarray(sorted(rows), np.int32)
+            for row in rows_arr:
+                actor, seq = b.actors[row], int(b.seqs[row])
+                self._all_deps[(actor, seq)] = self._compute_all_deps(
+                    actor, seq, b.deps[row])
+                self.clock[actor] = seq
+
+            # ops may reference ids minted by actors whose own changes sit
+            # in other rounds, so intern the batch's whole actor table
+            remap = self._intern_actors(b.actor_table)
+            if remap is not None:
+                self._apply_remap(remap)
+
+            if len(rows_arr) == b.n_changes:
+                mask = slice(None)  # whole batch ready: no filtering needed
+            else:
+                mask = np.isin(b.op_change, rows_arr)
+            if b.n_ops:
+                self._ingest(b, mask)
+
+    # ------------------------------------------------------------------
+    # slow register path (host; matches oracle applyAssign semantics)
+    # ------------------------------------------------------------------
+
+    def _apply_slow(self, b, slots, kinds, values, actor_ranks, seqs,
+                    slot_cap: int):
+        """Resolve non-fast assigns against gathered register state."""
+        import jax.numpy as jnp
+        from ..ops.ingest import bucket, gather_registers, scatter_registers
+
+        dev = self._dev
+        uniq = np.unique(slots)
+        S = bucket(len(uniq), 64)
+        slots_p = np.full(S, slot_cap, np.int32)
+        slots_p[: len(uniq)] = uniq
+        g_v, g_h, g_wa, g_ws, g_wc = (
+            np.asarray(x) for x in gather_registers(
+                dev["value"], dev["has_value"], dev["win_actor"],
+                dev["win_seq"], dev["win_counter"], jnp.asarray(slots_p)))
+
+        regs: dict = {}
+        for i, s in enumerate(uniq):
+            s = int(s)
+            ops = []
+            if g_h[i] or g_wa[i] >= 0:
+                ops.append({"actor_rank": int(g_wa[i]), "seq": int(g_ws[i]),
+                            "value": int(g_v[i]), "counter": bool(g_wc[i])})
+            ops.extend(self.conflicts.get(s, []))
+            regs[s] = ops
+
+        for j in range(len(slots)):
+            slot = int(slots[j])
+            kind = int(kinds[j])
+            value = int(values[j])
+            actor_rank = int(actor_ranks[j])
+            seq = int(seqs[j])
+            actor_id = self.actor_table[actor_rank]
+            all_deps = self._all_deps.get((actor_id, seq), {})
+            ops = regs[slot]
+
+            if kind == KIND_INC:
+                for op in ops:
+                    if op["counter"] and self._causally_covers(all_deps, op):
+                        entry = self.value_pool[-op["value"] - 1]
+                        self.value_pool.append(
+                            {"value": entry["value"] + value,
+                             "datatype": "counter"})
+                        op["value"] = -len(self.value_pool)
+                continue
+
+            surviving = [op for op in ops
+                         if not self._causally_covers(all_deps, op)]
+            if kind == KIND_SET:
+                pooled, counter = value, False
+                if value < 0:
+                    entry = b.value_pool[-value - 1]
+                    self.value_pool.append(entry)
+                    pooled = -len(self.value_pool)
+                    counter = entry.get("datatype") == "counter"
+                surviving.append({"actor_rank": actor_rank, "seq": seq,
+                                  "value": pooled, "counter": counter})
+            regs[slot] = surviving
+
+        # finalize: winner = highest actor rank; extras become conflicts
+        w_v = np.zeros(S, np.int32)
+        w_h = np.zeros(S, bool)
+        w_wa = np.full(S, -1, np.int32)
+        w_ws = np.zeros(S, np.int32)
+        w_wc = np.zeros(S, bool)
+        for i, s in enumerate(uniq):
+            s = int(s)
+            ops = sorted(regs[s], key=lambda o: o["actor_rank"], reverse=True)
+            if ops:
+                w = ops[0]
+                w_v[i], w_h[i] = w["value"], True
+                w_wa[i], w_ws[i], w_wc[i] = w["actor_rank"], w["seq"], w["counter"]
+            if ops[1:]:
+                self.conflicts[s] = ops[1:]
+            else:
+                self.conflicts.pop(s, None)
+
+        out = scatter_registers(
+            dev["value"], dev["has_value"], dev["win_actor"], dev["win_seq"],
+            dev["win_counter"], jnp.asarray(slots_p), jnp.asarray(w_v),
+            jnp.asarray(w_h), jnp.asarray(w_wa), jnp.asarray(w_ws),
+            jnp.asarray(w_wc))
+        dev["value"], dev["has_value"], dev["win_actor"], dev["win_seq"], \
+            dev["win_counter"] = out
+        self._invalidate()
+
+    # ------------------------------------------------------------------
+    # subclass hooks
+    # ------------------------------------------------------------------
+
+    def _ingest(self, batch, mask):
+        raise NotImplementedError
+
+    def _remap_device(self, remap: np.ndarray):
+        raise NotImplementedError
+
+    def _invalidate(self):
+        self._host = None

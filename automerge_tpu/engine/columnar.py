@@ -21,6 +21,95 @@ from .._common import (HEAD_PARENT, KIND_DEL, KIND_INC, KIND_INS,  # noqa: F401
 
 
 @dataclass
+class MapChangeBatch:
+    """A batch of changes targeting one map object, columnar.
+
+    Values: plain non-negative ints < 2^31 encode inline in `op_value`;
+    everything else (strings, bools, floats, negatives, counters) goes in
+    `value_pool` and is referenced by a negative index."""
+
+    obj_id: str
+    actors: list
+    seqs: np.ndarray            # int32[n_changes]
+    deps: list
+    messages: list
+    op_change: np.ndarray       # int32[n_ops] -> change row
+    op_kind: np.ndarray         # int8[n_ops] (set/del/inc)
+    op_key: np.ndarray          # int32[n_ops] -> batch key table
+    op_value: np.ndarray        # int64[n_ops]
+    key_table: list = field(default_factory=list)
+    value_pool: list = field(default_factory=list)
+
+    @property
+    def n_changes(self) -> int:
+        return len(self.actors)
+
+    @property
+    def n_ops(self) -> int:
+        return len(self.op_kind)
+
+    @property
+    def actor_table(self) -> list:
+        """Actors to intern (map ops carry no elemId actor refs)."""
+        return self.actors
+
+    @classmethod
+    def from_changes(cls, changes, obj_id: str) -> "MapChangeBatch":
+        key_id: dict = {}
+        key_table: list = []
+        value_pool: list = []
+
+        def intern_key(key: str) -> int:
+            if key not in key_id:
+                key_id[key] = len(key_table)
+                key_table.append(key)
+            return key_id[key]
+
+        actors, seqs, deps, messages = [], [], [], []
+        cols = {k: [] for k in ("change", "kind", "key", "val")}
+        for row, change in enumerate(changes):
+            actors.append(change["actor"])
+            seqs.append(change["seq"])
+            deps.append(change.get("deps", {}))
+            messages.append(change.get("message"))
+            for op in change["ops"]:
+                if op.get("obj") != obj_id:
+                    raise ValueError(
+                        f"op targets {op.get('obj')}, batch is for {obj_id}")
+                action = op["action"]
+                if action not in ("set", "del", "inc"):
+                    raise ValueError(
+                        f"unsupported map op action: {action}")
+                cols["change"].append(row)
+                cols["kind"].append(
+                    {"set": KIND_SET, "del": KIND_DEL, "inc": KIND_INC}[action])
+                cols["key"].append(intern_key(op["key"]))
+                if action == "set":
+                    value = op["value"]
+                    if (isinstance(value, int) and not isinstance(value, bool)
+                            and 0 <= value < 2**31 and not op.get("datatype")):
+                        cols["val"].append(value)
+                    else:
+                        value_pool.append(
+                            {"value": value, "datatype": op.get("datatype")})
+                        cols["val"].append(-len(value_pool))
+                elif action == "inc":
+                    cols["val"].append(op["value"])
+                else:
+                    cols["val"].append(0)
+
+        return cls(
+            obj_id=obj_id, actors=actors,
+            seqs=np.asarray(seqs, np.int32), deps=deps, messages=messages,
+            op_change=np.asarray(cols["change"], np.int32),
+            op_kind=np.asarray(cols["kind"], np.int8),
+            op_key=np.asarray(cols["key"], np.int32),
+            op_value=np.asarray(cols["val"], np.int64),
+            key_table=key_table, value_pool=value_pool,
+        )
+
+
+@dataclass
 class TextChangeBatch:
     """A batch of changes targeting one list/text object, columnar."""
 

@@ -1,0 +1,366 @@
+"""Vmapped multi-document text engine: one device program for a whole DocSet.
+
+The reference merges a DocSet one document at a time
+(/root/reference/src/doc_set.js:29-37 — a JS loop calling the backend per
+doc). On TPU the per-call dispatch dominates for small docs, so this engine
+stacks every document's element tables into (docs, capacity) arrays and runs
+ingestion/materialization as ONE vmapped program over the doc axis — the
+data-parallel "doc" dimension of the mesh design (parallel/mesh.py shards
+the same stacked tables over devices).
+
+Scope: the vmapped fast path covers rounds that are *runs-only* and fully
+causally ready (the overwhelming bulk-sync shape). A document whose batch
+needs the general machinery (residual ops, queueing, conflicts) permanently
+*graduates* to its own `DeviceTextDoc` built from its table slices —
+correctness never depends on the fast path applying.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .._common import HEAD_PARENT, make_elem_id
+from .columnar import TextChangeBatch
+from .host_index import (DuplicateElemId, ElemRangeIndex, pack_keys,
+                         unpack_key)
+from .runs import detect_runs
+from .text_doc import DeviceTextDoc
+
+
+class _DocMeta:
+    __slots__ = ("clock", "actor_table", "actor_rank", "index", "n_elems",
+                 "seg_bound", "all_ascii", "all_deps")
+
+    def __init__(self):
+        self.clock: dict = {}
+        self.actor_table: list = []
+        self.actor_rank: dict = {}
+        self.index = ElemRangeIndex()
+        self.n_elems = 0
+        self.seg_bound = 2
+        self.all_ascii = True
+        self.all_deps: dict = {}   # (actor, seq) -> transitive deps clock
+
+
+class DeviceTextDocSet:
+    """A set of text documents merged as one stacked device program."""
+
+    def __init__(self, obj_ids, capacity: int = 1024):
+        from ..ops.ingest import bucket
+        self.obj_ids = list(obj_ids)
+        self._idx = {o: i for i, o in enumerate(self.obj_ids)}
+        self._meta = [_DocMeta() for _ in self.obj_ids]
+        self._cap = bucket(max(capacity, 16))
+        self._dev = None                      # stacked (D, cap) tables
+        self._overlay: dict = {}              # doc idx -> DeviceTextDoc
+        self._codes_cache = None
+
+    @property
+    def n_docs(self) -> int:
+        return len(self.obj_ids)
+
+    _TABLE_KEYS = DeviceTextDoc._TABLE_KEYS
+
+    def _ensure_dev(self):
+        if self._dev is None:
+            import jax.numpy as jnp
+            D, cap = self.n_docs, self._cap
+            self._dev = {
+                "parent": jnp.zeros((D, cap), jnp.int32),
+                "ctr": jnp.zeros((D, cap), jnp.int32),
+                "actor": jnp.zeros((D, cap), jnp.int32),
+                "value": jnp.zeros((D, cap), jnp.int32),
+                "has_value": jnp.zeros((D, cap), bool),
+                "win_actor": jnp.full((D, cap), -1, jnp.int32),
+                "win_seq": jnp.zeros((D, cap), jnp.int32),
+                "win_counter": jnp.zeros((D, cap), bool),
+                "chain": jnp.zeros((D, cap), bool),
+            }
+        return self._dev
+
+    # ------------------------------------------------------------------
+
+    def _graduate(self, d: int) -> DeviceTextDoc:
+        """Extract doc d into its own DeviceTextDoc (general path)."""
+        if d in self._overlay:
+            return self._overlay[d]
+        meta = self._meta[d]
+        doc = DeviceTextDoc(self.obj_ids[d], capacity=self._cap)
+        dev = self._ensure_dev()
+        doc._dev = {k: dev[k][d] for k in self._TABLE_KEYS}
+        doc._cap = self._cap
+        doc.n_elems = meta.n_elems
+        doc.index = meta.index
+        doc.clock = dict(meta.clock)
+        doc.actor_table = list(meta.actor_table)
+        doc._actor_rank = dict(meta.actor_rank)
+        doc._all_deps = dict(meta.all_deps)
+        doc._seg_bound = meta.seg_bound
+        doc.all_ascii = meta.all_ascii
+        self._overlay[d] = doc
+        return doc
+
+    def doc(self, obj_id: str) -> DeviceTextDoc:
+        """The general-path engine for one document (graduates it)."""
+        return self._graduate(self._idx[obj_id])
+
+    def apply_batches(self, batches: dict):
+        """Merge {obj_id: TextChangeBatch}: vmapped fast path for runs-only
+        ready batches; general per-doc engine otherwise."""
+        import jax.numpy as jnp
+        from ..ops.ingest import bucket
+        from ..ops.ingest import expand_runs_dense
+
+        self._codes_cache = None
+        fast: list = []
+        for obj_id, batch in batches.items():
+            d = self._idx[obj_id]
+            if d in self._overlay:
+                self._overlay[d].apply_batch(batch)
+                continue
+            plan_pack = self._plan_fast(d, batch)
+            if plan_pack == "skip":
+                continue
+            if plan_pack is None:
+                self._graduate(d).apply_batch(batch)
+            else:
+                fast.append(plan_pack)
+        if not fast:
+            return self
+
+        # --- commit staged per-doc state now that every plan succeeded ---
+        for p in fast:
+            meta = self._meta[p["d"]]
+            meta.index = p["staged_index"]
+            meta.clock.update(p["staged_clock"])
+            meta.all_deps.update(p["staged_all_deps"])
+            meta.all_ascii = meta.all_ascii and p["staged_ascii"]
+            if p["staged_actors"] is not None:
+                meta.actor_table, meta.actor_rank = p["staged_actors"]
+
+        # --- stack run descriptors over the doc axis and expand once ---
+        R = bucket(max(p["n_runs"] for p in fast), 64)
+        N = bucket(max(p["n_pairs"] for p in fast), 256)
+        # every doc's write window [n_elems+1, n_elems+1+N) must fit: the
+        # dense expansion writes the whole padded window for ALL rows
+        # (inactive docs write only past their live region)
+        need = max(m.n_elems for m in self._meta) + 1 + N
+        out_cap = max(bucket(need), self._cap)
+        D = self.n_docs
+
+        cols = {k: np.zeros((D, R), np.int32) for k in
+                ("head_slot", "parent_slot", "ctr0", "actor", "win_actor",
+                 "win_seq")}
+        elem_base = np.full((D, R), N, np.int32)
+        has_val = np.zeros((D, R), bool)
+        blob = np.zeros((D, N), np.int32)
+        n_pairs_v = np.zeros(D, np.int32)
+        # inactive rows write garbage past their live region (harmless)
+        base_slot_v = np.asarray([m.n_elems + 1 for m in self._meta],
+                                 np.int32)
+        for p in fast:
+            d, nr = p["d"], p["n_runs"]
+            for k in cols:
+                cols[k][d, :nr] = p[k]
+            elem_base[d, :nr] = p["elem_base"]
+            has_val[d, :nr] = True
+            blob[d, : p["n_pairs"]] = p["blob"]
+            n_pairs_v[d] = p["n_pairs"]
+
+        dev = self._ensure_dev()
+        tables = tuple(dev[k] for k in self._TABLE_KEYS)
+        import jax
+        expanded = jax.vmap(
+            lambda *a: expand_runs_dense(*a, out_cap=out_cap))(
+            *tables,
+            jnp.asarray(cols["head_slot"]), jnp.asarray(cols["parent_slot"]),
+            jnp.asarray(cols["ctr0"]), jnp.asarray(cols["actor"]),
+            jnp.asarray(cols["win_actor"]), jnp.asarray(cols["win_seq"]),
+            jnp.asarray(elem_base), jnp.asarray(has_val),
+            jnp.asarray(blob), jnp.asarray(n_pairs_v),
+            jnp.asarray(base_slot_v))
+        self._dev = dict(zip(self._TABLE_KEYS, expanded))
+        self._cap = out_cap
+
+        # chain breaks for touched parents (stacked, one scatter)
+        touches = [(p["d"], p["parent_slot"], p["ctr0"], p["actor"])
+                   for p in fast if p["n_breaks"]]
+        if touches:
+            from ..ops.ingest import break_chains
+            T = bucket(max(len(t[1]) for t in touches), 64)
+            tp = np.zeros((D, T), np.int32)
+            tc_ = np.full((D, T), -1, np.int32)
+            ta_ = np.full((D, T), -1, np.int32)
+            for d, ps, cs, as_ in touches:
+                tp[d, : len(ps)] = ps
+                tc_[d, : len(ps)] = cs
+                ta_[d, : len(ps)] = as_
+            chain_n = jax.vmap(break_chains)(
+                self._dev["chain"], self._dev["parent"], self._dev["ctr"],
+                self._dev["actor"], jnp.asarray(tp), jnp.asarray(tc_),
+                jnp.asarray(ta_))
+            self._dev["chain"] = chain_n
+
+        for p in fast:
+            meta = self._meta[p["d"]]
+            meta.n_elems += p["n_pairs"]
+            meta.seg_bound += 3 * p["n_runs"] + 2
+        return self
+
+    def _plan_fast(self, d: int, b: TextChangeBatch):
+        """Host planning for the vmapped path; None -> general engine.
+
+        Pure: all state updates are staged in the returned pack and
+        committed by apply_batches only after every doc's plan succeeds."""
+        meta = self._meta[d]
+        # single fully-ready round? (idempotently drop all-duplicate batches)
+        clock = dict(meta.clock)
+        dups = 0
+        for row in range(b.n_changes):
+            actor, seq = b.actors[row], int(b.seqs[row])
+            deps = dict(b.deps[row])
+            deps[actor] = seq - 1
+            if seq <= clock.get(actor, 0):
+                dups += 1
+                continue
+            if not all(clock.get(a, 0) >= s for a, s in deps.items()
+                       if a != actor):
+                return None
+            if clock.get(actor, 0) != seq - 1:
+                return None
+        if dups == b.n_changes:
+            return "skip"         # redelivery of an applied batch: no-op
+        if dups:
+            return None           # partial duplicate: general path filters
+        plan = detect_runs(b.op_kind, b.op_target_actor, b.op_target_ctr,
+                           b.op_parent_actor, b.op_parent_ctr, b.op_value,
+                           b.op_change, meta.n_elems)
+        if len(plan.rpos) or plan.n_runs == 0:
+            return None
+
+        # intern actors; order change would need a remap -> general path
+        staged_actors = None
+        actor_rank = meta.actor_rank
+        missing = sorted(set(a for a in b.actor_table
+                             if a not in meta.actor_rank))
+        if missing:
+            merged = sorted(set(meta.actor_table) | set(missing))
+            if meta.actor_table and \
+                    merged[: len(meta.actor_table)] != meta.actor_table:
+                return None
+            actor_rank = {a: i for i, a in enumerate(merged)}
+            staged_actors = (merged, actor_rank)
+
+        batch_rank = np.asarray(
+            [actor_rank[a] for a in b.actor_table], np.int64)
+        row_rank = np.asarray([actor_rank[a] for a in b.actors], np.int32)
+        row_seq = np.asarray(b.seqs, np.int32)
+        hpos = plan.hpos
+        ta, tc = b.op_target_actor, b.op_target_ctr
+        pa, pc = b.op_parent_actor, b.op_parent_ctr
+
+        try:
+            staged_index = meta.index.merge(
+                pack_keys(batch_rank[ta[hpos]], tc[hpos].astype(np.int64)),
+                plan.run_len, plan.new_slot[hpos].astype(np.int64))
+        except DuplicateElemId as e:
+            rank, k_ctr = unpack_key(e.key)
+            table = staged_actors[0] if staged_actors else meta.actor_table
+            raise ValueError(
+                f"Duplicate list element ID "
+                f"{make_elem_id(table[rank], k_ctr)} "
+                f"in {self.obj_ids[d]}") from None
+        is_head = pa[hpos] == HEAD_PARENT
+        keys = pack_keys(batch_rank[np.where(is_head, 0, pa[hpos])],
+                         pc[hpos].astype(np.int64))
+        slots, found = staged_index.lookup(keys)
+        if not (found | is_head).all():
+            raise ValueError(
+                f"ins references unknown parent element in {self.obj_ids[d]}")
+        parent_slot = np.where(is_head, 0, slots)
+
+        # transitive dependency closure per change (the graduated doc's slow
+        # path needs it to judge causal coverage — readiness guarantees all
+        # referenced (actor, seq) entries are pre-batch)
+        staged_all_deps = {}
+        for row in range(b.n_changes):
+            actor, seq = b.actors[row], int(b.seqs[row])
+            base = dict(b.deps[row])
+            if seq > 1:
+                base[actor] = seq - 1
+            closure: dict = {}
+            for dep_actor, dep_seq in base.items():
+                if dep_seq <= 0:
+                    continue
+                for a, s in meta.all_deps.get((dep_actor, dep_seq),
+                                              {}).items():
+                    if s > closure.get(a, 0):
+                        closure[a] = s
+                closure[dep_actor] = dep_seq
+            staged_all_deps[(actor, seq)] = closure
+
+        blob = b.op_value[plan.pair_pos + 1]
+        return {
+            "d": d, "n_runs": plan.n_runs, "n_pairs": plan.n_pairs,
+            "head_slot": plan.new_slot[hpos], "parent_slot": parent_slot,
+            "ctr0": tc[hpos], "actor": batch_rank[ta[hpos]],
+            "win_actor": row_rank[b.op_change[hpos]],
+            "win_seq": row_seq[b.op_change[hpos]],
+            "elem_base": np.cumsum(plan.run_len) - plan.run_len,
+            "blob": blob.astype(np.int32),
+            "n_breaks": int((~is_head).sum()),
+            "staged_index": staged_index,
+            "staged_clock": {b.actors[r]: int(b.seqs[r])
+                             for r in range(b.n_changes)},
+            "staged_all_deps": staged_all_deps,
+            "staged_ascii": bool((blob < 128).all()),
+            "staged_actors": staged_actors,
+        }
+
+    # ------------------------------------------------------------------
+
+    def texts(self) -> dict:
+        """Materialize every document: one vmapped program + one fetch."""
+        import jax
+        import numpy as np
+        from ..ops.ingest import bucket, materialize_codes
+
+        out = {}
+        stacked_idx = [d for d in range(self.n_docs)
+                       if d not in self._overlay]
+        if stacked_idx:
+            if self._codes_cache is None:
+                dev = self._ensure_dev()
+                S = bucket(max(self._meta[d].seg_bound
+                               for d in stacked_idx) + 2, 64)
+                n_el = np.asarray([m.n_elems for m in self._meta], np.int32)
+                import jax.numpy as jnp
+                codes, codes_u8, n_vis, n_segs = jax.vmap(
+                    lambda *a: materialize_codes(*a, S=S))(
+                    dev["parent"], dev["ctr"], dev["actor"], dev["value"],
+                    dev["has_value"], dev["chain"], jnp.asarray(n_el))
+                n_segs_np = np.asarray(n_segs)
+                if (n_segs_np + 2 > S).any():
+                    S = bucket(int(n_segs_np.max()) + 2, 64)
+                    codes, codes_u8, n_vis, n_segs = jax.vmap(
+                        lambda *a: materialize_codes(*a, S=S))(
+                        dev["parent"], dev["ctr"], dev["actor"],
+                        dev["value"], dev["has_value"], dev["chain"],
+                        jnp.asarray(n_el))
+                    n_segs_np = np.asarray(n_segs)
+                for d in stacked_idx:
+                    self._meta[d].seg_bound = int(n_segs_np[d])
+                all_ascii = all(self._meta[d].all_ascii for d in stacked_idx)
+                fetched = np.asarray(codes_u8 if all_ascii else codes)
+                self._codes_cache = (fetched, np.asarray(n_vis), all_ascii)
+            fetched, n_vis, all_ascii = self._codes_cache
+            for d in stacked_idx:
+                row = fetched[d][: n_vis[d]]
+                if all_ascii:
+                    out[self.obj_ids[d]] = row.tobytes().decode("ascii")
+                else:
+                    out[self.obj_ids[d]] = "".join(
+                        chr(v) for v in row.astype(np.uint32))
+        for d, doc in self._overlay.items():
+            out[self.obj_ids[d]] = doc.text()
+        return out

@@ -1,0 +1,181 @@
+"""Device-resident map/counter CRDT document.
+
+The map analogue of `DeviceTextDoc`: key registers live as padded columnar
+tables in device memory and whole batches of changes merge per causally-ready
+round in one jitted program (`ops/ingest.py:apply_map_round`). This replaces
+the reference's per-op map reconciliation (`applyAssign` on map objects +
+Immutable.js `byObject` maps, /root/reference/backend/op_set.js:196-258) with
+scatter-based LWW resolution over interned key slots:
+
+- keys intern to dense int32 slots (host dictionary; slot = register index)
+- the device fast path resolves empty-register sets and same-actor
+  overwrites at memory bandwidth; concurrent multi-writer rounds, deletes,
+  counter increments, and pooled (non-inline-int) values flow through the
+  shared host slow path (engine/base.py) with identical semantics to the
+  oracle: winner = highest actor id, concurrent survivors are conflicts,
+  `inc` folds into causally-visible counter values
+
+`vmap`-style batching over many documents comes from the DocSet layer
+stacking per-doc batches; each doc's round is one device call either way.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import CausalDeviceDoc
+from .columnar import MapChangeBatch
+
+
+class DeviceMapDoc(CausalDeviceDoc):
+    """One map object: interned keys -> LWW registers on device."""
+
+    batch_type = MapChangeBatch
+
+    def __init__(self, obj_id: str = "map", capacity: int = 256):
+        from ..ops.ingest import bucket
+        super().__init__(obj_id)
+        self.key_table: list = []             # slot -> key string
+        self._key_slot: dict = {}
+        self._cap = bucket(max(capacity, 16))
+
+    # ------------------------------------------------------------------
+    # device state
+    # ------------------------------------------------------------------
+
+    def _ensure_dev(self) -> dict:
+        if self._dev is None:
+            import jax.numpy as jnp
+            cap = self._cap
+            self._dev = {
+                "value": jnp.zeros(cap, jnp.int32),
+                "has_value": jnp.zeros(cap, bool),
+                "win_actor": jnp.full(cap, -1, jnp.int32),
+                "win_seq": jnp.zeros(cap, jnp.int32),
+                "win_counter": jnp.zeros(cap, bool),
+            }
+        return self._dev
+
+    def _mirrors(self) -> dict:
+        if self._host is None:
+            dev = self._ensure_dev()
+            self._host = {k: np.asarray(dev[k])
+                          for k in ("value", "has_value", "win_counter")}
+        return self._host
+
+    def _remap_device(self, remap: np.ndarray):
+        import jax.numpy as jnp
+        from ..ops.ingest import remap_ranks
+        dev = self._ensure_dev()
+        dev["win_actor"] = remap_ranks(dev["win_actor"], jnp.asarray(remap))
+
+    def _intern_keys(self, keys) -> np.ndarray:
+        for k in keys:
+            if k not in self._key_slot:
+                self._key_slot[k] = len(self.key_table)
+                self.key_table.append(k)
+        return np.asarray([self._key_slot[k] for k in keys], np.int32)
+
+    # ------------------------------------------------------------------
+    # round ingestion
+    # ------------------------------------------------------------------
+
+    def _ingest(self, b: MapChangeBatch, mask):
+        import jax.numpy as jnp
+        from ..ops.ingest import apply_map_round, bucket
+
+        kind = np.ascontiguousarray(b.op_kind[mask])
+        n_ops = len(kind)
+        if n_ops == 0:
+            return
+        op_key = b.op_key[mask]
+        val64 = b.op_value[mask]
+        op_row = b.op_change[mask]
+
+        key_map = self._intern_keys(b.key_table)   # batch kid -> global slot
+        slot = key_map[op_key]
+        row_actor_rank = np.asarray(
+            [self._actor_rank[a] for a in b.actors], np.int32)
+        row_seq = np.asarray(b.seqs, np.int32)
+
+        out_cap = max(bucket(len(self.key_table)), self._cap)
+        dev = self._ensure_dev()
+        M = bucket(n_ops, 128)
+
+        def padm(arr, fill, dtype=np.int32):
+            out = np.full(M, fill, dtype)
+            out[:n_ops] = arr
+            return jnp.asarray(out)
+
+        K = bucket(max(len(self.conflicts), 1), 64)
+        conflict_slots = np.full(K, out_cap, np.int32)
+        if self.conflicts:
+            conflict_slots[: len(self.conflicts)] = list(self.conflicts)
+
+        (value_n, has_n, wa_n, ws_n, wc_n, slow_dev, tslot_dev,
+         n_slow) = apply_map_round(
+            dev["value"], dev["has_value"], dev["win_actor"],
+            dev["win_seq"], dev["win_counter"],
+            padm(kind, -1, np.int8), padm(slot, out_cap),
+            padm(np.clip(val64, -2**31, 2**31 - 1), 0),
+            padm(row_actor_rank[op_row], 0), padm(row_seq[op_row], 0),
+            jnp.asarray(conflict_slots), out_cap=out_cap)
+
+        self._dev = {"value": value_n, "has_value": has_n, "win_actor": wa_n,
+                     "win_seq": ws_n, "win_counter": wc_n}
+        self._cap = out_cap
+        self._host = None
+
+        if int(n_slow):
+            slow_np = np.asarray(slow_dev)[:n_ops]
+            tslot_np = np.asarray(tslot_dev)[:n_ops]
+            idxs = np.nonzero(slow_np)[0]
+            self._apply_slow(
+                b, tslot_np[idxs], kind[idxs], val64[idxs],
+                row_actor_rank[op_row[idxs]], row_seq[op_row[idxs]],
+                slot_cap=self._cap)
+
+    # ------------------------------------------------------------------
+    # accessors
+    # ------------------------------------------------------------------
+
+    def _decode(self, v: int):
+        if v >= 0:
+            return int(v)
+        return self.value_pool[-v - 1]["value"]
+
+    def to_dict(self) -> dict:
+        h = self._mirrors()
+        out = {}
+        for key, slot in self._key_slot.items():
+            if h["has_value"][slot]:
+                out[key] = self._decode(int(h["value"][slot]))
+        return out
+
+    def get(self, key: str, default=None):
+        slot = self._key_slot.get(key)
+        if slot is None:
+            return default
+        h = self._mirrors()
+        if not h["has_value"][slot]:
+            return default
+        return self._decode(int(h["value"][slot]))
+
+    def conflicts_for(self, key: str):
+        slot = self._key_slot.get(key)
+        extras = self.conflicts.get(slot) if slot is not None else None
+        if not extras:
+            return None
+        return {self.actor_table[op["actor_rank"]]: self._decode(op["value"])
+                for op in extras}
+
+    def __len__(self) -> int:
+        h = self._mirrors()
+        n = len(self.key_table)
+        return int(h["has_value"][:n].sum())
+
+    def __contains__(self, key: str) -> bool:
+        slot = self._key_slot.get(key)
+        if slot is None:
+            return False
+        return bool(self._mirrors()["has_value"][slot])

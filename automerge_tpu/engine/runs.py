@@ -1,0 +1,87 @@
+"""Typing-run detection over columnar op batches (host, vectorized numpy).
+
+A *run* is an INS immediately followed by its SET, chained so each next INS
+continues the previous element with a consecutive counter — the shape every
+text editor produces. Runs are the engine's unit of bulk transfer: ~20-byte
+descriptors + a value blob instead of 2 op rows per character
+(ops/ingest.py:expand_runs*). Shared by the single-doc engine
+(text_doc.DeviceTextDoc) and the vmapped doc-set engine
+(doc_set.DeviceTextDocSet).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .._common import KIND_INS, KIND_SET
+
+
+@dataclass
+class RoundPlan:
+    """Run/residual partition of one causally-ready round's op columns."""
+
+    n_ops: int
+    is_ins: np.ndarray       # bool[n_ops]
+    n_ins: int
+    new_slot: np.ndarray     # int64[n_ops] (0 where not ins)
+    hpos: np.ndarray         # run-head op positions
+    pair_pos: np.ndarray     # positions of all run INS ops (op order)
+    run_len: np.ndarray      # int64[n_runs]
+    rpos: np.ndarray         # residual op positions
+    res_is_ins: np.ndarray   # bool over rpos
+
+    @property
+    def n_runs(self) -> int:
+        return len(self.hpos)
+
+    @property
+    def n_pairs(self) -> int:
+        return len(self.pair_pos)
+
+    @property
+    def n_res_ins(self) -> int:
+        return int(self.res_is_ins.sum())
+
+
+def detect_runs(kind, ta, tc, pa, pc, val64, op_row, base_elems: int
+                ) -> RoundPlan:
+    """Partition one round's op columns into runs and residual ops.
+
+    `base_elems` is the document's live element count before this round;
+    inserted elements take slots base_elems+1.. in op order."""
+    n_ops = len(kind)
+    is_ins = kind == KIND_INS
+    n_ins = int(is_ins.sum())
+    new_slot = np.where(is_ins, base_elems + np.cumsum(is_ins), 0)
+
+    is_pair = np.zeros(n_ops, bool)
+    if n_ops >= 2:
+        is_pair[:-1] = ((kind[:-1] == KIND_INS) & (kind[1:] == KIND_SET)
+                        & (op_row[1:] == op_row[:-1])
+                        & (ta[1:] == ta[:-1]) & (tc[1:] == tc[:-1])
+                        & (val64[1:] >= 0) & (val64[1:] < 2**31))
+    cont = np.zeros(n_ops, bool)
+    if n_ops >= 3:
+        cont[2:] = (is_pair[2:] & is_pair[:-2]
+                    & (op_row[2:] == op_row[:-2]) & (ta[2:] == ta[:-2])
+                    & (tc[2:] == tc[:-2] + 1) & (pa[2:] == ta[:-2])
+                    & (pc[2:] == tc[:-2]))
+    run_head = is_pair & ~cont
+    covered = np.zeros(n_ops, bool)
+    covered[is_pair] = True
+    covered[1:] |= is_pair[:-1]
+
+    hpos = np.flatnonzero(run_head)
+    pair_pos = np.flatnonzero(is_pair)
+    if len(hpos):
+        run_len = np.diff(np.append(
+            np.searchsorted(pair_pos, hpos), len(pair_pos))).astype(np.int64)
+    else:
+        run_len = np.empty(0, np.int64)
+    rpos = np.flatnonzero(~covered)
+    res_is_ins = kind[rpos] == KIND_INS
+    return RoundPlan(n_ops=n_ops, is_ins=is_ins, n_ins=n_ins,
+                     new_slot=new_slot, hpos=hpos, pair_pos=pair_pos,
+                     run_len=run_len, rpos=rpos, res_is_ins=res_is_ins)

@@ -34,18 +34,17 @@ wire to the device, instead of 2k op rows.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
-from .._common import (HEAD_PARENT, KIND_DEL, KIND_INC, KIND_INS, KIND_SET,
-                       make_elem_id)
+from .._common import HEAD_PARENT, KIND_SET, make_elem_id
+from .base import CausalDeviceDoc
 from .columnar import TextChangeBatch
+from .runs import detect_runs
 from .host_index import (DuplicateElemId, ElemRangeIndex, pack_keys,
                          unpack_key)
 
 
-class DeviceTextDoc:
+class DeviceTextDoc(CausalDeviceDoc):
     """One text/list object, columnar, merged in batches on device.
 
     Element table layout: slot 0 is the virtual head; live elements occupy
@@ -59,25 +58,18 @@ class DeviceTextDoc:
     _TABLE_KEYS = ("parent", "ctr", "actor", "value", "has_value",
                    "win_actor", "win_seq", "win_counter", "chain")
 
+    batch_type = TextChangeBatch
+
     def __init__(self, obj_id: str = "text", capacity: int = 1024):
         from ..ops.ingest import bucket
-        self.obj_id = obj_id
+        super().__init__(obj_id)
         self.all_ascii = True                 # every value ever set is 7-bit
-        self.actor_table: list = []           # rank -> actor id (lex-ordered)
-        self._actor_rank: dict = {}
-        self.clock: dict = {}                 # actor id -> seq
-        self._all_deps: dict = {}             # (actor, seq) -> allDeps dict
-        self.queue: list = []                 # (batch, row) not causally ready
         self.n_elems = 0                      # live element count (excl. head)
-        self.conflicts: dict = {}             # slot -> extra surviving ops
-        self.value_pool: list = []            # rich values (non-single-char)
         self.index = ElemRangeIndex()         # elemId -> slot (host)
         self._cap = bucket(max(capacity, 16))
-        self._dev: Optional[dict] = None      # device arrays (lazy)
         self._seg_bound = 2                   # upper bound for S sizing
-        self._host: Optional[dict] = None     # numpy mirrors (lazy)
-        self._mat: Optional[tuple] = None     # (pos, codes, n_vis) device
-        self._pos_cache: Optional[np.ndarray] = None
+        self._mat = None                      # materialization cache (device)
+        self._pos_cache = None
 
     # ------------------------------------------------------------------
     # device state
@@ -113,26 +105,7 @@ class DeviceTextDoc:
                           ("parent", "ctr", "actor", "value", "has_value")}
         return self._host
 
-    # ------------------------------------------------------------------
-    # actor interning (order-preserving: rank order == lexicographic order)
-    # ------------------------------------------------------------------
-
-    def _intern_actors(self, new_actors) -> Optional[np.ndarray]:
-        """Add actors; if rank order changes, return the old->new remap."""
-        missing = sorted(set(a for a in new_actors if a not in self._actor_rank))
-        if not missing:
-            return None
-        merged = sorted(set(self.actor_table) | set(missing))
-        new_rank = {a: i for i, a in enumerate(merged)}
-        remap = None
-        if self.actor_table and merged[: len(self.actor_table)] != self.actor_table:
-            remap = np.asarray(
-                [new_rank[a] for a in self.actor_table], np.int32)
-        self.actor_table = merged
-        self._actor_rank = new_rank
-        return remap
-
-    def _apply_remap(self, remap: np.ndarray):
+    def _remap_device(self, remap: np.ndarray):
         import jax.numpy as jnp
         from ..ops.ingest import remap_actors
         dev = self._ensure_dev()
@@ -141,104 +114,6 @@ class DeviceTextDoc:
             np.int32(self.n_elems))
         dev.update(actor=actor_n, win_actor=wa_n)
         self.index.remap_actors(remap.astype(np.int64))
-        for ops in self.conflicts.values():
-            for op in ops:
-                op["actor_rank"] = int(remap[op["actor_rank"]])
-        self._invalidate()
-
-    # ------------------------------------------------------------------
-    # causality
-    # ------------------------------------------------------------------
-
-    def _compute_all_deps(self, actor: str, seq: int, deps: dict) -> dict:
-        base = dict(deps)
-        if seq > 1:
-            base[actor] = seq - 1
-        out: dict = {}
-        for dep_actor, dep_seq in base.items():
-            if dep_seq <= 0:
-                continue
-            transitive = self._all_deps.get((dep_actor, dep_seq))
-            if transitive:
-                for a, s in transitive.items():
-                    if s > out.get(a, 0):
-                        out[a] = s
-            out[dep_actor] = dep_seq
-        return out
-
-    # ------------------------------------------------------------------
-    # batch application
-    # ------------------------------------------------------------------
-
-    def apply_changes(self, changes) -> "DeviceTextDoc":
-        return self.apply_batch(TextChangeBatch.from_changes(changes, self.obj_id))
-
-    def apply_batch(self, batch: TextChangeBatch) -> "DeviceTextDoc":
-        """Merge a columnar change batch (causally gated, idempotent)."""
-        # --- admission: schedule rows in causal rounds over a host clock ---
-        pending = list(range(batch.n_changes)) + self.queue
-        clock = dict(self.clock)
-        scheduled: set = set()  # (actor, seq) admitted in this call
-        rounds: list = []
-        while pending:
-            ready, not_ready = [], []
-            for item in pending:
-                b, row = (batch, item) if isinstance(item, int) else item
-                actor, seq = b.actors[row], int(b.seqs[row])
-                if seq <= clock.get(actor, 0) or (actor, seq) in scheduled:
-                    continue  # duplicate: idempotent skip (inconsistent reuse
-                    # of a seq by the same actor is not detected here; the
-                    # oracle backend raises on it)
-                deps = dict(b.deps[row])
-                deps[actor] = seq - 1
-                if all(clock.get(a, 0) >= s for a, s in deps.items()):
-                    ready.append((b, row))
-                    scheduled.add((actor, seq))
-                else:
-                    not_ready.append(item if not isinstance(item, int) else (b, row))
-            if not ready:
-                self.queue = not_ready
-                break
-            for b, row in ready:
-                clock[b.actors[row]] = int(b.seqs[row])
-            rounds.append(ready)
-            pending = not_ready
-        else:
-            self.queue = []
-
-        for ready in rounds:
-            self._apply_round(ready)
-        self._invalidate()
-        return self
-
-    def _apply_round(self, ready):
-        """Apply causally-ready (batch, row) pairs: one device program each."""
-        # group rows per batch object so op columns slice cheaply
-        by_batch: dict = {}
-        for b, row in ready:
-            by_batch.setdefault(id(b), (b, []))[1].append(row)
-
-        for b, rows in by_batch.values():
-            rows_arr = np.asarray(sorted(rows), np.int32)
-            # update clocks + allDeps
-            for row in rows_arr:
-                actor, seq = b.actors[row], int(b.seqs[row])
-                self._all_deps[(actor, seq)] = self._compute_all_deps(
-                    actor, seq, b.deps[row])
-                self.clock[actor] = seq
-
-            # ops may reference elemIds minted by actors whose own changes sit
-            # in other rounds, so intern the batch's whole actor table
-            remap = self._intern_actors(b.actor_table)
-            if remap is not None:
-                self._apply_remap(remap)
-
-            if len(rows_arr) == b.n_changes:
-                mask = slice(None)  # whole batch ready: no filtering needed
-            else:
-                mask = np.isin(b.op_change, rows_arr)
-            if b.n_ops:
-                self._ingest(b, mask)
 
     def _ingest(self, b: TextChangeBatch, mask):
         """One causally-ready round of one batch: host resolution + at most
@@ -263,53 +138,23 @@ class DeviceTextDoc:
             [self._actor_rank[a] for a in b.actors], np.int32)
         row_seq = np.asarray(b.seqs, np.int32)
 
-        is_ins = kind == KIND_INS
-        n_ins = int(is_ins.sum())
-        # slot assignment: op order == slot order
-        new_slot = np.where(is_ins, self.n_elems + np.cumsum(is_ins), 0)
-
         # --- typing-run detection: INS immediately followed by its SET,
         # chained with consecutive counters (the dominant text workload) ---
-        is_pair = np.zeros(n_ops, bool)
-        if n_ops >= 2:
-            is_pair[:-1] = ((kind[:-1] == KIND_INS) & (kind[1:] == KIND_SET)
-                            & (op_row[1:] == op_row[:-1])
-                            & (ta[1:] == ta[:-1]) & (tc[1:] == tc[:-1])
-                            & (val64[1:] >= 0) & (val64[1:] < 2**31))
-        cont = np.zeros(n_ops, bool)
-        if n_ops >= 3:
-            cont[2:] = (is_pair[2:] & is_pair[:-2]
-                        & (op_row[2:] == op_row[:-2]) & (ta[2:] == ta[:-2])
-                        & (tc[2:] == tc[:-2] + 1) & (pa[2:] == ta[:-2])
-                        & (pc[2:] == tc[:-2]))
-        run_head = is_pair & ~cont
-        covered = np.zeros(n_ops, bool)
-        covered[is_pair] = True
-        covered[1:] |= is_pair[:-1]
-        residual = ~covered
-
-        hpos = np.flatnonzero(run_head)
-        n_runs = len(hpos)
-        pair_pos = np.flatnonzero(is_pair)
-        n_pairs = len(pair_pos)
-
-        rpos = np.flatnonzero(residual)
+        plan = detect_runs(kind, ta, tc, pa, pc, val64, op_row, self.n_elems)
+        new_slot, hpos, pair_pos, run_len, rpos, res_is_ins = (
+            plan.new_slot, plan.hpos, plan.pair_pos, plan.run_len,
+            plan.rpos, plan.res_is_ins)
+        n_ins, n_runs, n_pairs, n_res_ins = (
+            plan.n_ins, plan.n_runs, plan.n_pairs, plan.n_res_ins)
         res_kind = kind[rpos]
-        res_is_ins = res_kind == KIND_INS
-        n_res_ins = int(res_is_ins.sum())
 
         # --- elemId index: stage this round's minted ranges (commit later) ---
         if n_runs:
-            run_ctr0 = tc[hpos].astype(np.int64)
-            run_actor_g = batch_rank[ta[hpos]]
-            run_len = np.diff(np.append(
-                np.searchsorted(pair_pos, hpos), n_pairs)).astype(np.int64)
-            run_slot0 = new_slot[hpos].astype(np.int64)
-            new_starts = [pack_keys(run_actor_g, run_ctr0)]
+            new_starts = [pack_keys(batch_rank[ta[hpos]],
+                                    tc[hpos].astype(np.int64))]
             new_lens = [run_len]
-            new_slots = [run_slot0]
+            new_slots = [new_slot[hpos].astype(np.int64)]
         else:
-            run_len = np.empty(0, np.int64)
             new_starts, new_lens, new_slots = [], [], []
         if n_res_ins:
             ri = rpos[res_is_ins]
@@ -485,101 +330,8 @@ class DeviceTextDoc:
             ops_idx = rpos[idxs]
             self._apply_slow(
                 b, tslot_np[idxs], kind[ops_idx], val64[ops_idx],
-                row_actor_rank[op_row[ops_idx]], row_seq[op_row[ops_idx]])
-
-    # ------------------------------------------------------------------
-    # slow register path (host; matches oracle applyAssign semantics)
-    # ------------------------------------------------------------------
-
-    def _apply_slow(self, b, slots, kinds, values, actor_ranks, seqs):
-        """Resolve non-fast assigns against gathered register state."""
-        import jax.numpy as jnp
-        from ..ops.ingest import bucket, gather_registers, scatter_registers
-
-        dev = self._dev
-        uniq = np.unique(slots)
-        S = bucket(len(uniq), 64)
-        slots_p = np.full(S, self._cap, np.int32)
-        slots_p[: len(uniq)] = uniq
-        g_v, g_h, g_wa, g_ws, g_wc = (
-            np.asarray(x) for x in gather_registers(
-                dev["value"], dev["has_value"], dev["win_actor"],
-                dev["win_seq"], dev["win_counter"], jnp.asarray(slots_p)))
-
-        regs: dict = {}
-        for i, s in enumerate(uniq):
-            s = int(s)
-            ops = []
-            if g_h[i] or g_wa[i] >= 0:
-                ops.append({"actor_rank": int(g_wa[i]), "seq": int(g_ws[i]),
-                            "value": int(g_v[i]), "counter": bool(g_wc[i])})
-            ops.extend(self.conflicts.get(s, []))
-            regs[s] = ops
-
-        for j in range(len(slots)):
-            slot = int(slots[j])
-            kind = int(kinds[j])
-            value = int(values[j])
-            actor_rank = int(actor_ranks[j])
-            seq = int(seqs[j])
-            actor_id = self.actor_table[actor_rank]
-            all_deps = self._all_deps.get((actor_id, seq), {})
-            ops = regs[slot]
-
-            if kind == KIND_INC:
-                for op in ops:
-                    if op["counter"] and self._causally_covers(all_deps, op):
-                        entry = self.value_pool[-op["value"] - 1]
-                        self.value_pool.append(
-                            {"value": entry["value"] + value,
-                             "datatype": "counter"})
-                        op["value"] = -len(self.value_pool)
-                continue
-
-            surviving = [op for op in ops
-                         if not self._causally_covers(all_deps, op)]
-            if kind == KIND_SET:
-                pooled, counter = value, False
-                if value < 0:
-                    entry = b.value_pool[-value - 1]
-                    self.value_pool.append(entry)
-                    pooled = -len(self.value_pool)
-                    counter = entry.get("datatype") == "counter"
-                surviving.append({"actor_rank": actor_rank, "seq": seq,
-                                  "value": pooled, "counter": counter})
-            regs[slot] = surviving
-
-        # finalize: winner = highest actor rank; extras become conflicts
-        w_v = np.zeros(S, np.int32)
-        w_h = np.zeros(S, bool)
-        w_wa = np.full(S, -1, np.int32)
-        w_ws = np.zeros(S, np.int32)
-        w_wc = np.zeros(S, bool)
-        for i, s in enumerate(uniq):
-            s = int(s)
-            ops = sorted(regs[s], key=lambda o: o["actor_rank"], reverse=True)
-            if ops:
-                w = ops[0]
-                w_v[i], w_h[i] = w["value"], True
-                w_wa[i], w_ws[i], w_wc[i] = w["actor_rank"], w["seq"], w["counter"]
-            if ops[1:]:
-                self.conflicts[s] = ops[1:]
-            else:
-                self.conflicts.pop(s, None)
-
-        out = scatter_registers(
-            dev["value"], dev["has_value"], dev["win_actor"], dev["win_seq"],
-            dev["win_counter"], jnp.asarray(slots_p), jnp.asarray(w_v),
-            jnp.asarray(w_h), jnp.asarray(w_wa), jnp.asarray(w_ws),
-            jnp.asarray(w_wc))
-        dev["value"], dev["has_value"], dev["win_actor"], dev["win_seq"], \
-            dev["win_counter"] = out
-        self._invalidate()
-
-    def _causally_covers(self, all_deps: dict, op: dict) -> bool:
-        if op["actor_rank"] < 0:
-            return True
-        return all_deps.get(self.actor_table[op["actor_rank"]], 0) >= op["seq"]
+                row_actor_rank[op_row[ops_idx]], row_seq[op_row[ops_idx]],
+                slot_cap=self._cap)
 
     # ------------------------------------------------------------------
     # materialization (device kernels)

@@ -4,3 +4,4 @@ packed elemId keys live exclusively on the host (engine/host_index.py)."""
 
 from .linearize import rga_linearize  # noqa: F401
 from .scan import segment_starts, visible_index  # noqa: F401
+from .scan_pallas import fused_segment_scans  # noqa: F401

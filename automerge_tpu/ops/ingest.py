@@ -204,15 +204,33 @@ def apply_residual(
     wc_n = _ext(win_counter, False, out_cap).at[ins_idx].set(False, mode="drop")
     chain_n = _ext(chain, False, out_cap).at[ins_idx].set(False, mode="drop")
 
-    # register fast path: single uncontended plain set on an empty register
+    (value_n, has_n, wa_n, ws_n, wc_n, slow, tslot, n_slow) = \
+        _register_fast_path(
+            value_n, has_n, wa_n, ws_n, wc_n, kind, is_assign, op_slot,
+            op_value, op_win_actor, op_win_seq, conflict_slots, out_cap)
+    return (parent_n, ctr_n, actor_n, value_n, has_n, wa_n, ws_n, wc_n,
+            chain_n, slow, tslot, n_slow)
+
+
+def _register_fast_path(value_n, has_n, wa_n, ws_n, wc_n, kind, is_assign,
+                        op_slot, op_value, op_win_actor, op_win_seq,
+                        conflict_slots, out_cap):
+    """Shared LWW register resolution (text elements and map keys).
+
+    Fast = a single plain inline set in this round targeting either an
+    empty register or the op's own actor's earlier write (always causally
+    covered). Everything else -> `slow` for host resolution."""
     tslot = jnp.where(is_assign, op_slot, out_cap)
     tclip = jnp.clip(tslot, 0, out_cap - 1)
     counts = jnp.zeros(out_cap + 1, jnp.int32).at[
         jnp.clip(tslot, 0, out_cap)].add(is_assign.astype(jnp.int32))
     cmask = jnp.zeros(out_cap + 1, bool).at[
         jnp.clip(conflict_slots, 0, out_cap)].set(True)
+    empty = ~has_n[tclip] & (wa_n[tclip] < 0)
+    self_over = (~wc_n[tclip] & (wa_n[tclip] == op_win_actor)
+                 & (ws_n[tclip] < op_win_seq))
     fast = (is_assign & (kind == KIND_SET)
-            & (counts[tclip] == 1) & ~has_n[tclip] & (wa_n[tclip] < 0)
+            & (counts[tclip] == 1) & (empty | self_over)
             & ~cmask[tclip] & (op_value >= 0))
     f_idx = jnp.where(fast, tslot, out_cap)
     value_n = value_n.at[f_idx].set(op_value, mode="drop")
@@ -223,8 +241,45 @@ def apply_residual(
 
     slow = is_assign & ~fast
     n_slow = jnp.sum(slow.astype(jnp.int32))
-    return (parent_n, ctr_n, actor_n, value_n, has_n, wa_n, ws_n, wc_n,
-            chain_n, slow, tslot, n_slow)
+    return value_n, has_n, wa_n, ws_n, wc_n, slow, tslot, n_slow
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def apply_map_round(
+    # register tables, capacity K
+    value, has_value, win_actor, win_seq, win_counter,
+    # op columns, capacity M (padding: kind=-1, slot=out_cap)
+    op_kind, op_slot, op_value, op_win_actor, op_win_seq,
+    conflict_slots,
+    *, out_cap: int,
+):
+    """One causally-ready round of map ops (set/del/inc on interned keys).
+
+    The map analogue of `apply_residual` without inserts: key registers are
+    dense slots, the LWW fast path handles single uncontended inline-int
+    sets, and everything else (dels, incs, pooled values, multi-writer
+    rounds, occupied registers) lands in the `slow` mask for host
+    resolution — the reference's `applyAssign` partitioned the same way
+    (/root/reference/backend/op_set.js:196-258, map branch)."""
+    kind = op_kind.astype(jnp.int32)
+    is_assign = (kind == KIND_SET) | (kind == KIND_DEL) | (kind == KIND_INC)
+
+    value_n = _ext(value, 0, out_cap)
+    has_n = _ext(has_value, False, out_cap)
+    wa_n = _ext(win_actor, -1, out_cap)
+    ws_n = _ext(win_seq, 0, out_cap)
+    wc_n = _ext(win_counter, False, out_cap)
+    return _register_fast_path(
+        value_n, has_n, wa_n, ws_n, wc_n, kind, is_assign, op_slot,
+        op_value, op_win_actor, op_win_seq, conflict_slots, out_cap)
+
+
+@jax.jit
+def remap_ranks(win_actor, remap):
+    """Re-rank the winner-actor column after an interning order change."""
+    hi = remap.shape[0] - 1
+    return jnp.where(win_actor >= 0, remap[jnp.clip(win_actor, 0, hi)],
+                     win_actor)
 
 
 def _linearize_segments(parent, attach_off, ctr, actor, weight, valid):

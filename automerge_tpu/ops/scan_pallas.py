@@ -1,0 +1,155 @@
+"""Pallas TPU kernels: fused multi-scan for text materialization.
+
+`_materialize_core` (ops/ingest.py) needs three prefix scans over the element
+tables — segment ranks (cumsum of segment starts), segment heads (cummax),
+and the visibility prefix-sum that replaces the reference's order-statistic
+skip list (/root/reference/backend/skip_list.js:260-305). XLA emits each as
+its own HBM round trip plus the elementwise producers; this kernel computes
+all three in ONE pass: each grid step loads a (ROWS, LANES) tile into VMEM,
+derives `seg_start`/`vis` on the VPU, scans within the tile, and carries the
+running (rank, head, vis) totals across the sequential TPU grid in SMEM
+scratch — the standard single-pass carry pattern (grid steps execute in
+order on a TPU core).
+
+The kernel is shape-generic over C = ROWS*LANES*num_tiles; callers pad to a
+tile multiple (the engine's capacities are already power-of-two buckets).
+`interpret=True` runs it on CPU for the parity tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROWS, LANES = 8, 128
+TILE = ROWS * LANES
+
+
+def _scan_add(x, axis):
+    """Inclusive prefix-sum along `axis` via log-shift adds (Mosaic has no
+    cumsum primitive; pltpu.roll + mask is the standard in-kernel scan)."""
+    n = x.shape[axis]
+    pos = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
+    k = 1
+    while k < n:
+        x = x + jnp.where(pos >= k, pltpu.roll(x, k, axis), 0)
+        k *= 2
+    return x
+
+
+def _scan_max(x, axis):
+    """Inclusive prefix-max along `axis`, same shift pattern."""
+    n = x.shape[axis]
+    pos = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
+    k = 1
+    while k < n:
+        x = jnp.maximum(x, jnp.where(pos >= k, pltpu.roll(x, k, axis),
+                                     jnp.iinfo(jnp.int32).min))
+        k *= 2
+    return x
+
+
+def _tile_scans(seg_start, vis, base):
+    """Within-tile inclusive scans in row-major flat order.
+
+    Returns (rank_incl, cumvis, flat_idx)."""
+    # scan along lanes, then add exclusive row-total prefixes
+    cs = _scan_add(seg_start, 1)
+    row_tot = cs[:, -1:]
+    row_pre = _scan_add(row_tot, 0) - row_tot
+    rank = cs + row_pre
+
+    cv = _scan_add(vis, 1)
+    vrow_tot = cv[:, -1:]
+    vrow_pre = _scan_add(vrow_tot, 0) - vrow_tot
+    cumvis = cv + vrow_pre
+
+    flat = (base + LANES * jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 0)
+            + jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 1))
+    return rank, cumvis, flat
+
+
+def _fused_kernel(n_ref, chain_ref, has_ref, rank_ref, head_ref, cv_ref,
+                  carry):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        carry[0] = 0   # segment-rank running total
+        carry[1] = 0   # running segment head (cummax)
+        carry[2] = 0   # visibility running total
+
+    n_elems = n_ref[0]
+    base = i * TILE
+    chain = chain_ref[:]
+    has = has_ref[:]
+
+    flat0 = (base + LANES * jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 0)
+             + jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 1))
+    is_elem = (flat0 >= 1) & (flat0 <= n_elems)
+    seg_start = (is_elem & ~chain).astype(jnp.int32)
+    vis = (is_elem & has).astype(jnp.int32)
+
+    rank, cumvis, flat = _tile_scans(seg_start, vis, base)
+    rank_ref[:] = rank + carry[0]
+    cv_ref[:] = cumvis + carry[2]
+
+    # segment head: prefix-max of (seg_start ? flat_idx : 0) in flat order,
+    # same two-level trick with max instead of add
+    cand = jnp.where(seg_start > 0, flat, 0)
+    cm = _scan_max(cand, 1)
+    row_max = cm[:, -1:]
+    rp_incl = _scan_max(row_max, 0)
+    pos0 = jax.lax.broadcasted_iota(jnp.int32, rp_incl.shape, 0)
+    row_pre = jnp.where(pos0 >= 1, pltpu.roll(rp_incl, 1, 0), 0)
+    head = jnp.maximum(cm, jnp.maximum(row_pre, carry[1]))
+    head_ref[:] = head
+
+    carry[0] = carry[0] + jnp.sum(seg_start)
+    carry[1] = jnp.maximum(carry[1], jnp.max(cand))
+    carry[2] = carry[2] + jnp.sum(vis)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def fused_segment_scans(chain, has_value, n_elems, *, interpret: bool = False):
+    """-> (rank_incl, seg_head, cumvis), all int32[C], inclusive scans.
+
+    rank_incl[i] = number of segment starts at slots <= i (the condensed-tree
+    node id of i's segment); seg_head[i] = slot of the latest segment head
+    <= i; cumvis[i] = number of visible elements at slots <= i (the
+    skip-list-index replacement). C must be a multiple of 1024.
+    """
+    C = chain.shape[0]
+    assert C % TILE == 0, f"capacity {C} not a multiple of {TILE}"
+    grid = C // TILE
+    shape2d = (grid * ROWS, LANES)
+
+    out = pl.pallas_call(
+        _fused_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((ROWS, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROWS, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((ROWS, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROWS, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROWS, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct(shape2d, jnp.int32)] * 3,
+        scratch_shapes=[pltpu.SMEM((3,), jnp.int32)],
+        interpret=interpret,
+    )(jnp.asarray([n_elems], jnp.int32),
+      chain.reshape(shape2d), has_value.reshape(shape2d))
+    rank, head, cumvis = (o.reshape(C) for o in out)
+    return rank, head, cumvis

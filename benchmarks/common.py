@@ -1,0 +1,39 @@
+"""Shared helpers for the BASELINE.md benchmark configs.
+
+Each config prints one JSON line {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no numbers (BASELINE.md), so vs_baseline is reported
+against the driver's north-star rate where one is defined (configs tied to
+the 100M ops/s target) and as 0.0/absent otherwise.
+"""
+
+import json
+import os
+import time
+
+
+def setup_jax_cache():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.makedirs(os.path.join(root, ".jax_cache"), exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(root, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def timed(fn, warmups: int = 1, reps: int = 2) -> float:
+    """Best wall time over `reps` runs after `warmups` compile passes."""
+    for _ in range(warmups):
+        fn()
+    return min(timed_once(fn) for _ in range(reps))
+
+
+def timed_once(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float = 0.0):
+    print(json.dumps({"metric": metric, "value": round(value, 2),
+                      "unit": unit, "vs_baseline": round(vs_baseline, 4)}),
+          flush=True)

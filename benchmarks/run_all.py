@@ -1,0 +1,168 @@
+"""BASELINE.md benchmark configs 1-5. One JSON line per config.
+
+Usage: python -m benchmarks.run_all [--quick]
+
+Config 5 (the headline 1M-char / 10k-actor merge) is bench.py at the repo
+root — the driver runs it separately; `run_all` includes a reduced variant
+unless --quick is absent and AUTOMERGE_BENCH_FULL=1.
+"""
+
+import sys
+
+import numpy as np
+
+from benchmarks.common import emit, setup_jax_cache, timed
+
+setup_jax_cache()
+
+
+def config1_text_two_actor(n_chars: int = 1000):
+    """Single Text doc, 2 actors, concurrent 1k-char insert (facade path)."""
+    import automerge_tpu as am
+
+    def run():
+        a = am.change(am.init("actor-a"),
+                      lambda d: d.__setitem__("t", am.Text("x" * 10)))
+        b = am.merge(am.init("actor-b"), a)
+        half = n_chars // 2
+        a2 = am.change(a, lambda d: d["t"].insert_at(5, *("a" * half)))
+        b2 = am.change(b, lambda d: d["t"].insert_at(5, *("b" * half)))
+        m1 = am.merge(a2, b2)
+        m2 = am.merge(b2, a2)
+        assert str(m1["t"]) == str(m2["t"])
+        assert len(str(m1["t"])) == 10 + n_chars
+
+    dt = timed(run, warmups=1, reps=2)
+    emit("cfg1_text_2actor_concurrent_insert", n_chars / dt, "chars/s")
+
+
+def config2_map_counter(n_actors: int = 100, n_keys: int = 100):
+    """Map doc: n_actors concurrent actors each setting n_keys keys plus a
+    shared counter, merged through the device map engine."""
+    from automerge_tpu.engine import DeviceMapDoc, MapChangeBatch
+
+    base = {"actor": "base", "seq": 1, "deps": {}, "ops":
+            [{"action": "set", "obj": "m", "key": "count", "value": 0,
+              "datatype": "counter"}]}
+    changes = []
+    for a in range(n_actors):
+        ops = [{"action": "set", "obj": "m", "key": f"k{a}-{i}", "value": i}
+               for i in range(n_keys)]
+        ops.append({"action": "inc", "obj": "m", "key": "count", "value": 1})
+        changes.append({"actor": f"actor-{a:04d}", "seq": 1,
+                        "deps": {"base": 1}, "ops": ops})
+    batch = MapChangeBatch.from_changes(changes, "m")
+    n_ops = batch.n_ops
+
+    def run():
+        doc = DeviceMapDoc("m")
+        doc.apply_changes([base])
+        doc.apply_batch(batch)
+        assert doc.get("count") == n_actors
+        assert len(doc) == n_actors * n_keys + 1
+
+    dt = timed(run, warmups=1, reps=2)
+    emit("cfg2_map_counter_100x100", n_ops / dt, "ops/s")
+
+
+def config3_docset(n_docs: int = 1000, n_actors: int = 10,
+                   chars_per_actor: int = 50):
+    """DocSet of n_docs text docs, n_actors concurrent writers per doc,
+    merged in ONE vmapped device program over the doc axis (the reference
+    loops one doc at a time, src/doc_set.js:29-37)."""
+    from automerge_tpu.engine import DeviceTextDocSet, TextChangeBatch
+    from automerge_tpu.engine.columnar import HEAD_PARENT, KIND_INS, KIND_SET
+
+    def doc_batch(obj_id: str, seed: int) -> TextChangeBatch:
+        """n_actors concurrent typing runs from the head of an empty doc."""
+        run = chars_per_actor
+        n_ops = n_actors * run * 2
+        actors = [f"actor-{i:03d}" for i in range(n_actors)]
+        op_change = np.repeat(np.arange(n_actors, dtype=np.int32), run * 2)
+        kind = np.tile(np.array([KIND_INS, KIND_SET], np.int8),
+                       n_actors * run)
+        ta = np.repeat(np.arange(n_actors, dtype=np.int32), run * 2)
+        tc = np.zeros(n_ops, np.int32)
+        pa = np.zeros(n_ops, np.int32)
+        pc = np.zeros(n_ops, np.int32)
+        val = np.zeros(n_ops, np.int64)
+        ctrs = np.arange(1, run + 1, dtype=np.int32)
+        for a in range(n_actors):
+            s = a * run * 2
+            tc[s: s + 2 * run: 2] = ctrs
+            tc[s + 1: s + 2 * run: 2] = ctrs
+            pa[s] = HEAD_PARENT
+            pa[s + 2: s + 2 * run: 2] = a
+            pc[s + 2: s + 2 * run: 2] = ctrs[:-1]
+            val[s + 1: s + 2 * run: 2] = 97 + ((a + seed) % 26)
+        return TextChangeBatch(
+            obj_id=obj_id, actors=actors,
+            seqs=np.ones(n_actors, np.int32), deps=[{}] * n_actors,
+            messages=[None] * n_actors, op_change=op_change, op_kind=kind,
+            op_target_actor=ta, op_target_ctr=tc, op_parent_actor=pa,
+            op_parent_ctr=pc, op_value=val, actor_table=actors,
+            value_pool=[])
+
+    batches = [doc_batch(f"d{d}", d) for d in range(n_docs)]
+    n_ops = sum(b.n_ops for b in batches)
+
+    def run():
+        ds = DeviceTextDocSet([f"d{d}" for d in range(n_docs)],
+                              capacity=n_actors * chars_per_actor + 64)
+        ds.apply_batches({f"d{d}": b for d, b in enumerate(batches)})
+        total = sum(len(t) for t in ds.texts().values())
+        assert total == n_docs * n_actors * chars_per_actor
+
+    dt = timed(run, warmups=1, reps=1)
+    emit("cfg3_docset_1k_docs", n_ops / dt, "ops/s")
+    emit("cfg3_docset_docs_per_sec", n_docs / dt, "docs/s")
+
+
+def config4_trellis(n_actors: int = 1000, quick: bool = False):
+    """Trellis-style nested cards[]/tasks[]: n_actors concurrent actors do
+    mixed insert/update/delete on a shared board (facade/oracle path — the
+    nested-document engine tier)."""
+    import automerge_tpu as am
+
+    if quick:
+        n_actors = 100
+    base = am.change(am.init("base"), lambda d: d.update(
+        {"cards": [{"title": f"card{i}", "tasks": [f"t{j}" for j in range(3)]}
+                   for i in range(10)]}))
+    changes_per_actor = []
+    for a in range(n_actors):
+        peer = am.merge(am.init(f"actor-{a:05d}"), base)
+        k = a % 10
+        if a % 3 == 0:
+            peer2 = am.change(peer, lambda d, k=k: d["cards"][k]["tasks"]
+                              .append(f"new-{a}"))
+        elif a % 3 == 1:
+            peer2 = am.change(peer, lambda d, k=k: d["cards"][k]
+                              .__setitem__("title", f"retitled-{a}"))
+        else:
+            peer2 = am.change(peer, lambda d, k=k: d["cards"][k]["tasks"]
+                              .__delitem__(0))
+        changes_per_actor.append(am.get_changes(base, peer2))
+    all_changes = [c for cs in changes_per_actor for c in cs]
+    n_ops = sum(len(c["ops"]) for c in all_changes)
+
+    def run():
+        merged = am.apply_changes(base, all_changes)
+        assert len(am.to_json(merged)["cards"]) == 10
+
+    dt = timed(run, warmups=0, reps=1)
+    emit(f"cfg4_trellis_nested_{n_actors}_actors", n_ops / dt, "ops/s")
+
+
+def main():
+    quick = "--quick" in sys.argv
+    config1_text_two_actor()
+    config2_map_counter()
+    config3_docset(n_docs=100 if quick else 1000)
+    config4_trellis(quick=quick)
+    if not quick:
+        print("# cfg5 (headline): python bench.py", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+"""DeviceTextDocSet: vmapped multi-doc merges match per-doc DeviceTextDoc."""
+
+import numpy as np
+import pytest
+
+from automerge_tpu.engine import DeviceTextDoc, DeviceTextDocSet
+
+
+def typing_change(actor, seq, text, start_ctr=1, after=None, deps=None,
+                  obj="t"):
+    ops = []
+    key = after if after is not None else "_head"
+    for i, c in enumerate(text):
+        ctr = start_ctr + i
+        ops.append({"action": "ins", "obj": obj, "key": key, "elem": ctr})
+        ops.append({"action": "set", "obj": obj, "key": f"{actor}:{ctr}",
+                    "value": c})
+        key = f"{actor}:{ctr}"
+    return {"actor": actor, "seq": seq, "deps": deps or {}, "ops": ops}
+
+
+def test_bulk_build_matches_single_doc():
+    ids = [f"d{i}" for i in range(5)]
+    ds = DeviceTextDocSet(ids)
+    batches = {}
+    singles = {}
+    from automerge_tpu.engine import TextChangeBatch
+    for i, obj in enumerate(ids):
+        changes = [typing_change(f"actor-{a}", 1, f"doc{i}text{a}", obj=obj)
+                   for a in range(3)]
+        batches[obj] = TextChangeBatch.from_changes(changes, obj)
+        singles[obj] = DeviceTextDoc(obj).apply_changes(changes)
+    ds.apply_batches(batches)
+    texts = ds.texts()
+    for obj in ids:
+        assert texts[obj] == singles[obj].text()
+
+
+def test_incremental_rounds_and_graduation():
+    from automerge_tpu.engine import TextChangeBatch
+    ids = ["a", "b"]
+    ds = DeviceTextDocSet(ids)
+    # round 1: plain typing in both docs (fast path)
+    ds.apply_batches({o: TextChangeBatch.from_changes(
+        [typing_change("w", 1, "hello", obj=o)], o) for o in ids})
+    assert ds.texts() == {"a": "hello", "b": "hello"}
+    # round 2: doc "a" gets an irregular batch (delete -> graduates)
+    ch = {"actor": "w", "seq": 2, "deps": {}, "ops":
+          [{"action": "del", "obj": "a", "key": "w:5"}]}
+    ds.apply_batches({"a": TextChangeBatch.from_changes([ch], "a")})
+    assert ds.texts() == {"a": "hell", "b": "hello"}
+    # round 3: both docs extend; "a" continues on its own engine
+    ds.apply_batches({o: TextChangeBatch.from_changes(
+        [typing_change("w", 3 if o == "a" else 2, "!!", start_ctr=6,
+                       after="w:4" if o == "a" else "w:5", obj=o)], o)
+        for o in ids})
+    assert ds.texts() == {"a": "hell!!", "b": "hello!!"}
+
+
+def test_unicode_docset():
+    from automerge_tpu.engine import TextChangeBatch
+    ds = DeviceTextDocSet(["u"])
+    ds.apply_batches({"u": TextChangeBatch.from_changes(
+        [typing_change("w", 1, "héllo", obj="u")], "u")})
+    assert ds.texts()["u"] == "héllo"
+
+
+def test_concurrent_actors_same_position():
+    from automerge_tpu.engine import TextChangeBatch
+    ds = DeviceTextDocSet(["x"])
+    changes = [typing_change("aaa", 1, "123", obj="x"),
+               typing_change("bbb", 1, "456", start_ctr=1, obj="x")]
+    ds.apply_batches({"x": TextChangeBatch.from_changes(changes, "x")})
+    single = DeviceTextDoc("x").apply_changes(changes)
+    assert ds.texts()["x"] == single.text()
+
+
+def test_graduation_carries_causal_history():
+    """A doc graduating off the fast path must keep the transitive-deps
+    closure of fast-path changes: a later writer whose deps transitively
+    cover an earlier write must overwrite it, not conflict with it."""
+    from automerge_tpu.engine import TextChangeBatch
+    ds = DeviceTextDocSet(["g"])
+    chA = typing_change("A", 1, "x", obj="g")
+    chB = {"actor": "B", "seq": 1, "deps": {"A": 1}, "ops": [
+        {"action": "ins", "obj": "g", "key": "A:1", "elem": 2},
+        {"action": "set", "obj": "g", "key": "B:2", "value": "y"}]}
+    ds.apply_batches({"g": TextChangeBatch.from_changes([chA], "g")})
+    ds.apply_batches({"g": TextChangeBatch.from_changes([chB], "g")})
+    # actor '0' < 'A' lexicographically; deps {B:1} transitively covers A:1
+    ch0 = {"actor": "0", "seq": 1, "deps": {"B": 1}, "ops": [
+        {"action": "set", "obj": "g", "key": "A:1", "value": "z"}]}
+    ds.apply_batches({"g": TextChangeBatch.from_changes([ch0], "g")})
+    single = DeviceTextDoc("g").apply_changes([chA, chB, ch0])
+    assert ds.texts()["g"] == single.text() == "zy"
+    assert ds.doc("g").conflicts_at(0) is None
+
+
+def test_duplicate_batch_is_noop_without_graduation():
+    from automerge_tpu.engine import TextChangeBatch
+    ds = DeviceTextDocSet(["dup"])
+    batch = TextChangeBatch.from_changes(
+        [typing_change("w", 1, "abc", obj="dup")], "dup")
+    ds.apply_batches({"dup": batch})
+    ds.apply_batches({"dup": batch})  # redelivery
+    assert ds.texts()["dup"] == "abc"
+    assert not ds._overlay  # still on the vmapped fast path
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_random_docsets_match_single(seed):
+    from automerge_tpu.engine import TextChangeBatch
+    rng = np.random.default_rng(seed)
+    ids = [f"r{i}" for i in range(4)]
+    ds = DeviceTextDocSet(ids)
+    singles = {o: DeviceTextDoc(o) for o in ids}
+    ctr = {o: 1 for o in ids}
+    for rnd in range(3):
+        batches = {}
+        for o in ids:
+            n_act = int(rng.integers(1, 4))
+            changes = []
+            for a in range(n_act):
+                text = "".join(chr(97 + int(c))
+                               for c in rng.integers(0, 26, 8))
+                changes.append(typing_change(
+                    f"w{a}", rnd + 1, text, start_ctr=ctr[o], obj=o,
+                    deps={f"w{i}": rnd for i in range(n_act)} if rnd else {}))
+            ctr[o] += 8
+            batches[o] = TextChangeBatch.from_changes(changes, o)
+            singles[o].apply_changes(changes)
+        ds.apply_batches(batches)
+    texts = ds.texts()
+    for o in ids:
+        assert texts[o] == singles[o].text(), o

@@ -1,0 +1,152 @@
+"""Device map engine vs oracle: bit-exact parity on map/counter documents.
+
+Mirrors tests/test_engine_parity.py for DeviceMapDoc: drive the facade
+(oracle backend) to build causally-valid histories, replay the same changes
+through the device map engine, and compare materialized values + conflicts.
+"""
+
+import random
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import Counter
+from automerge_tpu._common import ROOT_ID
+from automerge_tpu.engine import DeviceMapDoc
+
+
+def root_map_changes(doc):
+    """All changes restricted to set/del/inc ops on the root map."""
+    out = []
+    for ch in am.get_all_changes(doc):
+        ops = [op for op in ch["ops"]
+               if op.get("obj") == ROOT_ID and op["action"] in
+               ("set", "del", "inc")]
+        out.append({**ch, "ops": ops})
+    return out
+
+
+def assert_map_parity(doc):
+    eng = DeviceMapDoc(ROOT_ID)
+    eng.apply_changes(root_map_changes(doc))
+    oracle = {k: (v.value if isinstance(v, Counter) else v)
+              for k, v in am.to_json(doc).items()
+              if not isinstance(v, (dict, list))}
+    assert eng.to_dict() == oracle
+    for key in oracle:
+        o_conf = am.get_conflicts(doc, key)
+        if o_conf is not None:
+            o_conf = {a: (v.value if isinstance(v, Counter) else v)
+                      for a, v in o_conf.items()}
+        assert eng.conflicts_for(key) == o_conf, key
+    return eng
+
+
+def test_simple_sets():
+    d = am.change(am.init("a1"), lambda d: d.update({"x": 1, "y": "str", "z": 3}))
+    d = am.change(d, lambda d: d.__setitem__("x", 10))
+    assert_map_parity(d)
+
+
+def test_delete():
+    d = am.change(am.init("a1"), lambda d: d.update({"x": 1, "y": 2}))
+    d = am.change(d, lambda d: d.__delitem__("x"))
+    eng = assert_map_parity(d)
+    assert "x" not in eng and "y" in eng
+
+
+def test_concurrent_lww_conflict():
+    a = am.change(am.init("actor-1"), lambda d: d.__setitem__("k", "low"))
+    b = am.change(am.init("actor-2"), lambda d: d.__setitem__("k", "high"))
+    m = am.merge(a, b)
+    eng = assert_map_parity(m)
+    assert eng.get("k") == "high"
+    assert eng.conflicts_for("k") == {"actor-1": "low"}
+
+
+def test_conflict_resolution_by_later_write():
+    a = am.change(am.init("actor-1"), lambda d: d.__setitem__("k", 1))
+    b = am.change(am.init("actor-2"), lambda d: d.__setitem__("k", 2))
+    m = am.change(am.merge(a, b), lambda d: d.__setitem__("k", 3))
+    eng = assert_map_parity(m)
+    assert eng.conflicts_for("k") is None
+
+
+def test_counter_merge():
+    a = am.change(am.init("actor-1"), lambda d: d.__setitem__("n", Counter(5)))
+    b = am.merge(am.init("actor-2"), a)
+    a2 = am.change(a, lambda d: d["n"].increment(3))
+    b2 = am.change(b, lambda d: d["n"].increment(4))
+    eng = assert_map_parity(am.merge(a2, b2))
+    assert eng.get("n") == 12
+
+
+def test_concurrent_set_vs_delete_add_wins():
+    base = am.change(am.init("actor-1"), lambda d: d.__setitem__("k", "v"))
+    other = am.merge(am.init("actor-2"), base)
+    deleted = am.change(base, lambda d: d.__delitem__("k"))
+    updated = am.change(other, lambda d: d.__setitem__("k", "w"))
+    eng = assert_map_parity(am.merge(deleted, updated))
+    assert eng.get("k") == "w"
+
+
+def test_out_of_order_queues():
+    a1 = am.change(am.init("actor-1"), lambda d: d.__setitem__("x", 1))
+    a2 = am.change(a1, lambda d: d.__setitem__("y", 2))
+    changes = root_map_changes(a2)
+    eng = DeviceMapDoc(ROOT_ID)
+    eng.apply_changes([changes[1]])
+    assert eng.to_dict() == {}
+    eng.apply_changes([changes[0]])
+    assert eng.to_dict() == {"x": 1, "y": 2}
+
+
+def test_duplicate_idempotent():
+    d = am.change(am.init("a1"), lambda d: d.__setitem__("x", 1))
+    changes = root_map_changes(d)
+    eng = DeviceMapDoc(ROOT_ID)
+    eng.apply_changes(changes)
+    eng.apply_changes(changes)
+    assert eng.to_dict() == {"x": 1}
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_histories_parity(seed):
+    """Random multi-actor map/counter sessions with merges, replayed through
+    the device engine, must match the oracle exactly."""
+    rng = random.Random(seed)
+    keys = [f"k{i}" for i in range(6)]
+    docs = [am.init(f"actor-{i}") for i in range(3)]
+
+    for step in range(rng.randint(8, 20)):
+        i = rng.randrange(len(docs))
+        op = rng.random()
+        key = rng.choice(keys)
+        if op < 0.45:
+            if isinstance(docs[i].get(key), Counter):
+                continue  # the frontend forbids plain-set over a Counter
+            val = rng.choice([rng.randint(0, 1000), f"s{step}",
+                              rng.random() < 0.5, -rng.randint(1, 9)])
+            docs[i] = am.change(docs[i], lambda d, k=key, v=val:
+                                d.__setitem__(k, v))
+        elif op < 0.6:
+            if am.to_json(docs[i]).get(key) is not None:
+                docs[i] = am.change(docs[i], lambda d, k=key:
+                                    d.__delitem__(k))
+        elif op < 0.75:
+            cur = docs[i]
+            if isinstance(cur.get(key), Counter):
+                docs[i] = am.change(cur, lambda d, k=key:
+                                    d[k].increment(rng.randint(-5, 5)))
+            else:
+                docs[i] = am.change(cur, lambda d, k=key:
+                                    d.__setitem__(k, Counter(rng.randint(0, 50))))
+        else:
+            j = rng.randrange(len(docs))
+            if i != j:
+                docs[i] = am.merge(docs[i], docs[j])
+
+    final = docs[0]
+    for j in range(1, len(docs)):
+        final = am.merge(final, docs[j])
+    assert_map_parity(final)
