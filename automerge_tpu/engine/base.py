@@ -30,6 +30,27 @@ import numpy as np
 from .._common import KIND_INC, KIND_SET
 
 
+def transitive_closure(all_deps: dict, actor: str, seq: int,
+                       deps: dict) -> dict:
+    """allDeps of a change: its explicit deps plus its own predecessor,
+    closed transitively over the (actor, seq) -> clock map (the reference's
+    `transitiveDeps`, /root/reference/backend/op_set.js:29-37)."""
+    base = dict(deps)
+    if seq > 1:
+        base[actor] = seq - 1
+    out: dict = {}
+    for dep_actor, dep_seq in base.items():
+        if dep_seq <= 0:
+            continue
+        transitive = all_deps.get((dep_actor, dep_seq))
+        if transitive:
+            for a, s in transitive.items():
+                if s > out.get(a, 0):
+                    out[a] = s
+        out[dep_actor] = dep_seq
+    return out
+
+
 class CausalDeviceDoc:
     """Base: causal batch admission + registers + actor interning."""
 
@@ -78,20 +99,7 @@ class CausalDeviceDoc:
     # ------------------------------------------------------------------
 
     def _compute_all_deps(self, actor: str, seq: int, deps: dict) -> dict:
-        base = dict(deps)
-        if seq > 1:
-            base[actor] = seq - 1
-        out: dict = {}
-        for dep_actor, dep_seq in base.items():
-            if dep_seq <= 0:
-                continue
-            transitive = self._all_deps.get((dep_actor, dep_seq))
-            if transitive:
-                for a, s in transitive.items():
-                    if s > out.get(a, 0):
-                        out[a] = s
-            out[dep_actor] = dep_seq
-        return out
+        return transitive_closure(self._all_deps, actor, seq, deps)
 
     def _causally_covers(self, all_deps: dict, op: dict) -> bool:
         if op["actor_rank"] < 0:

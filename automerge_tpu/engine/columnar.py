@@ -139,6 +139,20 @@ class TextChangeBatch:
         return len(self.op_kind)
 
     @classmethod
+    def from_json(cls, data, obj_id: str) -> "TextChangeBatch":
+        """Decode a JSON change list (str/bytes) into columns.
+
+        Uses the native C++ codec (automerge_tpu/native) when available and
+        the payload is in its scope; otherwise parses with the Python
+        decoder. Both produce identical batches (tests/test_native_codec)."""
+        from ..native import decode_text_changes
+        batch = decode_text_changes(data, obj_id)
+        if batch is not None:
+            return batch
+        import json as _json
+        return cls.from_changes(_json.loads(data), obj_id)
+
+    @classmethod
     def from_changes(cls, changes, obj_id: str) -> "TextChangeBatch":
         """Decode wire-format changes (plain dicts) into columns."""
         actor_rank: dict = {}

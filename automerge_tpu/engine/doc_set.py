@@ -20,6 +20,7 @@ from __future__ import annotations
 import numpy as np
 
 from .._common import HEAD_PARENT, make_elem_id
+from .base import transitive_closure
 from .columnar import TextChangeBatch
 from .host_index import (DuplicateElemId, ElemRangeIndex, pack_keys,
                          unpack_key)
@@ -213,7 +214,9 @@ class DeviceTextDocSet:
         Pure: all state updates are staged in the returned pack and
         committed by apply_batches only after every doc's plan succeeds."""
         meta = self._meta[d]
-        # single fully-ready round? (idempotently drop all-duplicate batches)
+        # fully-ready batch? the clock advances through the loop, so
+        # sequential same-actor changes stay fast and any duplicate —
+        # pre-applied or repeated within the batch — is detected
         clock = dict(meta.clock)
         dups = 0
         for row in range(b.n_changes):
@@ -228,6 +231,7 @@ class DeviceTextDocSet:
                 return None
             if clock.get(actor, 0) != seq - 1:
                 return None
+            clock[actor] = seq
         if dups == b.n_changes:
             return "skip"         # redelivery of an applied batch: no-op
         if dups:
@@ -280,24 +284,15 @@ class DeviceTextDocSet:
         parent_slot = np.where(is_head, 0, slots)
 
         # transitive dependency closure per change (the graduated doc's slow
-        # path needs it to judge causal coverage — readiness guarantees all
-        # referenced (actor, seq) entries are pre-batch)
-        staged_all_deps = {}
+        # path needs it to judge causal coverage); a dep may reference an
+        # earlier in-batch change, so close over staged entries as well
+        staged_all_deps: dict = {}
+        combined = dict(meta.all_deps)
         for row in range(b.n_changes):
             actor, seq = b.actors[row], int(b.seqs[row])
-            base = dict(b.deps[row])
-            if seq > 1:
-                base[actor] = seq - 1
-            closure: dict = {}
-            for dep_actor, dep_seq in base.items():
-                if dep_seq <= 0:
-                    continue
-                for a, s in meta.all_deps.get((dep_actor, dep_seq),
-                                              {}).items():
-                    if s > closure.get(a, 0):
-                        closure[a] = s
-                closure[dep_actor] = dep_seq
+            closure = transitive_closure(combined, actor, seq, b.deps[row])
             staged_all_deps[(actor, seq)] = closure
+            combined[(actor, seq)] = closure
 
         blob = b.op_value[plan.pair_pos + 1]
         return {

@@ -1,0 +1,136 @@
+"""Native (C++) runtime tier: wire-format codec.
+
+The compute path is JAX/XLA (ops/); this package holds the host runtime
+pieces where native code pays. `codec.cpp` decodes JSON change lists (the
+sync wire format) straight into the engine's columnar batch arrays ~50x
+faster than the per-op Python loop.
+
+The library builds lazily with g++ (no pybind11 — plain ctypes over an
+extern-C API) and caches next to the source; every entry point degrades to
+the pure-Python decoder when the toolchain or the .so is unavailable, or
+when the batch contains shapes the native scope excludes (rich values,
+non-list objects) — correctness never depends on the native tier.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "build", "libamtpu_codec.so")
+_SRC = os.path.join(_HERE, "codec.cpp")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _load():
+    """Build (if stale) and load the codec library; None if unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                os.makedirs(os.path.dirname(_SO), exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     _SRC, "-o", _SO],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_SO)
+            lib.amtpu_parse.restype = ctypes.c_void_p
+            lib.amtpu_parse.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                        ctypes.c_char_p]
+            lib.amtpu_error.restype = ctypes.c_char_p
+            lib.amtpu_error.argtypes = [ctypes.c_void_p]
+            for name in ("amtpu_unsupported", "amtpu_n_changes",
+                         "amtpu_n_ops", "amtpu_n_actors"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_long
+                fn.argtypes = [ctypes.c_void_p]
+            lib.amtpu_fill_ops.argtypes = [ctypes.c_void_p] + \
+                [np.ctypeslib.ndpointer(dt, flags="C_CONTIGUOUS")
+                 for dt in (np.int32, np.int8, np.int32, np.int32,
+                            np.int32, np.int32, np.int64)]
+            lib.amtpu_fill_seqs.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
+            for name in ("amtpu_actors", "amtpu_actor_table", "amtpu_deps",
+                         "amtpu_messages"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_char_p
+                fn.argtypes = [ctypes.c_void_p]
+            lib.amtpu_free.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode_text_changes(data, obj_id: str):
+    """JSON change list (str/bytes) -> TextChangeBatch via the native codec.
+
+    Returns None when the native tier is unavailable or the payload is out
+    of its scope; the caller falls back to the Python decoder."""
+    lib = _load()
+    if lib is None:
+        return None
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    h = lib.amtpu_parse(data, len(data), obj_id.encode("utf-8"))
+    try:
+        if lib.amtpu_unsupported(h):
+            return None
+        n_changes = lib.amtpu_n_changes(h)
+        n_ops = lib.amtpu_n_ops(h)
+        op_change = np.empty(n_ops, np.int32)
+        op_kind = np.empty(n_ops, np.int8)
+        ta = np.empty(n_ops, np.int32)
+        tc = np.empty(n_ops, np.int32)
+        pa = np.empty(n_ops, np.int32)
+        pc = np.empty(n_ops, np.int32)
+        val = np.empty(n_ops, np.int64)
+        if n_ops:
+            lib.amtpu_fill_ops(h, op_change, op_kind, ta, tc, pa, pc, val)
+        seqs = np.empty(n_changes, np.int32)
+        if n_changes:
+            lib.amtpu_fill_seqs(h, seqs)
+
+        def split(raw):
+            s = raw.decode("utf-8")
+            return s.split("\n") if s else []
+
+        actors = split(lib.amtpu_actors(h))
+        actor_table = split(lib.amtpu_actor_table(h))
+        deps = [json.loads(d) for d in split(lib.amtpu_deps(h))]
+        raw_msgs = lib.amtpu_messages(h).decode("utf-8")
+        messages = []
+        if n_changes:
+            for part in raw_msgs.split("\x1f"):
+                messages.append(part[1:] if part[:1] == "1" else None)
+        if not (len(actors) == len(deps) == len(messages) == n_changes):
+            return None  # defensive: malformed joins -> python path
+
+        from ..engine.columnar import TextChangeBatch
+        return TextChangeBatch(
+            obj_id=obj_id, actors=actors, seqs=seqs, deps=deps,
+            messages=messages, op_change=op_change, op_kind=op_kind,
+            op_target_actor=ta, op_target_ctr=tc, op_parent_actor=pa,
+            op_parent_ctr=pc, op_value=val, actor_table=actor_table,
+            value_pool=[])
+    finally:
+        lib.amtpu_free(h)

@@ -1,0 +1,478 @@
+// Native wire-format decoder for columnar text-change batches.
+//
+// The reference keeps its whole runtime in JavaScript (no native tier —
+// SURVEY.md §0); this framework's runtime tier is native where it pays:
+// decoding JSON change lists (the sync wire format, INTERNALS.md:150-324 in
+// the reference) into the struct-of-arrays columns the device engine
+// consumes (engine/columnar.py:TextChangeBatch). The Python decoder loops
+// per op (~1us/op); this decoder is a single-pass recursive-descent parse
+// into preallocated columns (~20ns/op).
+//
+// Scope: ins/set/del/inc ops on ONE list/text object, with single-char
+// string values or integer values. Anything else (nested objects, rich
+// values, unknown fields that matter) sets `unsupported`, and the Python
+// caller falls back to the reference decoder for the whole batch —
+// correctness never depends on this fast path.
+//
+// Build: g++ -O2 -shared -fPIC codec.cpp -o libamtpu_codec.so (driven by
+// automerge_tpu/native/__init__.py, cached; ctypes binding, no pybind11).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+#include <unordered_map>
+
+namespace {
+
+struct Parser {
+    const char* p;
+    const char* end;
+    bool ok = true;
+    std::string err;
+
+    explicit Parser(const char* s, size_t n) : p(s), end(s + n) {}
+
+    void fail(const std::string& m) {
+        if (ok) { ok = false; err = m; }
+    }
+    void ws() { while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p; }
+    bool eat(char c) {
+        ws();
+        if (p < end && *p == c) { ++p; return true; }
+        return false;
+    }
+    bool expect(char c) {
+        if (!eat(c)) fail(std::string("expected '") + c + "'");
+        return ok;
+    }
+    bool peek(char c) { ws(); return p < end && *p == c; }
+
+    // JSON string -> UTF-8 bytes (handles escapes incl. \uXXXX pairs)
+    bool str(std::string& out) {
+        out.clear();
+        if (!expect('"')) return false;
+        while (p < end && *p != '"') {
+            char c = *p++;
+            if (c != '\\') { out.push_back(c); continue; }
+            if (p >= end) { fail("bad escape"); return false; }
+            char e = *p++;
+            switch (e) {
+                case '"': out.push_back('"'); break;
+                case '\\': out.push_back('\\'); break;
+                case '/': out.push_back('/'); break;
+                case 'b': out.push_back('\b'); break;
+                case 'f': out.push_back('\f'); break;
+                case 'n': out.push_back('\n'); break;
+                case 'r': out.push_back('\r'); break;
+                case 't': out.push_back('\t'); break;
+                case 'u': {
+                    if (end - p < 4) { fail("bad \\u"); return false; }
+                    auto hex4 = [&]() {
+                        unsigned v = 0;
+                        for (int i = 0; i < 4; i++) {
+                            char h = *p++;
+                            v <<= 4;
+                            if (h >= '0' && h <= '9') v |= h - '0';
+                            else if (h >= 'a' && h <= 'f') v |= h - 'a' + 10;
+                            else if (h >= 'A' && h <= 'F') v |= h - 'A' + 10;
+                            else { fail("bad hex"); return 0u; }
+                        }
+                        return v;
+                    };
+                    unsigned cp = hex4();
+                    if (!ok) return false;
+                    if (cp >= 0xD800 && cp <= 0xDBFF) {  // surrogate pair
+                        if (end - p < 6 || p[0] != '\\' || p[1] != 'u') {
+                            fail("lone surrogate"); return false;
+                        }
+                        p += 2;
+                        unsigned lo = hex4();
+                        if (!ok) return false;
+                        cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                    }
+                    // encode UTF-8
+                    if (cp < 0x80) out.push_back((char)cp);
+                    else if (cp < 0x800) {
+                        out.push_back((char)(0xC0 | (cp >> 6)));
+                        out.push_back((char)(0x80 | (cp & 0x3F)));
+                    } else if (cp < 0x10000) {
+                        out.push_back((char)(0xE0 | (cp >> 12)));
+                        out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+                        out.push_back((char)(0x80 | (cp & 0x3F)));
+                    } else {
+                        out.push_back((char)(0xF0 | (cp >> 18)));
+                        out.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+                        out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+                        out.push_back((char)(0x80 | (cp & 0x3F)));
+                    }
+                    break;
+                }
+                default: fail("bad escape"); return false;
+            }
+        }
+        return expect('"');
+    }
+
+    bool integer(long long& out) {
+        ws();
+        bool neg = false;
+        if (p < end && *p == '-') { neg = true; ++p; }
+        if (p >= end || *p < '0' || *p > '9') { fail("expected int"); return false; }
+        long long v = 0;
+        while (p < end && *p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+        if (p < end && (*p == '.' || *p == 'e' || *p == 'E')) {
+            fail("float value");  // unsupported -> python fallback
+            return false;
+        }
+        out = neg ? -v : v;
+        return true;
+    }
+
+    // skip any JSON value (for unknown fields)
+    bool skip() {
+        ws();
+        if (p >= end) { fail("eof"); return false; }
+        char c = *p;
+        if (c == '"') { std::string s; return str(s); }
+        if (c == '{') {
+            ++p;
+            if (eat('}')) return true;
+            do {
+                std::string k;
+                if (!str(k) || !expect(':') || !skip()) return false;
+            } while (eat(','));
+            return expect('}');
+        }
+        if (c == '[') {
+            ++p;
+            if (eat(']')) return true;
+            do { if (!skip()) return false; } while (eat(','));
+            return expect(']');
+        }
+        if (!strncmp(p, "true", 4)) { p += 4; return true; }
+        if (!strncmp(p, "false", 5)) { p += 5; return true; }
+        if (!strncmp(p, "null", 4)) { p += 4; return true; }
+        long long n;
+        // tolerate floats when skipping
+        if (*p == '-' || (*p >= '0' && *p <= '9')) {
+            while (p < end && (*p == '-' || *p == '+' || *p == '.' ||
+                               *p == 'e' || *p == 'E' ||
+                               (*p >= '0' && *p <= '9'))) ++p;
+            return true;
+        }
+        (void)n;
+        fail("bad value");
+        return false;
+    }
+};
+
+constexpr int8_t KIND_INS = 0, KIND_SET = 1, KIND_DEL = 2, KIND_INC = 3;
+constexpr int32_t HEAD_PARENT = -1;
+
+struct Batch {
+    bool unsupported = false;
+    std::string err;
+    std::string err_obj;                   // object id ops must target
+    std::string scratch1, scratch2, scratch3, scratch4;  // join buffers
+    // per change
+    std::vector<std::string> actors;
+    std::vector<int32_t> seqs;
+    std::vector<std::string> deps_json;    // raw slices, decoded in python
+    std::vector<std::string> messages;     // "" = none
+    std::vector<uint8_t> has_message;
+    // per op
+    std::vector<int32_t> op_change;
+    std::vector<int8_t> op_kind;
+    std::vector<int32_t> op_ta, op_tc, op_pa, op_pc;
+    std::vector<int64_t> op_value;
+    // batch actor interning
+    std::vector<std::string> actor_table;
+    std::unordered_map<std::string, int32_t> actor_rank;
+
+    int32_t intern(const std::string& a) {
+        auto it = actor_rank.find(a);
+        if (it != actor_rank.end()) return it->second;
+        int32_t r = (int32_t)actor_table.size();
+        actor_table.push_back(a);
+        actor_rank.emplace(a, r);
+        return r;
+    }
+};
+
+// "actor:ctr" -> (rank, ctr); false if malformed
+bool parse_elem_id(Batch& b, const std::string& id, int32_t& a, int32_t& c) {
+    size_t pos = id.rfind(':');
+    if (pos == std::string::npos || pos + 1 >= id.size()) return false;
+    if (id.find('\n') != std::string::npos) return false;  // join-safe ids only
+    long long ctr = 0;
+    for (size_t i = pos + 1; i < id.size(); i++) {
+        if (id[i] < '0' || id[i] > '9') return false;
+        ctr = ctr * 10 + (id[i] - '0');
+    }
+    a = b.intern(id.substr(0, pos));
+    c = (int32_t)ctr;
+    return true;
+}
+
+// single-char UTF-8 string -> codepoint, or -1
+int64_t single_codepoint(const std::string& s) {
+    if (s.empty()) return -1;
+    unsigned char c0 = s[0];
+    size_t need = c0 < 0x80 ? 1 : (c0 >> 5) == 6 ? 2 : (c0 >> 4) == 14 ? 3
+                  : (c0 >> 3) == 30 ? 4 : 0;
+    if (need == 0 || s.size() != need) return -1;
+    if (need == 1) return c0;
+    uint32_t cp = c0 & (0x7F >> need);
+    for (size_t i = 1; i < need; i++) {
+        if ((s[i] & 0xC0) != 0x80) return -1;
+        cp = (cp << 6) | (s[i] & 0x3F);
+    }
+    return cp;
+}
+
+bool parse_op(Parser& ps, Batch& b, const std::string& obj_id,
+              int32_t change_row) {
+    if (!ps.expect('{')) return false;
+    std::string action, obj, key, value_str;
+    long long elem = -1, value_int = 0;
+    bool have_value_str = false, have_value_int = false, value_other = false;
+    bool have_datatype = false;
+    if (!ps.peek('}')) do {
+        std::string k;
+        if (!ps.str(k) || !ps.expect(':')) return false;
+        if (k == "action") { if (!ps.str(action)) return false; }
+        else if (k == "obj") { if (!ps.str(obj)) return false; }
+        else if (k == "key") { if (!ps.str(key)) return false; }
+        else if (k == "elem") { if (!ps.integer(elem)) return false; }
+        else if (k == "value") {
+            ps.ws();
+            if (ps.peek('"')) { have_value_str = ps.str(value_str); if (!have_value_str) return false; }
+            else if (ps.p < ps.end && (*ps.p == '-' || (*ps.p >= '0' && *ps.p <= '9'))) {
+                if (!ps.integer(value_int)) { value_other = true; ps.ok = true; if (!ps.skip()) return false; }
+                else have_value_int = true;
+            } else { value_other = true; if (!ps.skip()) return false; }
+        }
+        else if (k == "datatype") { have_datatype = true; if (!ps.skip()) return false; }
+        else { if (!ps.skip()) return false; }
+    } while (ps.eat(','));
+    if (!ps.expect('}')) return false;
+
+    if (obj != obj_id) { b.unsupported = true; b.err = "op targets other object"; return true; }
+    b.op_change.push_back(change_row);
+    if (action == "ins") {
+        b.op_kind.push_back(KIND_INS);
+        b.op_ta.push_back(-2);  // filled by caller: the change's actor
+        b.op_tc.push_back((int32_t)elem);
+        if (key == "_head") { b.op_pa.push_back(HEAD_PARENT); b.op_pc.push_back(0); }
+        else {
+            int32_t a, c;
+            if (!parse_elem_id(b, key, a, c)) { b.unsupported = true; b.err = "bad elemId"; return true; }
+            b.op_pa.push_back(a); b.op_pc.push_back(c);
+        }
+        b.op_value.push_back(0);
+    } else if (action == "set" || action == "del" || action == "inc") {
+        b.op_kind.push_back(action == "set" ? KIND_SET : action == "del" ? KIND_DEL : KIND_INC);
+        int32_t a, c;
+        if (!parse_elem_id(b, key, a, c)) { b.unsupported = true; b.err = "bad elemId"; return true; }
+        b.op_ta.push_back(a); b.op_tc.push_back(c);
+        b.op_pa.push_back(HEAD_PARENT); b.op_pc.push_back(0);
+        if (action == "set") {
+            if (have_datatype || value_other || have_value_int) {
+                // pooled / rich values -> python decoder
+                b.unsupported = true; b.err = "rich value";
+                b.op_value.push_back(0);
+            } else if (have_value_str) {
+                int64_t cp = single_codepoint(value_str);
+                if (cp < 0) { b.unsupported = true; b.err = "multi-char value"; }
+                b.op_value.push_back(cp < 0 ? 0 : cp);
+            } else { b.unsupported = true; b.err = "missing value"; b.op_value.push_back(0); }
+        } else if (action == "inc") {
+            b.op_value.push_back(have_value_int ? value_int : 0);
+            if (!have_value_int) { b.unsupported = true; b.err = "inc without int"; }
+        } else b.op_value.push_back(0);
+    } else {
+        b.unsupported = true; b.err = "unsupported action: " + action;
+        // keep columns aligned
+        b.op_kind.push_back(KIND_DEL);
+        b.op_ta.push_back(0); b.op_tc.push_back(0);
+        b.op_pa.push_back(HEAD_PARENT); b.op_pc.push_back(0);
+        b.op_value.push_back(0);
+    }
+    return true;
+}
+
+bool parse_change(Parser& ps, Batch& b) {
+    if (!ps.expect('{')) return false;
+    int32_t row = (int32_t)b.actors.size();
+    b.actors.emplace_back();
+    b.seqs.push_back(0);
+    b.deps_json.emplace_back("{}");
+    b.messages.emplace_back();
+    b.has_message.push_back(0);
+    size_t ops_from = b.op_kind.size();
+    if (!ps.peek('}')) do {
+        std::string k;
+        if (!ps.str(k) || !ps.expect(':')) return false;
+        if (k == "actor") {
+            if (!ps.str(b.actors[row])) return false;
+            // actor ids travel '\n'-joined to python; exotic ids fall back
+            if (b.actors[row].find('\n') != std::string::npos) {
+                b.unsupported = true; b.err = "newline in actor id";
+            }
+        }
+        else if (k == "seq") { long long s; if (!ps.integer(s)) return false; b.seqs[row] = (int32_t)s; }
+        else if (k == "deps") {
+            // deps is a flat {actor: seq} map; re-serialize compactly (the
+            // python side json-decodes each line, so no raw input slices —
+            // pretty-printed payloads must round-trip too)
+            if (!ps.expect('{')) return false;
+            std::string& out = b.deps_json[row];
+            out = "{";
+            if (!ps.peek('}')) {
+                bool first = true;
+                do {
+                    std::string dk;
+                    long long dv;
+                    if (!ps.str(dk) || !ps.expect(':')) return false;
+                    if (!ps.integer(dv)) { b.unsupported = true; b.err = "non-int dep"; return false; }
+                    if (!first) out.push_back(',');
+                    first = false;
+                    out.push_back('"');
+                    for (char ch : dk) {  // JSON-escape the actor id
+                        if (ch == '"' || ch == '\\') { out.push_back('\\'); out.push_back(ch); }
+                        else if ((unsigned char)ch < 0x20) {
+                            char buf[8];
+                            snprintf(buf, sizeof buf, "\\u%04x", ch);
+                            out += buf;
+                        } else out.push_back(ch);
+                    }
+                    out += "\":" + std::to_string(dv);
+                } while (ps.eat(','));
+            }
+            if (!ps.expect('}')) return false;
+            out.push_back('}');
+        }
+        else if (k == "message") {
+            ps.ws();
+            if (ps.peek('"')) {
+                if (!ps.str(b.messages[row])) return false;
+                b.has_message[row] = 1;
+                if (b.messages[row].find('\x1f') != std::string::npos) {
+                    b.unsupported = true; b.err = "separator in message";
+                }
+            }
+            else if (!ps.skip()) return false;
+        }
+        else if (k == "ops") {
+            if (!ps.expect('[')) return false;
+            if (!ps.eat(']')) {
+                do { if (!parse_op(ps, b, b.err_obj, row)) return false; } while (ps.eat(','));
+                if (!ps.expect(']')) return false;
+            }
+        }
+        else { if (!ps.skip()) return false; }
+    } while (ps.eat(','));
+    if (!ps.expect('}')) return false;
+    // ins target actor = the change's own actor
+    int32_t rank = b.intern(b.actors[row]);
+    for (size_t i = ops_from; i < b.op_kind.size(); i++)
+        if (b.op_ta[i] == -2) b.op_ta[i] = rank;
+    return true;
+}
+
+struct Handle {
+    Batch b;
+    std::string obj_id;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* amtpu_parse(const char* json, long json_len, const char* obj_id) {
+    auto* h = new Handle();
+    h->obj_id = obj_id;
+    h->b.err_obj = obj_id;
+    Parser ps(json, (size_t)json_len);
+    if (!ps.expect('[')) { h->b.unsupported = true; h->b.err = ps.err; return h; }
+    if (!ps.eat(']')) {
+        do {
+            if (!parse_change(ps, h->b)) {
+                h->b.unsupported = true;
+                h->b.err = ps.err.empty() ? "parse error" : ps.err;
+                return h;
+            }
+        } while (ps.eat(','));
+        if (!ps.expect(']')) { h->b.unsupported = true; h->b.err = ps.err; }
+    }
+    return h;
+}
+
+int amtpu_unsupported(void* hv) { return ((Handle*)hv)->b.unsupported ? 1 : 0; }
+
+const char* amtpu_error(void* hv) { return ((Handle*)hv)->b.err.c_str(); }
+
+long amtpu_n_changes(void* hv) { return (long)((Handle*)hv)->b.actors.size(); }
+long amtpu_n_ops(void* hv) { return (long)((Handle*)hv)->b.op_kind.size(); }
+long amtpu_n_actors(void* hv) { return (long)((Handle*)hv)->b.actor_table.size(); }
+
+void amtpu_fill_ops(void* hv, int32_t* op_change, int8_t* op_kind,
+                    int32_t* ta, int32_t* tc, int32_t* pa, int32_t* pc,
+                    int64_t* value) {
+    Batch& b = ((Handle*)hv)->b;
+    size_t n = b.op_kind.size();
+    memcpy(op_change, b.op_change.data(), n * 4);
+    memcpy(op_kind, b.op_kind.data(), n);
+    memcpy(ta, b.op_ta.data(), n * 4);
+    memcpy(tc, b.op_tc.data(), n * 4);
+    memcpy(pa, b.op_pa.data(), n * 4);
+    memcpy(pc, b.op_pc.data(), n * 4);
+    memcpy(value, b.op_value.data(), n * 8);
+}
+
+void amtpu_fill_seqs(void* hv, int32_t* seqs) {
+    Batch& b = ((Handle*)hv)->b;
+    memcpy(seqs, b.seqs.data(), b.seqs.size() * 4);
+}
+
+// '\n'-joined string tables (actors, actor_table, deps json, messages)
+static void join(const std::vector<std::string>& v, std::string& out) {
+    out.clear();
+    for (size_t i = 0; i < v.size(); i++) {
+        if (i) out.push_back('\n');
+        out += v[i];
+    }
+}
+
+const char* amtpu_actors(void* hv) {
+    auto* h = (Handle*)hv;
+    join(h->b.actors, h->b.scratch1);
+    return h->b.scratch1.c_str();
+}
+const char* amtpu_actor_table(void* hv) {
+    auto* h = (Handle*)hv;
+    join(h->b.actor_table, h->b.scratch2);
+    return h->b.scratch2.c_str();
+}
+const char* amtpu_deps(void* hv) {
+    auto* h = (Handle*)hv;
+    join(h->b.deps_json, h->b.scratch3);
+    return h->b.scratch3.c_str();
+}
+const char* amtpu_messages(void* hv) {
+    auto* h = (Handle*)hv;
+    // messages may contain '\n'; join with '\x1f' (unit separator)
+    h->b.scratch4.clear();
+    for (size_t i = 0; i < h->b.messages.size(); i++) {
+        if (i) h->b.scratch4.push_back('\x1f');
+        h->b.scratch4.push_back(h->b.has_message[i] ? '1' : '0');
+        h->b.scratch4 += h->b.messages[i];
+    }
+    return h->b.scratch4.c_str();
+}
+
+void amtpu_free(void* hv) { delete (Handle*)hv; }
+
+}  // extern "C"

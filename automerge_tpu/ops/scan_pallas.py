@@ -11,9 +11,9 @@ running (rank, head, vis) totals across the sequential TPU grid in SMEM
 scratch — the standard single-pass carry pattern (grid steps execute in
 order on a TPU core).
 
-The kernel is shape-generic over C = ROWS*LANES*num_tiles; callers pad to a
-tile multiple (the engine's capacities are already power-of-two buckets).
-`interpret=True` runs it on CPU for the parity tests.
+The kernel is shape-generic: inputs pad internally to a ROWS*LANES tile
+multiple and outputs slice back to the caller's capacity. `interpret=True`
+runs it on CPU for the parity tests.
 """
 
 from __future__ import annotations
@@ -121,10 +121,16 @@ def fused_segment_scans(chain, has_value, n_elems, *, interpret: bool = False):
     rank_incl[i] = number of segment starts at slots <= i (the condensed-tree
     node id of i's segment); seg_head[i] = slot of the latest segment head
     <= i; cumvis[i] = number of visible elements at slots <= i (the
-    skip-list-index replacement). C must be a multiple of 1024.
+    skip-list-index replacement). Any capacity works; inputs pad internally
+    to a tile multiple (engine buckets are 2^k or 3*2^(k-1), not all tile
+    multiples) and the outputs are sliced back.
     """
-    C = chain.shape[0]
-    assert C % TILE == 0, f"capacity {C} not a multiple of {TILE}"
+    C0 = chain.shape[0]
+    C = ((C0 + TILE - 1) // TILE) * TILE
+    if C != C0:
+        pad = ((0, C - C0),)
+        chain = jnp.pad(chain, pad)
+        has_value = jnp.pad(has_value, pad)
     grid = C // TILE
     shape2d = (grid * ROWS, LANES)
 
@@ -151,5 +157,5 @@ def fused_segment_scans(chain, has_value, n_elems, *, interpret: bool = False):
         interpret=interpret,
     )(jnp.asarray([n_elems], jnp.int32),
       chain.reshape(shape2d), has_value.reshape(shape2d))
-    rank, head, cumvis = (o.reshape(C) for o in out)
+    rank, head, cumvis = (o.reshape(C)[:C0] for o in out)
     return rank, head, cumvis

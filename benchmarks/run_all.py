@@ -3,8 +3,8 @@
 Usage: python -m benchmarks.run_all [--quick]
 
 Config 5 (the headline 1M-char / 10k-actor merge) is bench.py at the repo
-root — the driver runs it separately; `run_all` includes a reduced variant
-unless --quick is absent and AUTOMERGE_BENCH_FULL=1.
+root — the driver runs it separately. --quick shrinks configs 3 and 4 for
+fast iteration.
 """
 
 import sys

@@ -107,6 +107,28 @@ def test_duplicate_batch_is_noop_without_graduation():
     assert not ds._overlay  # still on the vmapped fast path
 
 
+def test_in_batch_duplicate_change_is_idempotent():
+    """The same change twice within ONE batch must apply once, like the
+    general engine, not raise a duplicate-elemId error."""
+    from automerge_tpu.engine import TextChangeBatch
+    ds = DeviceTextDocSet(["ib"])
+    ch = typing_change("w", 1, "a", obj="ib")
+    ds.apply_batches({"ib": TextChangeBatch.from_changes([ch, ch], "ib")})
+    single = DeviceTextDoc("ib").apply_changes([ch, ch])
+    assert ds.texts()["ib"] == single.text() == "a"
+
+
+def test_sequential_same_actor_batch_stays_fast():
+    """seq n and n+1 from one actor in one batch ride the vmapped path."""
+    from automerge_tpu.engine import TextChangeBatch
+    ds = DeviceTextDocSet(["sq"])
+    chs = [typing_change("w", 1, "ab", obj="sq"),
+           typing_change("w", 2, "cd", start_ctr=3, after="w:2", obj="sq")]
+    ds.apply_batches({"sq": TextChangeBatch.from_changes(chs, "sq")})
+    assert ds.texts()["sq"] == "abcd"
+    assert not ds._overlay
+
+
 @pytest.mark.parametrize("seed", range(3))
 def test_random_docsets_match_single(seed):
     from automerge_tpu.engine import TextChangeBatch

@@ -1,0 +1,129 @@
+"""Native C++ wire codec vs the Python decoder: identical columnar batches.
+
+The native tier is optional — tests skip when no toolchain is available —
+but when it builds, every in-scope payload must decode bit-identically to
+`TextChangeBatch.from_changes`, and out-of-scope payloads must fall back.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from automerge_tpu.engine import DeviceTextDoc, TextChangeBatch
+from automerge_tpu import native
+
+
+def typing_change(actor, seq, text, start=1, after="_head", deps=None,
+                  obj="t", message=None):
+    ops = []
+    key = after
+    for i, c in enumerate(text):
+        ops += [{"action": "ins", "obj": obj, "key": key, "elem": start + i},
+                {"action": "set", "obj": obj, "key": f"{actor}:{start+i}",
+                 "value": c}]
+        key = f"{actor}:{start+i}"
+    ch = {"actor": actor, "seq": seq, "deps": deps or {}, "ops": ops}
+    if message is not None:
+        ch["message"] = message
+    return ch
+
+
+def assert_batches_equal(a: TextChangeBatch, b: TextChangeBatch):
+    assert a.actors == b.actors
+    assert a.actor_table == b.actor_table
+    assert a.deps == b.deps
+    assert a.messages == b.messages
+    np.testing.assert_array_equal(a.seqs, b.seqs)
+    for f in ("op_change", "op_kind", "op_target_actor", "op_target_ctr",
+              "op_parent_actor", "op_parent_ctr", "op_value"):
+        np.testing.assert_array_equal(getattr(a, f), getattr(b, f), f)
+
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native toolchain unavailable")
+
+
+@needs_native
+def test_parity_typing():
+    changes = [typing_change("alice", 1, "hello world", message="hi\nthere"),
+               typing_change("bob", 1, "né±漢🎉", start=1,
+                             deps={"alice": 1}),
+               {"actor": "bob", "seq": 2, "deps": {}, "ops": [
+                   {"action": "del", "obj": "t", "key": "alice:2"},
+                   {"action": "ins", "obj": "t", "key": "bob:1", "elem": 99},
+                   {"action": "set", "obj": "t", "key": "bob:99",
+                    "value": "é"}]}]
+    payload = json.dumps(changes)
+    fast = native.decode_text_changes(payload, "t")
+    assert fast is not None
+    slow = TextChangeBatch.from_changes(changes, "t")
+    assert_batches_equal(fast, slow)
+
+
+@needs_native
+def test_engine_accepts_native_batch():
+    changes = [typing_change("w", 1, "native!")]
+    batch = TextChangeBatch.from_json(json.dumps(changes), "t")
+    doc = DeviceTextDoc("t").apply_batch(batch)
+    assert doc.text() == "native!"
+
+
+@needs_native
+def test_out_of_scope_falls_back():
+    # rich (multi-char) value -> native returns None, from_json still works
+    changes = [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+        {"action": "ins", "obj": "t", "key": "_head", "elem": 1},
+        {"action": "set", "obj": "t", "key": "a:1", "value": "multi-char"}]}]
+    assert native.decode_text_changes(json.dumps(changes), "t") is None
+    batch = TextChangeBatch.from_json(json.dumps(changes), "t")
+    assert batch.value_pool[0]["value"] == "multi-char"
+
+
+@needs_native
+def test_escapes_and_unicode():
+    changes = [{"actor": "aé", "seq": 1, "deps": {}, "ops": [
+        {"action": "ins", "obj": "t", "key": "_head", "elem": 1},
+        {"action": "set", "obj": "t", "key": "aé:1",
+         "value": "🎉"}]}]  # surrogate-pair emoji
+    payload = json.dumps(changes)
+    fast = native.decode_text_changes(payload, "t")
+    slow = TextChangeBatch.from_changes(json.loads(payload), "t")
+    assert fast is not None
+    assert_batches_equal(fast, slow)
+
+
+@needs_native
+def test_pretty_printed_payload():
+    """Whitespace/indentation in the wire JSON must not break decoding."""
+    changes = [typing_change("alice", 1, "hi"),
+               typing_change("bob", 1, "yo", deps={"alice": 1})]
+    pretty = json.dumps(changes, indent=2)
+    fast = native.decode_text_changes(pretty, "t")
+    slow = TextChangeBatch.from_changes(changes, "t")
+    assert fast is not None
+    assert_batches_equal(fast, slow)
+
+
+@needs_native
+def test_newline_actor_falls_back():
+    changes = [{"actor": "a\nb", "seq": 1, "deps": {}, "ops": []}]
+    assert native.decode_text_changes(json.dumps(changes), "t") is None
+    assert TextChangeBatch.from_json(json.dumps(changes), "t").actors == ["a\nb"]
+
+
+@needs_native
+def test_decode_speed_sanity():
+    """The native decoder should beat the Python loop comfortably."""
+    import time
+    changes = [typing_change(f"actor-{a}", 1, "x" * 500)
+               for a in range(20)]
+    payload = json.dumps(changes)
+    t0 = time.perf_counter()
+    fast = native.decode_text_changes(payload, "t")
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    slow = TextChangeBatch.from_changes(json.loads(payload), "t")
+    t_python = time.perf_counter() - t0
+    assert_batches_equal(fast, slow)
+    assert t_native < t_python  # typically 20-100x

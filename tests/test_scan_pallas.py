@@ -21,10 +21,10 @@ def reference(chain, has_value, n_elems):
 
 
 @pytest.mark.parametrize("seed", range(4))
-@pytest.mark.parametrize("tiles", [1, 3])
+@pytest.mark.parametrize("tiles", [1, 1.5, 3])
 def test_matches_numpy(seed, tiles):
     rng = np.random.default_rng(seed)
-    C = TILE * tiles
+    C = int(TILE * tiles)  # 1.5 -> a 3*2^(k-1) bucket (internal padding)
     n_elems = int(rng.integers(0, C - 1))
     chain = rng.random(C) < 0.7
     chain[0] = False
