@@ -17,7 +17,12 @@ from .api import (  # noqa: F401
     get_changes, get_history, get_missing_deps, init, load, merge, redo, save,
     to_json, undo,
 )
+from . import types  # noqa: F401
 from .backend import Backend  # noqa: F401
+from .engine import (  # noqa: F401
+    DeviceMapDoc, DeviceTextDoc, DeviceTextDocSet, MapChangeBatch,
+    TextChangeBatch,
+)
 from .frontend import (  # noqa: F401
     Counter, Frontend, Table, Text, can_redo, can_undo, get_actor_id,
     get_conflicts, get_object_by_id, get_object_id, set_actor_id,
