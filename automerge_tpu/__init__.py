@@ -19,10 +19,18 @@ from .api import (  # noqa: F401
 )
 from . import types  # noqa: F401
 from .backend import Backend  # noqa: F401
-from .engine import (  # noqa: F401
-    DeviceMapDoc, DeviceTextDoc, DeviceTextDocSet, MapChangeBatch,
-    TextChangeBatch,
-)
+
+# Device-engine classes resolve lazily (PEP 562): the facade tier is pure
+# Python and must import without jax; the engines pull it in on first use.
+_ENGINE_EXPORTS = ("DeviceMapDoc", "DeviceTextDoc", "DeviceTextDocSet",
+                   "MapChangeBatch", "TextChangeBatch")
+
+
+def __getattr__(name):
+    if name in _ENGINE_EXPORTS:
+        from . import engine
+        return getattr(engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from .frontend import (  # noqa: F401
     Counter, Frontend, Table, Text, can_redo, can_undo, get_actor_id,
     get_conflicts, get_object_by_id, get_object_id, set_actor_id,

@@ -14,11 +14,11 @@ readers get the full schema.
 
 from __future__ import annotations
 
-from typing import Any, List, Literal, Optional, TypedDict
+from typing import Any, Dict, List, Literal, Optional, TypedDict
 
 # Vector clock: actor id -> highest seq seen (INTERNALS.md:104-141 in the
 # reference; used by sync and causal admission).
-Clock = dict  # Dict[str, int]
+Clock = Dict[str, int]
 
 OpAction = Literal["makeMap", "makeList", "makeText", "makeTable",
                    "ins", "set", "del", "inc", "link"]
@@ -38,9 +38,8 @@ class Op(TypedDict, total=False):
     obj: str                   # target object id (UUID; ROOT_ID for root)
     key: str                   # map key / elemId / '_head'
     elem: int                  # ins: new element's counter
-    value: Any                 # set/inc payload
+    value: Any                 # set/inc payload; link: child object id
     datatype: DataType
-    child: str                 # link: child object id
 
 
 class Change(TypedDict, total=False):
@@ -58,6 +57,7 @@ class Conflict(TypedDict, total=False):
     actor: str
     value: Any
     link: bool
+    datatype: DataType         # e.g. a counter that lost LWW resolution
 
 
 class Diff(TypedDict, total=False):

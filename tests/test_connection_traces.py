@@ -1,0 +1,138 @@
+"""Exact sync-protocol message traces.
+
+Counterpart of the reference's connection suite mini-DSL
+(/root/reference/test/connection_test.js): peers wired through recording
+spies, asserting the precise {docId, clock, changes?} sequences, dropped-
+message tolerance, and message-count invariants.
+"""
+
+import automerge_tpu as am
+from automerge_tpu import Connection, DocSet
+
+
+class Spy:
+    """Records outbound messages; delivery is manual (supports drops)."""
+
+    def __init__(self):
+        self.sent = []
+
+    def __call__(self, msg):
+        self.sent.append(msg)
+
+
+def wire():
+    ds_a, ds_b = DocSet(), DocSet()
+    spy_a, spy_b = Spy(), Spy()
+    conn_a = Connection(ds_a, spy_a)
+    conn_b = Connection(ds_b, spy_b)
+    return ds_a, ds_b, conn_a, conn_b, spy_a, spy_b
+
+
+def deliver_all(spy, conn, start=0):
+    """Deliver spy.sent[start:] to conn; returns new high-water mark."""
+    i = start
+    while i < len(spy.sent):
+        conn.receive_msg(spy.sent[i])
+        i += 1
+    return i
+
+
+def test_doc_transfer_trace():
+    ds_a, ds_b, conn_a, conn_b, spy_a, spy_b = wire()
+    doc = am.change(am.init("alice"), lambda d: d.__setitem__("x", 1))
+    ds_a.set_doc("doc1", doc)
+    conn_a.open()
+    conn_b.open()
+
+    # A advertises its clock, no changes yet
+    assert len(spy_a.sent) == 1
+    assert spy_a.sent[0]["docId"] == "doc1"
+    assert spy_a.sent[0]["clock"] == {"alice": 1}
+    assert "changes" not in spy_a.sent[0]
+
+    # B, receiving an advertisement for an unknown doc, requests it
+    a_mark = deliver_all(spy_a, conn_b)
+    assert len(spy_b.sent) == 1
+    assert spy_b.sent[0] == {"docId": "doc1", "clock": {}}
+
+    # A responds with the changes
+    deliver_all(spy_b, conn_a)
+    assert len(spy_a.sent) == 2
+    assert spy_a.sent[1]["clock"] == {"alice": 1}
+    assert len(spy_a.sent[1]["changes"]) == 1
+
+    deliver_all(spy_a, conn_b, a_mark)
+    assert am.to_json(ds_b.get_doc("doc1")) == {"x": 1}
+
+
+def test_no_redundant_messages_when_in_sync():
+    ds_a, ds_b, conn_a, conn_b, spy_a, spy_b = wire()
+    ds_a.set_doc("d", am.change(am.init("alice"),
+                                lambda d: d.__setitem__("x", 1)))
+    conn_a.open()
+    conn_b.open()
+    a_mark = b_mark = 0
+    for _ in range(4):  # run message exchange to quiescence
+        a_mark = deliver_all(spy_a, conn_b, a_mark)
+        b_mark = deliver_all(spy_b, conn_a, b_mark)
+    total = len(spy_a.sent) + len(spy_b.sent)
+    # converged: one more full pump produces no new messages
+    a_mark = deliver_all(spy_a, conn_b, a_mark)
+    b_mark = deliver_all(spy_b, conn_a, b_mark)
+    assert len(spy_a.sent) + len(spy_b.sent) == total
+
+
+def test_concurrent_changes_both_directions():
+    ds_a, ds_b, conn_a, conn_b, spy_a, spy_b = wire()
+    base = am.change(am.init("alice"), lambda d: d.__setitem__("x", 0))
+    ds_a.set_doc("d", base)
+    conn_a.open(); conn_b.open()
+    a_mark = b_mark = 0
+    for _ in range(4):
+        a_mark = deliver_all(spy_a, conn_b, a_mark)
+        b_mark = deliver_all(spy_b, conn_a, b_mark)
+
+    # now both sides edit concurrently
+    doc_b = ds_b.get_doc("d")
+    doc_b = am.change(am.set_actor_id(doc_b, "bob"),
+                      lambda d: d.__setitem__("from_b", 2))
+    ds_b.set_doc("d", doc_b)
+    doc_a = am.change(ds_a.get_doc("d"), lambda d: d.__setitem__("from_a", 1))
+    ds_a.set_doc("d", doc_a)
+    for _ in range(4):
+        a_mark = deliver_all(spy_a, conn_b, a_mark)
+        b_mark = deliver_all(spy_b, conn_a, b_mark)
+
+    assert am.to_json(ds_a.get_doc("d")) == am.to_json(ds_b.get_doc("d")) \
+        == {"x": 0, "from_a": 1, "from_b": 2}
+
+
+def test_dropped_message_recovered_by_next_round():
+    ds_a, ds_b, conn_a, conn_b, spy_a, spy_b = wire()
+    ds_a.set_doc("d", am.change(am.init("alice"),
+                                lambda d: d.__setitem__("x", 1)))
+    conn_a.open(); conn_b.open()
+    # DROP A's advertisement entirely; B never learns about the doc yet
+    a_mark = len(spy_a.sent)
+    # a new local change triggers a fresh message
+    ds_a.set_doc("d", am.change(ds_a.get_doc("d"),
+                                lambda d: d.__setitem__("y", 2)))
+    b_mark = 0
+    for _ in range(4):
+        a_mark = deliver_all(spy_a, conn_b, a_mark)
+        b_mark = deliver_all(spy_b, conn_a, b_mark)
+    assert am.to_json(ds_b.get_doc("d")) == {"x": 1, "y": 2}
+
+
+def test_multi_doc_multiplexing():
+    ds_a, ds_b, conn_a, conn_b, spy_a, spy_b = wire()
+    for i in range(3):
+        ds_a.set_doc(f"doc{i}", am.change(
+            am.init(f"alice{i}"), lambda d, i=i: d.__setitem__("n", i)))
+    conn_a.open(); conn_b.open()
+    a_mark = b_mark = 0
+    for _ in range(4):
+        a_mark = deliver_all(spy_a, conn_b, a_mark)
+        b_mark = deliver_all(spy_b, conn_a, b_mark)
+    for i in range(3):
+        assert am.to_json(ds_b.get_doc(f"doc{i}")) == {"n": i}

@@ -1,0 +1,154 @@
+"""Proxy-layer semantics inside change blocks.
+
+Counterpart of the reference's proxy conformance suite
+(/root/reference/test/proxies_test.js): the reference pins JS Array/Object
+semantics on its ES Proxy layer; these pin the Python dict/list protocols on
+ours — reads, slices, mutators, errors, and read-your-writes behavior.
+"""
+
+import pytest
+
+import automerge_tpu as am
+
+
+def change(doc, cb):
+    return am.change(doc, cb)
+
+
+@pytest.fixture
+def listdoc():
+    return change(am.init("actor-1"),
+                  lambda d: d.__setitem__("xs", [10, 20, 30, 40]))
+
+
+class TestMapProxy:
+    def test_read_write_styles(self):
+        def cb(d):
+            d["a"] = 1
+            d.b = 2
+            assert d["b"] == 2 and d.a == 1
+            assert d.get("missing", "dflt") == "dflt"
+        doc = change(am.init(), cb)
+        assert am.to_json(doc) == {"a": 1, "b": 2}
+
+    def test_keys_values_items_iteration(self):
+        seen = {}
+
+        def cb(d):
+            d.update({"x": 1, "y": 2})
+            seen["keys"] = sorted(d.keys())
+            seen["values"] = sorted(d.values())
+            seen["items"] = sorted(d.items())
+            seen["iter"] = sorted(iter(d))
+            seen["len"] = len(d)
+            seen["contains"] = "x" in d and "z" not in d
+        change(am.init(), cb)
+        assert seen == {"keys": ["x", "y"], "values": [1, 2],
+                        "items": [("x", 1), ("y", 2)], "iter": ["x", "y"],
+                        "len": 2, "contains": True}
+
+    def test_delete_missing_key_raises(self):
+        doc = change(am.init(), lambda d: d.__setitem__("a", 1))
+        with pytest.raises(KeyError):
+            change(doc, lambda d: d.__delitem__("nope"))
+
+    def test_delattr(self):
+        doc = change(am.init(), lambda d: d.update({"a": 1, "b": 2}))
+        doc = change(doc, lambda d: delattr(d, "a"))
+        assert am.to_json(doc) == {"b": 2}
+
+    def test_nested_proxy_identity_and_equality(self):
+        def cb(d):
+            d["m"] = {"k": [1, 2]}
+            assert d["m"] == {"k": [1, 2]}
+            assert d["m"]["k"] == [1, 2]
+        change(am.init(), cb)
+
+
+class TestListProxy:
+    def test_slice_reads(self, listdoc):
+        seen = {}
+
+        def cb(d):
+            xs = d["xs"]
+            seen["mid"] = xs[1:3]
+            seen["neg"] = xs[-2:]
+            seen["step"] = xs[::2]
+            seen["rev"] = xs[::-1]
+        change(listdoc, cb)
+        assert seen == {"mid": [20, 30], "neg": [30, 40],
+                        "step": [10, 30], "rev": [40, 30, 20, 10]}
+
+    def test_slice_delete(self, listdoc):
+        doc = change(listdoc, lambda d: d["xs"].__delitem__(slice(1, 3)))
+        assert am.to_json(doc) == {"xs": [10, 40]}
+
+    def test_slice_assignment_rejected(self, listdoc):
+        with pytest.raises(TypeError, match="splice"):
+            change(listdoc, lambda d: d["xs"].__setitem__(slice(0, 1), [9]))
+
+    def test_stepped_slice_delete_rejected(self, listdoc):
+        with pytest.raises(TypeError, match="stepped"):
+            change(listdoc, lambda d: d["xs"].__delitem__(slice(0, 4, 2)))
+
+    def test_pop_remove_index_count(self, listdoc):
+        seen = {}
+
+        def cb(d):
+            xs = d["xs"]
+            seen["pop"] = xs.pop()
+            seen["pop0"] = xs.pop(0)
+            xs.append(20)
+            seen["index"] = xs.index(20)
+            seen["count"] = xs.count(20)
+            xs.remove(20)
+            seen["after_remove"] = xs.to_list()
+        doc = change(listdoc, cb)
+        assert seen == {"pop": 40, "pop0": 10, "index": 0, "count": 2,
+                        "after_remove": [30, 20]}
+        assert am.to_json(doc) == {"xs": [30, 20]}
+
+    def test_remove_missing_raises(self, listdoc):
+        with pytest.raises(ValueError):
+            change(listdoc, lambda d: d["xs"].remove(999))
+
+    def test_index_missing_raises(self, listdoc):
+        with pytest.raises(ValueError):
+            change(listdoc, lambda d: d["xs"].index(999))
+
+    def test_pop_empty_raises(self):
+        doc = change(am.init(), lambda d: d.__setitem__("xs", []))
+        with pytest.raises(IndexError):
+            change(doc, lambda d: d["xs"].pop())
+
+    def test_splice(self, listdoc):
+        doc = change(listdoc,
+                     lambda d: d["xs"].splice(1, 2, [99, 98, 97]))
+        assert am.to_json(doc) == {"xs": [10, 99, 98, 97, 40]}
+
+    def test_read_your_writes_within_block(self, listdoc):
+        seen = {}
+
+        def cb(d):
+            xs = d["xs"]
+            xs[0] = 11
+            seen["updated"] = xs[0]
+            xs.insert(0, 5)
+            seen["len"] = len(xs)
+            seen["contains"] = 5 in xs
+        change(listdoc, cb)
+        assert seen == {"updated": 11, "len": 5, "contains": True}
+
+    def test_nested_list_of_maps_mutation(self):
+        doc = change(am.init(), lambda d: d.__setitem__(
+            "rows", [{"n": 1}, {"n": 2}]))
+
+        def cb(d):
+            for row in d["rows"]:
+                row["n"] = row["n"] * 10
+        doc = change(doc, cb)
+        assert am.to_json(doc) == {"rows": [{"n": 10}, {"n": 20}]}
+
+    def test_out_of_range_read_raises(self, listdoc):
+        with pytest.raises(IndexError):
+            change(listdoc, lambda d: d["xs"][99])

@@ -1,0 +1,99 @@
+"""Text span/embed depth: control characters, embedded objects, and the
+span contract a rich-text editor bridge builds on.
+
+Counterpart of the reference's span sections
+(/root/reference/test/text_test.js:368-437 and the Quill-delta bridge that
+consumes to_spans)."""
+
+import automerge_tpu as am
+from automerge_tpu import Text
+
+
+def make(initial=""):
+    return am.change(am.init("writer"),
+                     lambda d: d.__setitem__("t", Text(initial)))
+
+
+class TestSpans:
+    def test_empty(self):
+        doc = make()
+        assert doc["t"].to_spans() == []
+
+    def test_plain_run(self):
+        doc = make("hello")
+        assert doc["t"].to_spans() == ["hello"]
+
+    def test_embed_objects_split_runs(self):
+        doc = make("ab")
+        doc = am.change(doc, lambda d: d["t"].insert_at(1, {"bold": True}))
+        assert doc["t"].to_spans() == ["a", {"bold": True}, "b"]
+        # embeds are excluded from the plain string
+        assert str(doc["t"]) == "ab"
+        assert len(doc["t"]) == 3
+
+    def test_leading_and_trailing_embeds(self):
+        doc = make("x")
+        doc = am.change(doc, lambda d: d["t"].insert_at(0, {"s": 1}))
+        doc = am.change(doc, lambda d: d["t"].insert_at(2, {"e": 2}))
+        assert doc["t"].to_spans() == [{"s": 1}, "x", {"e": 2}]
+
+    def test_adjacent_embeds(self):
+        doc = make("ab")
+        doc = am.change(doc, lambda d: d["t"].insert_at(1, {"i": 1}, {"i": 2}))
+        assert doc["t"].to_spans() == ["a", {"i": 1}, {"i": 2}, "b"]
+
+    def test_deleting_embed_rejoins_runs(self):
+        doc = make("ab")
+        doc = am.change(doc, lambda d: d["t"].insert_at(1, {"m": 1}))
+        doc = am.change(doc, lambda d: d["t"].delete_at(1))
+        assert doc["t"].to_spans() == ["ab"]
+
+    def test_control_characters_kept_in_string(self):
+        doc = make("a\nb\tc")
+        assert str(doc["t"]) == "a\nb\tc"
+        assert doc["t"].to_spans() == ["a\nb\tc"]
+
+    def test_embed_values_survive_merge(self):
+        a = make("hi")
+        a = am.change(a, lambda d: d["t"].insert_at(1, {"link": "url"}))
+        b = am.merge(am.init("other"), a)
+        b = am.change(b, lambda d: d["t"].insert_at(3, "!"))
+        m1, m2 = am.merge(a, b), am.merge(b, a)
+        assert m1["t"].to_spans() == m2["t"].to_spans() \
+            == ["h", {"link": "url"}, "i!"]
+
+    def test_spans_survive_save_load(self):
+        doc = make("xy")
+        doc = am.change(doc, lambda d: d["t"].insert_at(1, {"k": [1, 2]}))
+        loaded = am.load(am.save(doc), "reader")
+        assert loaded["t"].to_spans() == ["x", {"k": [1, 2]}, "y"]
+
+
+class TestTextEditingDepth:
+    def test_slice_and_iteration(self):
+        doc = make("hello")
+        t = doc["t"]
+        assert t[1:4] == ["e", "l", "l"]
+        assert list(t) == list("hello")
+        assert t == "hello" and t == Text("hello")
+
+    def test_get_elem_id_stability_across_edits(self):
+        doc = make("abc")
+        id_b = doc["t"].get_elem_id(1)
+        doc = am.change(doc, lambda d: d["t"].insert_at(0, "z"))
+        assert doc["t"].get_elem_id(2) == id_b
+
+    def test_unicode_text(self):
+        doc = make("héllo")
+        doc = am.change(doc, lambda d: d["t"].insert_at(5, "🎉"))
+        assert str(doc["t"]) == "héllo🎉"
+        loaded = am.load(am.save(doc))
+        assert str(loaded["t"]) == "héllo🎉"
+
+    def test_overlapping_concurrent_deletes_converge(self):
+        a = make("abcdef")
+        b = am.merge(am.init("other"), a)
+        a = am.change(a, lambda d: d["t"].delete_at(1, 3))   # remove bcd
+        b = am.change(b, lambda d: d["t"].delete_at(2, 3))   # remove cde
+        m1, m2 = am.merge(a, b), am.merge(b, a)
+        assert str(m1["t"]) == str(m2["t"]) == "af"

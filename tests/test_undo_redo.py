@@ -1,0 +1,105 @@
+"""Undo/redo depth: list ops, merged-document interaction, stack discipline.
+
+Mirrors the reference's undo/redo block (/root/reference/test/test.js:
+956-1297): undo affects only the local actor's own changes, inverse ops are
+synthesized against current state, and redo replays exactly what undo
+removed.
+"""
+
+import pytest
+
+import automerge_tpu as am
+
+
+def set_(k, v):
+    return lambda d: d.__setitem__(k, v)
+
+
+class TestUndoListOps:
+    def test_undo_list_insert(self):
+        d = am.change(am.init(), set_("xs", ["a"]))
+        d = am.change(d, lambda doc: doc["xs"].append("b"))
+        d = am.undo(d)
+        assert am.to_json(d) == {"xs": ["a"]}
+
+    def test_undo_list_delete_restores_element(self):
+        d = am.change(am.init(), set_("xs", ["a", "b", "c"]))
+        d = am.change(d, lambda doc: doc["xs"].delete_at(1))
+        assert am.to_json(d) == {"xs": ["a", "c"]}
+        d = am.undo(d)
+        assert am.to_json(d) == {"xs": ["a", "b", "c"]}
+
+    def test_undo_list_set_restores_old_value(self):
+        d = am.change(am.init(), set_("xs", ["a", "b"]))
+        d = am.change(d, lambda doc: doc["xs"].__setitem__(0, "z"))
+        d = am.undo(d)
+        assert am.to_json(d) == {"xs": ["a", "b"]}
+
+    def test_undo_redo_chain(self):
+        d = am.change(am.init(), set_("xs", []))
+        for c in "abc":
+            d = am.change(d, lambda doc, c=c: doc["xs"].append(c))
+        d = am.undo(am.undo(d))
+        assert am.to_json(d) == {"xs": ["a"]}
+        d = am.redo(d)
+        assert am.to_json(d) == {"xs": ["a", "b"]}
+        d = am.redo(d)
+        assert am.to_json(d) == {"xs": ["a", "b", "c"]}
+        assert not am.can_redo(d)
+
+    def test_undo_text_edit(self):
+        d = am.change(am.init(), set_("t", am.Text("hello")))
+        d = am.change(d, lambda doc: doc["t"].delete_at(0, 2))
+        assert str(d["t"]) == "llo"
+        d = am.undo(d)
+        assert str(d["t"]) == "hello"
+
+
+class TestUndoWithMerges:
+    def test_undo_skips_remote_changes(self):
+        a = am.change(am.init("actor-1"), set_("mine", 1))
+        b = am.change(am.init("actor-2"), set_("theirs", 2))
+        merged = am.merge(a, b)
+        undone = am.undo(merged)
+        # only the local actor's change is undone
+        assert am.to_json(undone) == {"theirs": 2}
+
+    def test_undo_then_merge_converges(self):
+        a = am.change(am.init("actor-1"), set_("x", 1))
+        b = am.merge(am.init("actor-2"), a)
+        a = am.undo(a)
+        b = am.change(b, set_("y", 2))
+        m1, m2 = am.merge(a, b), am.merge(b, a)
+        assert am.to_json(m1) == am.to_json(m2) == {"y": 2}
+
+    def test_undo_set_after_remote_overwrite_deletes_key(self):
+        a = am.change(am.init("actor-1"), set_("k", "a-val"))
+        b = am.merge(am.init("actor-2"), a)
+        b = am.change(b, set_("k", "b-val"))
+        merged = am.merge(a, b)           # b's later write overwrites
+        # actor-1's inverse op is `del k`, issued with the merged clock as
+        # deps — it causally covers b's write too, so the key disappears
+        # (inverse ops are synthesized at change time, applied at undo time)
+        undone = am.undo(merged)
+        assert am.to_json(undone) == {}
+
+
+class TestStackDiscipline:
+    def test_interleaved_undo_redo_and_change(self):
+        d = am.change(am.init(), set_("a", 1))
+        d = am.change(d, set_("b", 2))
+        d = am.undo(d)                     # removes b
+        d = am.change(d, set_("c", 3))     # clears redo stack
+        assert not am.can_redo(d)
+        d = am.undo(d)                     # removes c
+        d = am.undo(d)                     # removes a
+        assert am.to_json(d) == {}
+        assert not am.can_undo(d)
+
+    def test_empty_change_undo_is_noop_then_pops_previous(self):
+        d = am.change(am.init(), set_("a", 1))
+        d2 = am.empty_change(d, "checkpoint")
+        d3 = am.undo(d2)                   # pops the empty entry: no-op
+        assert am.to_json(d3) == {"a": 1}
+        d4 = am.undo(d3)                   # now pops the real change
+        assert am.to_json(d4) == {}
