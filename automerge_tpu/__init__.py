@@ -31,6 +31,13 @@ def __getattr__(name):
         from . import engine
         return getattr(engine, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_ENGINE_EXPORTS))
+
+
+__all__ = [n for n in dir() if not n.startswith("_")] + list(_ENGINE_EXPORTS)
 from .frontend import (  # noqa: F401
     Counter, Frontend, Table, Text, can_redo, can_undo, get_actor_id,
     get_conflicts, get_object_by_id, get_object_id, set_actor_id,

@@ -184,20 +184,22 @@ class CausalDeviceDoc:
     # ------------------------------------------------------------------
 
     def _apply_slow(self, b, slots, kinds, values, actor_ranks, seqs,
-                    slot_cap: int):
-        """Resolve non-fast assigns against gathered register state."""
+                    slot_cap: int, reg_state):
+        """Resolve non-fast assigns against register state.
+
+        `reg_state` = (value, has, win_actor, win_seq, win_counter) numpy
+        rows aligned with `slots` — pre-gathered by the ingest kernel's
+        packed slow_info output, so resolution costs zero extra device
+        round trips beyond the one write-back scatter."""
         import jax.numpy as jnp
-        from ..ops.ingest import bucket, gather_registers, scatter_registers
+        from ..ops.ingest import bucket, scatter_registers
 
         dev = self._dev
-        uniq = np.unique(slots)
+        uniq, first = np.unique(slots, return_index=True)
         S = bucket(len(uniq), 64)
         slots_p = np.full(S, slot_cap, np.int32)
         slots_p[: len(uniq)] = uniq
-        g_v, g_h, g_wa, g_ws, g_wc = (
-            np.asarray(x) for x in gather_registers(
-                dev["value"], dev["has_value"], dev["win_actor"],
-                dev["win_seq"], dev["win_counter"], jnp.asarray(slots_p)))
+        g_v, g_h, g_wa, g_ws, g_wc = (col[first] for col in reg_state)
 
         regs: dict = {}
         for i, s in enumerate(uniq):
@@ -268,6 +270,20 @@ class CausalDeviceDoc:
         dev["value"], dev["has_value"], dev["win_actor"], dev["win_seq"], \
             dev["win_counter"] = out
         self._invalidate()
+
+    def _fetch_mirrors(self, keys) -> dict:
+        """Host numpy mirrors of device tables, fetched as ONE packed
+        transfer (RTT-bound on remote-attached chips). bool tables come
+        back as bool; everything else int32."""
+        from ..ops.ingest import pack_rows
+        import jax.numpy as jnp
+        dev = self._ensure_dev()
+        packed = np.asarray(pack_rows(*(dev[k] for k in keys)))
+        out = {}
+        for i, k in enumerate(keys):
+            row = packed[i]
+            out[k] = row.astype(bool) if dev[k].dtype == jnp.bool_ else row
+        return out
 
     # ------------------------------------------------------------------
     # subclass hooks

@@ -58,9 +58,8 @@ class DeviceMapDoc(CausalDeviceDoc):
 
     def _mirrors(self) -> dict:
         if self._host is None:
-            dev = self._ensure_dev()
-            self._host = {k: np.asarray(dev[k])
-                          for k in ("value", "has_value", "win_counter")}
+            self._host = self._fetch_mirrors(
+                ("value", "has_value", "win_counter"))
         return self._host
 
     def _remap_device(self, remap: np.ndarray):
@@ -112,8 +111,7 @@ class DeviceMapDoc(CausalDeviceDoc):
         if self.conflicts:
             conflict_slots[: len(self.conflicts)] = list(self.conflicts)
 
-        (value_n, has_n, wa_n, ws_n, wc_n, slow_dev, tslot_dev,
-         n_slow) = apply_map_round(
+        (value_n, has_n, wa_n, ws_n, wc_n, slow_info) = apply_map_round(
             dev["value"], dev["has_value"], dev["win_actor"],
             dev["win_seq"], dev["win_counter"],
             padm(kind, -1, np.int8), padm(slot, out_cap),
@@ -126,14 +124,15 @@ class DeviceMapDoc(CausalDeviceDoc):
         self._cap = out_cap
         self._host = None
 
-        if int(n_slow):
-            slow_np = np.asarray(slow_dev)[:n_ops]
-            tslot_np = np.asarray(tslot_dev)[:n_ops]
-            idxs = np.nonzero(slow_np)[0]
+        # one packed transfer: slow mask + slots + register state
+        info = np.asarray(slow_info)[:, :n_ops]
+        if info[0].any():
+            idxs = np.nonzero(info[0])[0]
             self._apply_slow(
-                b, tslot_np[idxs], kind[idxs], val64[idxs],
+                b, info[1][idxs], kind[idxs], val64[idxs],
                 row_actor_rank[op_row[idxs]], row_seq[op_row[idxs]],
-                slot_cap=self._cap)
+                slot_cap=self._cap,
+                reg_state=tuple(info[r][idxs] for r in range(2, 7)))
 
     # ------------------------------------------------------------------
     # accessors

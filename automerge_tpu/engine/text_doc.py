@@ -98,11 +98,10 @@ class DeviceTextDoc(CausalDeviceDoc):
         self._pos_cache = None
 
     def _mirrors(self) -> dict:
-        """Host numpy mirrors of the element tables (fetched on demand)."""
+        """Host numpy mirrors of the element tables (one packed fetch)."""
         if self._host is None:
-            dev = self._ensure_dev()
-            self._host = {k: np.asarray(dev[k]) for k in
-                          ("parent", "ctr", "actor", "value", "has_value")}
+            self._host = self._fetch_mirrors(
+                ("parent", "ctr", "actor", "value", "has_value"))
         return self._host
 
     def _remap_device(self, remap: np.ndarray):
@@ -253,7 +252,7 @@ class DeviceTextDoc(CausalDeviceDoc):
             else:
                 tables = expand_runs(*tables, *run_args, out_cap=out_cap)
 
-        slow_np = tslot_np = None
+        slow_info_np = None
         if len(rpos):
             M = bucket(len(rpos), 128)
 
@@ -284,10 +283,8 @@ class DeviceTextDoc(CausalDeviceDoc):
                 padm(row_seq[op_row[rpos]], 0),
                 jnp.asarray(conflict_slots), out_cap=out_cap)
             tables = out[:9]
-            slow_dev, tslot_dev, n_slow = out[9], out[10], out[11]
-            if int(n_slow):
-                slow_np = np.asarray(slow_dev)[: len(rpos)]
-                tslot_np = np.asarray(tslot_dev)[: len(rpos)]
+            # one packed transfer: slow mask + slots + register state
+            slow_info_np = np.asarray(out[9])[:, : len(rpos)]
         elif n_runs == 0:
             return
 
@@ -325,13 +322,14 @@ class DeviceTextDoc(CausalDeviceDoc):
         self._seg_bound += 3 * (n_runs + n_res_ins) + 2
         self._invalidate()
 
-        if slow_np is not None:
-            idxs = np.nonzero(slow_np)[0]
+        if slow_info_np is not None and slow_info_np[0].any():
+            idxs = np.nonzero(slow_info_np[0])[0]
             ops_idx = rpos[idxs]
             self._apply_slow(
-                b, tslot_np[idxs], kind[ops_idx], val64[ops_idx],
+                b, slow_info_np[1][idxs], kind[ops_idx], val64[ops_idx],
                 row_actor_rank[op_row[ops_idx]], row_seq[op_row[ops_idx]],
-                slot_cap=self._cap)
+                slot_cap=self._cap,
+                reg_state=tuple(slow_info_np[r][idxs] for r in range(2, 7)))
 
     # ------------------------------------------------------------------
     # materialization (device kernels)

@@ -185,9 +185,10 @@ def apply_residual(
 ):
     """Place irregular inserts and run the LWW register fast path.
 
-    Returns updated tables + (slow, tslot, n_slow): ops needing host
-    resolution (multi-writer rounds, occupied registers, dels, incs, pooled
-    values) in op order."""
+    Returns the updated tables + the packed (7, M) `slow_info` matrix (see
+    `_register_fast_path`): ops needing host resolution — multi-writer
+    rounds, occupied registers, dels, incs, pooled values — plus their
+    register state, in op order, as one device->host transfer."""
     M = op_kind.shape[0]
     kind = op_kind.astype(jnp.int32)
     is_ins = kind == KIND_INS
@@ -204,12 +205,11 @@ def apply_residual(
     wc_n = _ext(win_counter, False, out_cap).at[ins_idx].set(False, mode="drop")
     chain_n = _ext(chain, False, out_cap).at[ins_idx].set(False, mode="drop")
 
-    (value_n, has_n, wa_n, ws_n, wc_n, slow, tslot, n_slow) = \
-        _register_fast_path(
-            value_n, has_n, wa_n, ws_n, wc_n, kind, is_assign, op_slot,
-            op_value, op_win_actor, op_win_seq, conflict_slots, out_cap)
+    (value_n, has_n, wa_n, ws_n, wc_n, slow_info) = _register_fast_path(
+        value_n, has_n, wa_n, ws_n, wc_n, kind, is_assign, op_slot,
+        op_value, op_win_actor, op_win_seq, conflict_slots, out_cap)
     return (parent_n, ctr_n, actor_n, value_n, has_n, wa_n, ws_n, wc_n,
-            chain_n, slow, tslot, n_slow)
+            chain_n, slow_info)
 
 
 def _register_fast_path(value_n, has_n, wa_n, ws_n, wc_n, kind, is_assign,
@@ -219,7 +219,13 @@ def _register_fast_path(value_n, has_n, wa_n, ws_n, wc_n, kind, is_assign,
 
     Fast = a single plain inline set in this round targeting either an
     empty register or the op's own actor's earlier write (always causally
-    covered). Everything else -> `slow` for host resolution."""
+    covered). Everything else -> `slow` for host resolution.
+
+    Returns the updated tables plus `slow_info`, a single packed (7, M)
+    int32 array [slow, tslot, reg_value, reg_has, reg_win_actor,
+    reg_win_seq, reg_win_counter]: everything the host slow path needs in
+    ONE device->host transfer (device round trips dominate small rounds —
+    the remote-tunnel RTT is ~10^2 ms)."""
     tslot = jnp.where(is_assign, op_slot, out_cap)
     tclip = jnp.clip(tslot, 0, out_cap - 1)
     counts = jnp.zeros(out_cap + 1, jnp.int32).at[
@@ -240,8 +246,13 @@ def _register_fast_path(value_n, has_n, wa_n, ws_n, wc_n, kind, is_assign,
     wc_n = wc_n.at[f_idx].set(False, mode="drop")
 
     slow = is_assign & ~fast
-    n_slow = jnp.sum(slow.astype(jnp.int32))
-    return value_n, has_n, wa_n, ws_n, wc_n, slow, tslot, n_slow
+    # register state at each slow op's slot, post fast-path/insert writes
+    # (a slot is never both fast- and slow-targeted: counts==1 gates fast)
+    slow_info = jnp.stack([
+        slow.astype(jnp.int32), tslot,
+        value_n[tclip], has_n[tclip].astype(jnp.int32),
+        wa_n[tclip], ws_n[tclip], wc_n[tclip].astype(jnp.int32)])
+    return value_n, has_n, wa_n, ws_n, wc_n, slow_info
 
 
 @partial(jax.jit, static_argnames=("out_cap",))
@@ -443,11 +454,11 @@ def remap_actors(actor, win_actor, remap, n_elems):
 
 
 @jax.jit
-def gather_registers(value, has_value, win_actor, win_seq, win_counter, slots):
-    """Fetch register state at `slots` (clipped; caller masks) for the host
-    slow path."""
-    s = jnp.clip(slots, 0, value.shape[0] - 1)
-    return (value[s], has_value[s], win_actor[s], win_seq[s], win_counter[s])
+def pack_rows(*arrays):
+    """Stack same-length device arrays into one int32 matrix: the host
+    mirror fetch becomes a single device->host transfer (RTT-bound on
+    remote-attached chips)."""
+    return jnp.stack([a.astype(jnp.int32) for a in arrays])
 
 
 @jax.jit
