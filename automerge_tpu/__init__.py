@@ -19,6 +19,13 @@ from .api import (  # noqa: F401
 )
 from . import types  # noqa: F401
 from .backend import Backend  # noqa: F401
+from .frontend import (  # noqa: F401
+    Counter, Frontend, Table, Text, can_redo, can_undo, get_actor_id,
+    get_conflicts, get_object_by_id, get_object_id, set_actor_id,
+)
+from .sync import Connection, DocSet, WatchableDoc  # noqa: F401
+
+__version__ = "0.1.0"
 
 # Device-engine classes resolve lazily (PEP 562): the facade tier is pure
 # Python and must import without jax; the engines pull it in on first use.
@@ -37,11 +44,5 @@ def __dir__():
     return sorted(set(globals()) | set(_ENGINE_EXPORTS))
 
 
-__all__ = [n for n in dir() if not n.startswith("_")] + list(_ENGINE_EXPORTS)
-from .frontend import (  # noqa: F401
-    Counter, Frontend, Table, Text, can_redo, can_undo, get_actor_id,
-    get_conflicts, get_object_by_id, get_object_id, set_actor_id,
-)
-from .sync import Connection, DocSet, WatchableDoc  # noqa: F401
-
-__version__ = "0.1.0"
+__all__ = [n for n in globals() if not n.startswith("_")] \
+    + list(_ENGINE_EXPORTS)

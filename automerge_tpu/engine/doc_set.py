@@ -266,7 +266,7 @@ class DeviceTextDocSet:
         try:
             staged_index = meta.index.merge(
                 pack_keys(batch_rank[ta[hpos]], tc[hpos].astype(np.int64)),
-                plan.run_len, plan.new_slot[hpos].astype(np.int64))
+                plan.run_len, plan.head_slot)
         except DuplicateElemId as e:
             rank, k_ctr = unpack_key(e.key)
             table = staged_actors[0] if staged_actors else meta.actor_table
@@ -294,21 +294,20 @@ class DeviceTextDocSet:
             staged_all_deps[(actor, seq)] = closure
             combined[(actor, seq)] = closure
 
-        blob = b.op_value[plan.pair_pos + 1]
         return {
             "d": d, "n_runs": plan.n_runs, "n_pairs": plan.n_pairs,
-            "head_slot": plan.new_slot[hpos], "parent_slot": parent_slot,
+            "head_slot": plan.head_slot, "parent_slot": parent_slot,
             "ctr0": tc[hpos], "actor": batch_rank[ta[hpos]],
             "win_actor": row_rank[b.op_change[hpos]],
             "win_seq": row_seq[b.op_change[hpos]],
             "elem_base": np.cumsum(plan.run_len) - plan.run_len,
-            "blob": blob.astype(np.int32),
+            "blob": plan.blob,
             "n_breaks": int((~is_head).sum()),
             "staged_index": staged_index,
             "staged_clock": {b.actors[r]: int(b.seqs[r])
                              for r in range(b.n_changes)},
             "staged_all_deps": staged_all_deps,
-            "staged_ascii": bool((blob < 128).all()),
+            "staged_ascii": plan.blob_lt_128,
             "staged_actors": staged_actors,
         }
 
@@ -326,28 +325,30 @@ class DeviceTextDocSet:
         if stacked_idx:
             if self._codes_cache is None:
                 dev = self._ensure_dev()
+                all_ascii = all(self._meta[d].all_ascii for d in stacked_idx)
                 S = bucket(max(self._meta[d].seg_bound
                                for d in stacked_idx) + 2, 64)
                 n_el = np.asarray([m.n_elems for m in self._meta], np.int32)
                 import jax.numpy as jnp
-                codes, codes_u8, n_vis, n_segs = jax.vmap(
-                    lambda *a: materialize_codes(*a, S=S))(
-                    dev["parent"], dev["ctr"], dev["actor"], dev["value"],
-                    dev["has_value"], dev["chain"], jnp.asarray(n_el))
-                n_segs_np = np.asarray(n_segs)
-                if (n_segs_np + 2 > S).any():
-                    S = bucket(int(n_segs_np.max()) + 2, 64)
-                    codes, codes_u8, n_vis, n_segs = jax.vmap(
-                        lambda *a: materialize_codes(*a, S=S))(
+
+                def run(S):
+                    return jax.vmap(
+                        lambda *a: materialize_codes(*a, S=S,
+                                                     as_u8=all_ascii))(
                         dev["parent"], dev["ctr"], dev["actor"],
                         dev["value"], dev["has_value"], dev["chain"],
                         jnp.asarray(n_el))
-                    n_segs_np = np.asarray(n_segs)
+
+                codes, scalars = run(S)
+                scalars_np = np.asarray(scalars)     # (D, 2): n_vis, n_segs
+                if (scalars_np[:, 1] + 2 > S).any():
+                    S = bucket(int(scalars_np[:, 1].max()) + 2, 64)
+                    codes, scalars = run(S)
+                    scalars_np = np.asarray(scalars)
                 for d in stacked_idx:
-                    self._meta[d].seg_bound = int(n_segs_np[d])
-                all_ascii = all(self._meta[d].all_ascii for d in stacked_idx)
-                fetched = np.asarray(codes_u8 if all_ascii else codes)
-                self._codes_cache = (fetched, np.asarray(n_vis), all_ascii)
+                    self._meta[d].seg_bound = int(scalars_np[d, 1])
+                self._codes_cache = (np.asarray(codes), scalars_np[:, 0],
+                                     all_ascii)
             fetched, n_vis, all_ascii = self._codes_cache
             for d in stacked_idx:
                 row = fetched[d][: n_vis[d]]

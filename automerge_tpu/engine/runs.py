@@ -1,4 +1,4 @@
-"""Typing-run detection over columnar op batches (host, vectorized numpy).
+"""Typing-run detection over columnar op batches.
 
 A *run* is an INS immediately followed by its SET, chained so each next INS
 continues the previous element with a consecutive counter — the shape every
@@ -7,6 +7,11 @@ descriptors + a value blob instead of 2 op rows per character
 (ops/ingest.py:expand_runs*). Shared by the single-doc engine
 (text_doc.DeviceTextDoc) and the vmapped doc-set engine
 (doc_set.DeviceTextDocSet).
+
+Detection dispatches to the native single-pass C++ walker
+(native/codec.cpp:amtpu_detect_runs) when available and falls back to the
+vectorized numpy formulation; both are bit-identical
+(tests/test_native_codec pins parity on random batches).
 """
 
 from __future__ import annotations
@@ -23,14 +28,15 @@ class RoundPlan:
     """Run/residual partition of one causally-ready round's op columns."""
 
     n_ops: int
-    is_ins: np.ndarray       # bool[n_ops]
     n_ins: int
-    new_slot: np.ndarray     # int64[n_ops] (0 where not ins)
     hpos: np.ndarray         # run-head op positions
-    pair_pos: np.ndarray     # positions of all run INS ops (op order)
     run_len: np.ndarray      # int64[n_runs]
+    head_slot: np.ndarray    # int64[n_runs]: slot of each run's first elem
     rpos: np.ndarray         # residual op positions
-    res_is_ins: np.ndarray   # bool over rpos
+    res_new_slot: np.ndarray  # int64[n_res]: slot per residual INS (-1 else)
+    blob: np.ndarray         # int32[n_pairs]: run SET values, op order
+    blob_lt_128: bool
+    blob_lt_256: bool
 
     @property
     def n_runs(self) -> int:
@@ -38,11 +44,15 @@ class RoundPlan:
 
     @property
     def n_pairs(self) -> int:
-        return len(self.pair_pos)
+        return len(self.blob)
+
+    @property
+    def res_is_ins(self) -> np.ndarray:
+        return self.res_new_slot >= 0
 
     @property
     def n_res_ins(self) -> int:
-        return int(self.res_is_ins.sum())
+        return int((self.res_new_slot >= 0).sum())
 
 
 def detect_runs(kind, ta, tc, pa, pc, val64, op_row, base_elems: int
@@ -51,6 +61,23 @@ def detect_runs(kind, ta, tc, pa, pc, val64, op_row, base_elems: int
 
     `base_elems` is the document's live element count before this round;
     inserted elements take slots base_elems+1.. in op order."""
+    n_ops = len(kind)
+    from ..native import detect_runs_native
+    native = detect_runs_native(kind, ta, tc, pa, pc, val64, op_row,
+                                base_elems)
+    if native is not None:
+        (hpos, run_len, head_slot, rpos, res_new_slot, blob, n_ins,
+         lt128, lt256) = native
+        return RoundPlan(n_ops=n_ops, n_ins=int(n_ins), hpos=hpos,
+                         run_len=run_len, head_slot=head_slot, rpos=rpos,
+                         res_new_slot=res_new_slot, blob=blob,
+                         blob_lt_128=lt128, blob_lt_256=lt256)
+    return _detect_runs_numpy(kind, ta, tc, pa, pc, val64, op_row,
+                              base_elems)
+
+
+def _detect_runs_numpy(kind, ta, tc, pa, pc, val64, op_row,
+                       base_elems: int) -> RoundPlan:
     n_ops = len(kind)
     is_ins = kind == KIND_INS
     n_ins = int(is_ins.sum())
@@ -78,10 +105,18 @@ def detect_runs(kind, ta, tc, pa, pc, val64, op_row, base_elems: int
     if len(hpos):
         run_len = np.diff(np.append(
             np.searchsorted(pair_pos, hpos), len(pair_pos))).astype(np.int64)
+        blob = val64[pair_pos + 1].astype(np.int32)
     else:
         run_len = np.empty(0, np.int64)
+        blob = np.empty(0, np.int32)
     rpos = np.flatnonzero(~covered)
-    res_is_ins = kind[rpos] == KIND_INS
-    return RoundPlan(n_ops=n_ops, is_ins=is_ins, n_ins=n_ins,
-                     new_slot=new_slot, hpos=hpos, pair_pos=pair_pos,
-                     run_len=run_len, rpos=rpos, res_is_ins=res_is_ins)
+    res_new_slot = np.where(kind[rpos] == KIND_INS,
+                            new_slot[rpos], -1).astype(np.int64)
+    # the pair predicate guarantees 0 <= value < 2^31, so the int32 blob
+    # holds the exact values — derive the flags from it directly
+    return RoundPlan(
+        n_ops=n_ops, n_ins=n_ins, hpos=hpos.astype(np.int64),
+        run_len=run_len, head_slot=new_slot[hpos].astype(np.int64),
+        rpos=rpos.astype(np.int64), res_new_slot=res_new_slot, blob=blob,
+        blob_lt_128=bool((blob < 128).all()),
+        blob_lt_256=bool((blob < 256).all()))

@@ -140,9 +140,8 @@ class DeviceTextDoc(CausalDeviceDoc):
         # --- typing-run detection: INS immediately followed by its SET,
         # chained with consecutive counters (the dominant text workload) ---
         plan = detect_runs(kind, ta, tc, pa, pc, val64, op_row, self.n_elems)
-        new_slot, hpos, pair_pos, run_len, rpos, res_is_ins = (
-            plan.new_slot, plan.hpos, plan.pair_pos, plan.run_len,
-            plan.rpos, plan.res_is_ins)
+        hpos, run_len, rpos, res_is_ins = (
+            plan.hpos, plan.run_len, plan.rpos, plan.res_is_ins)
         n_ins, n_runs, n_pairs, n_res_ins = (
             plan.n_ins, plan.n_runs, plan.n_pairs, plan.n_res_ins)
         res_kind = kind[rpos]
@@ -152,14 +151,14 @@ class DeviceTextDoc(CausalDeviceDoc):
             new_starts = [pack_keys(batch_rank[ta[hpos]],
                                     tc[hpos].astype(np.int64))]
             new_lens = [run_len]
-            new_slots = [new_slot[hpos].astype(np.int64)]
+            new_slots = [plan.head_slot]
         else:
             new_starts, new_lens, new_slots = [], [], []
         if n_res_ins:
             ri = rpos[res_is_ins]
             new_starts.append(pack_keys(batch_rank[ta[ri]], tc[ri].astype(np.int64)))
             new_lens.append(np.ones(n_res_ins, np.int64))
-            new_slots.append(new_slot[ri].astype(np.int64))
+            new_slots.append(plan.res_new_slot[res_is_ins])
         def decode(key: int) -> str:
             rank, k_ctr = unpack_key(key)
             return make_elem_id(self.actor_table[rank], k_ctr)
@@ -230,16 +229,14 @@ class DeviceTextDoc(CausalDeviceDoc):
                 out[:n_runs] = arr
                 return jnp.asarray(out)
 
-            blob_vals = val64[pair_pos + 1]
-            if self.all_ascii and not (blob_vals < 128).all():
+            if self.all_ascii and not plan.blob_lt_128:
                 self.all_ascii = False
-            blob = np.zeros(N, np.int32 if blob_vals.max(initial=0) > 255
-                            else np.uint8)
-            blob[:n_pairs] = blob_vals
+            blob = np.zeros(N, np.uint8 if plan.blob_lt_256 else np.int32)
+            blob[:n_pairs] = plan.blob
             elem_base = np.full(R, N, np.int32)
             elem_base[:n_runs] = np.cumsum(run_len) - run_len
             run_args = (
-                padr(new_slot[hpos], 0), padr(run_parent_slot, 0),
+                padr(plan.head_slot, 0), padr(run_parent_slot, 0),
                 padr(tc[hpos], 0), padr(batch_rank[ta[hpos]], 0),
                 padr(row_actor_rank[op_row[hpos]], 0),
                 padr(row_seq[op_row[hpos]], 0), jnp.asarray(elem_base),
@@ -276,7 +273,8 @@ class DeviceTextDoc(CausalDeviceDoc):
                 padm(res_kind, -1, np.int8),
                 padm(np.where(res_is_ins, res_parent_slot, res_target_slot),
                      out_cap),
-                padm(np.where(res_is_ins, new_slot[rpos], out_cap), out_cap),
+                padm(np.where(res_is_ins, plan.res_new_slot, out_cap),
+                     out_cap),
                 padm(tc[rpos], 0), padm(batch_rank[ta[rpos]], 0),
                 padm(np.clip(res_vals, -2**31, 2**31 - 1), 0),
                 padm(row_actor_rank[op_row[rpos]], 0),
@@ -336,9 +334,10 @@ class DeviceTextDoc(CausalDeviceDoc):
     # ------------------------------------------------------------------
 
     def _materialize(self, with_pos: bool = True):
-        """Cached device materialization. `with_pos=False` runs the cheaper
-        codes-only kernel (enough for `text()`)."""
-        if self._mat is not None and (len(self._mat) == 5 or not with_pos):
+        """Cached device materialization -> (pos?, codes, [n_vis, n_segs]
+        as numpy). `with_pos=False` runs the cheaper codes-only kernel
+        (enough for `text()`); codes are uint8 when the doc is all-7-bit."""
+        if self._mat is not None and (len(self._mat) == 3 or not with_pos):
             return self._mat
         from ..ops.ingest import bucket, materialize_codes, materialize_text
         dev = self._ensure_dev()
@@ -347,14 +346,15 @@ class DeviceTextDoc(CausalDeviceDoc):
         while True:
             out = fn(dev["parent"], dev["ctr"], dev["actor"], dev["value"],
                      dev["has_value"], dev["chain"], np.int32(self.n_elems),
-                     S=S)
-            n_segs = int(out[-1])
+                     S=S, as_u8=self.all_ascii)
+            scalars = np.asarray(out[-1])
+            n_segs = int(scalars[1])
             if n_segs + 2 <= S:
                 break
             # bound was stale (e.g. a partial-round estimate)
             S = bucket(n_segs + 2, 64)
         self._seg_bound = n_segs  # tighten for the next materialize
-        self._mat = out
+        self._mat = out[:-1] + (scalars,)
         return self._mat
 
     def _positions(self) -> np.ndarray:
@@ -407,11 +407,10 @@ class DeviceTextDoc(CausalDeviceDoc):
             return ""
         if self.use_condensed:
             out = self._materialize(with_pos=False)
-            codes, codes_u8, n_vis = out[-4], out[-3], int(out[-2])
-            if self.all_ascii:
-                return (np.asarray(codes_u8)[:n_vis].tobytes()
-                        .decode("ascii"))
+            codes, n_vis = out[-2], int(out[-1][0])
             values = np.asarray(codes)[:n_vis]
+            if values.dtype == np.uint8:
+                return values.tobytes().decode("ascii")
         else:
             order = self.visible_order()
             values = self._mirrors()["value"][order]

@@ -71,6 +71,30 @@ def _load():
                 fn.restype = ctypes.c_char_p
                 fn.argtypes = [ctypes.c_void_p]
             lib.amtpu_free.argtypes = [ctypes.c_void_p]
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            lib.amtpu_detect_runs.restype = ctypes.c_void_p
+            lib.amtpu_detect_runs.argtypes = [
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.c_int64]
+            for name in ("amtpu_plan_n_runs", "amtpu_plan_n_pairs",
+                         "amtpu_plan_n_res", "amtpu_plan_n_ins"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [ctypes.c_void_p]
+            lib.amtpu_plan_blob_lt.restype = ctypes.c_int
+            lib.amtpu_plan_blob_lt.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_int]
+            lib.amtpu_plan_fill.argtypes = [
+                ctypes.c_void_p, i64p, i64p, i64p, i64p, i64p,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
+            lib.amtpu_plan_free.argtypes = [ctypes.c_void_p]
             _lib = lib
         except Exception:
             _lib_failed = True
@@ -79,6 +103,46 @@ def _load():
 
 def available() -> bool:
     return _load() is not None
+
+
+def detect_runs_native(kind, ta, tc, pa, pc, val64, op_row,
+                       base_elems: int):
+    """Single-pass C++ typing-run detection over op columns.
+
+    Returns (hpos, run_len, head_slot, rpos, res_new_slot, blob, n_ins,
+    blob_lt_128, blob_lt_256) or None when the native tier is unavailable.
+    Bit-identical to the numpy detection (engine/runs.py) — pinned by
+    tests/test_native_codec."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(kind)
+    h = lib.amtpu_detect_runs(
+        n, np.ascontiguousarray(kind, np.int8),
+        np.ascontiguousarray(ta, np.int32),
+        np.ascontiguousarray(tc, np.int32),
+        np.ascontiguousarray(pa, np.int32),
+        np.ascontiguousarray(pc, np.int32),
+        np.ascontiguousarray(val64, np.int64),
+        np.ascontiguousarray(op_row, np.int32), base_elems)
+    try:
+        n_runs = lib.amtpu_plan_n_runs(h)
+        n_pairs = lib.amtpu_plan_n_pairs(h)
+        n_res = lib.amtpu_plan_n_res(h)
+        hpos = np.empty(n_runs, np.int64)
+        run_len = np.empty(n_runs, np.int64)
+        head_slot = np.empty(n_runs, np.int64)
+        rpos = np.empty(n_res, np.int64)
+        res_new_slot = np.empty(n_res, np.int64)
+        blob = np.empty(n_pairs, np.int32)
+        lib.amtpu_plan_fill(h, hpos, run_len, head_slot, rpos,
+                            res_new_slot, blob)
+        return (hpos, run_len, head_slot, rpos, res_new_slot, blob,
+                lib.amtpu_plan_n_ins(h),
+                bool(lib.amtpu_plan_blob_lt(h, 128)),
+                bool(lib.amtpu_plan_blob_lt(h, 256)))
+    finally:
+        lib.amtpu_plan_free(h)
 
 
 def decode_text_changes(data, obj_id: str):

@@ -476,3 +476,90 @@ const char* amtpu_messages(void* hv) {
 void amtpu_free(void* hv) { delete (Handle*)hv; }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Typing-run detection over columnar op batches: the single-pass native
+// form of engine/runs.py:detect_runs (same predicate, op by op). Python
+// numpy needs ~8 vectorized passes over the columns; this walks them once.
+// ---------------------------------------------------------------------------
+
+struct RunPlan {
+    std::vector<int64_t> hpos, run_len, head_slot, rpos, res_new_slot;
+    std::vector<int32_t> blob;
+    int64_t n_ins = 0;
+    bool blob_lt_128 = true, blob_lt_256 = true;
+};
+
+extern "C" {
+
+void* amtpu_detect_runs(
+    int64_t n, const int8_t* kind, const int32_t* ta, const int32_t* tc,
+    const int32_t* pa, const int32_t* pc, const int64_t* val,
+    const int32_t* row, int64_t base_elems) {
+    auto* p = new RunPlan();
+    constexpr int8_t INS = 0, SET = 1;
+    int64_t ins_count = 0;
+    int64_t prev_pair = -2;  // op index of the previous pair's INS
+    int64_t i = 0;
+    while (i < n) {
+        bool pair = (kind[i] == INS && i + 1 < n && kind[i + 1] == SET
+                     && row[i + 1] == row[i] && ta[i + 1] == ta[i]
+                     && tc[i + 1] == tc[i] && val[i + 1] >= 0
+                     && val[i + 1] < (1LL << 31));
+        if (pair) {
+            bool cont = (prev_pair == i - 2 && row[i] == row[i - 2]
+                         && ta[i] == ta[i - 2] && tc[i] == tc[i - 2] + 1
+                         && pa[i] == ta[i - 2] && pc[i] == tc[i - 2]);
+            if (!cont) {
+                p->hpos.push_back(i);
+                p->run_len.push_back(0);
+                p->head_slot.push_back(base_elems + ins_count + 1);
+            }
+            p->run_len.back()++;
+            int64_t v = val[i + 1];
+            p->blob.push_back((int32_t)v);
+            if (v >= 128) p->blob_lt_128 = false;
+            if (v >= 256) p->blob_lt_256 = false;
+            ins_count++;
+            prev_pair = i;
+            i += 2;
+        } else {
+            p->rpos.push_back(i);
+            if (kind[i] == INS) {
+                ins_count++;
+                p->res_new_slot.push_back(base_elems + ins_count);
+            } else {
+                p->res_new_slot.push_back(-1);
+            }
+            prev_pair = -2;
+            i += 1;
+        }
+    }
+    p->n_ins = ins_count;
+    return p;
+}
+
+int64_t amtpu_plan_n_runs(void* pv) { return (int64_t)((RunPlan*)pv)->hpos.size(); }
+int64_t amtpu_plan_n_pairs(void* pv) { return (int64_t)((RunPlan*)pv)->blob.size(); }
+int64_t amtpu_plan_n_res(void* pv) { return (int64_t)((RunPlan*)pv)->rpos.size(); }
+int64_t amtpu_plan_n_ins(void* pv) { return ((RunPlan*)pv)->n_ins; }
+int amtpu_plan_blob_lt(void* pv, int bound) {
+    auto* p = (RunPlan*)pv;
+    return bound == 128 ? p->blob_lt_128 : p->blob_lt_256;
+}
+
+void amtpu_plan_fill(void* pv, int64_t* hpos, int64_t* run_len,
+                     int64_t* head_slot, int64_t* rpos,
+                     int64_t* res_new_slot, int32_t* blob) {
+    auto* p = (RunPlan*)pv;
+    memcpy(hpos, p->hpos.data(), p->hpos.size() * 8);
+    memcpy(run_len, p->run_len.data(), p->run_len.size() * 8);
+    memcpy(head_slot, p->head_slot.data(), p->head_slot.size() * 8);
+    memcpy(rpos, p->rpos.data(), p->rpos.size() * 8);
+    memcpy(res_new_slot, p->res_new_slot.data(), p->res_new_slot.size() * 8);
+    memcpy(blob, p->blob.data(), p->blob.size() * 4);
+}
+
+void amtpu_plan_free(void* pv) { delete (RunPlan*)pv; }
+
+}  // extern "C"

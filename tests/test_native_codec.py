@@ -113,6 +113,62 @@ def test_newline_actor_falls_back():
 
 
 @needs_native
+@pytest.mark.parametrize("seed", range(6))
+def test_run_detection_parity(seed):
+    """Native single-pass run detection == numpy vectorized detection on
+    random op batches (pairs, bare inserts, dels, incs, pooled values)."""
+    from automerge_tpu.engine.runs import _detect_runs_numpy
+    from automerge_tpu.native import detect_runs_native
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 400))
+    kind = np.zeros(n, np.int8)
+    ta = rng.integers(0, 4, n).astype(np.int32)
+    tc = rng.integers(1, 50, n).astype(np.int32)
+    pa = rng.integers(-1, 4, n).astype(np.int32)
+    pc = rng.integers(0, 50, n).astype(np.int32)
+    val = rng.integers(-3, 300, n).astype(np.int64)
+    row = np.sort(rng.integers(0, 5, n)).astype(np.int32)
+    # sprinkle plausible pair/chain structure among random noise
+    i = 0
+    while i < n - 1:
+        choice = rng.random()
+        if choice < 0.5:
+            kind[i] = 0          # INS
+            kind[i + 1] = 1      # SET
+            ta[i + 1] = ta[i]
+            tc[i + 1] = tc[i]
+            row[i + 1] = row[i]
+            if rng.random() < 0.7 and i >= 2 and kind[i - 2] == 0:
+                ta[i] = ta[i - 2]
+                tc[i] = tc[i - 2] + 1
+                pa[i] = ta[i - 2]
+                pc[i] = tc[i - 2]
+                row[i] = row[i - 2]
+                tc[i + 1] = tc[i]
+                ta[i + 1] = ta[i]
+                row[i + 1] = row[i]
+            i += 2
+        else:
+            kind[i] = int(rng.integers(0, 4))
+            i += 1
+    base = int(rng.integers(0, 100))
+    a = _detect_runs_numpy(kind, ta, tc, pa, pc, val, row, base)
+    out = detect_runs_native(kind, ta, tc, pa, pc, val, row, base)
+    assert out is not None
+    (hpos, run_len, head_slot, rpos, res_new_slot, blob, n_ins,
+     lt128, lt256) = out
+    np.testing.assert_array_equal(hpos, a.hpos)
+    np.testing.assert_array_equal(run_len, a.run_len)
+    np.testing.assert_array_equal(head_slot, a.head_slot)
+    np.testing.assert_array_equal(rpos, a.rpos)
+    np.testing.assert_array_equal(res_new_slot, a.res_new_slot)
+    np.testing.assert_array_equal(blob, a.blob)
+    assert n_ins == a.n_ins
+    assert lt128 == a.blob_lt_128 and lt256 == a.blob_lt_256
+
+
+@needs_native
 def test_decode_speed_sanity():
     """The native decoder should beat the Python loop comfortably."""
     import time
