@@ -44,17 +44,37 @@ class _DocMeta:
 
 
 class DeviceTextDocSet:
-    """A set of text documents merged as one stacked device program."""
+    """A set of text documents merged as one stacked device program.
 
-    def __init__(self, obj_ids, capacity: int = 1024):
+    With a `jax.sharding.Mesh` (axes "doc", "elem"), the stacked tables
+    shard over the devices — documents data-parallel along "doc", elements
+    of each document sequence-parallel along "elem" — and the same vmapped
+    programs run SPMD with XLA inserting the collectives (the condensed
+    linearization's small sort rides all-to-all; the prefix scans exchange
+    carries over ICI). This is the framework's multi-chip execution path
+    (parallel/mesh.py builds meshes; __graft_entry__.dryrun_multichip
+    drives it on a virtual device mesh)."""
+
+    def __init__(self, obj_ids, capacity: int = 1024, mesh=None):
         from ..ops.ingest import bucket
         self.obj_ids = list(obj_ids)
         self._idx = {o: i for i, o in enumerate(self.obj_ids)}
         self._meta = [_DocMeta() for _ in self.obj_ids]
         self._cap = bucket(max(capacity, 16))
+        self.mesh = mesh
         self._dev = None                      # stacked (D, cap) tables
         self._overlay: dict = {}              # doc idx -> DeviceTextDoc
         self._codes_cache = None
+        if mesh is not None:
+            if self.n_docs % mesh.shape["doc"]:
+                raise ValueError(
+                    f"the mesh's doc axis ({mesh.shape['doc']}) must divide "
+                    f"n_docs ({self.n_docs})")
+            if self._cap % mesh.shape["elem"]:
+                raise ValueError(
+                    f"the mesh's elem axis ({mesh.shape['elem']}) must "
+                    f"divide the bucketed capacity ({self._cap}); pick a "
+                    f"power-of-two elem axis")
 
     @property
     def n_docs(self) -> int:
@@ -62,20 +82,43 @@ class DeviceTextDocSet:
 
     _TABLE_KEYS = DeviceTextDoc._TABLE_KEYS
 
+    def _sharding(self, *axes):
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self.mesh, PartitionSpec(*axes))
+
+    def _put(self, arr, *axes):
+        """Host array -> device, sharded over the mesh when one is set."""
+        import jax
+        import jax.numpy as jnp
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        return jax.device_put(arr, self._sharding(*axes))
+
     def _ensure_dev(self):
         if self._dev is None:
-            import jax.numpy as jnp
+            import numpy as onp
             D, cap = self.n_docs, self._cap
             self._dev = {
-                "parent": jnp.zeros((D, cap), jnp.int32),
-                "ctr": jnp.zeros((D, cap), jnp.int32),
-                "actor": jnp.zeros((D, cap), jnp.int32),
-                "value": jnp.zeros((D, cap), jnp.int32),
-                "has_value": jnp.zeros((D, cap), bool),
-                "win_actor": jnp.full((D, cap), -1, jnp.int32),
-                "win_seq": jnp.zeros((D, cap), jnp.int32),
-                "win_counter": jnp.zeros((D, cap), bool),
-                "chain": jnp.zeros((D, cap), bool),
+                "parent": self._put(onp.zeros((D, cap), onp.int32),
+                                    "doc", "elem"),
+                "ctr": self._put(onp.zeros((D, cap), onp.int32),
+                                 "doc", "elem"),
+                "actor": self._put(onp.zeros((D, cap), onp.int32),
+                                   "doc", "elem"),
+                "value": self._put(onp.zeros((D, cap), onp.int32),
+                                   "doc", "elem"),
+                "has_value": self._put(onp.zeros((D, cap), bool),
+                                       "doc", "elem"),
+                "win_actor": self._put(onp.full((D, cap), -1, onp.int32),
+                                       "doc", "elem"),
+                "win_seq": self._put(onp.zeros((D, cap), onp.int32),
+                                     "doc", "elem"),
+                "win_counter": self._put(onp.zeros((D, cap), bool),
+                                         "doc", "elem"),
+                "chain": self._put(onp.zeros((D, cap), bool),
+                                   "doc", "elem"),
             }
         return self._dev
 
@@ -108,7 +151,6 @@ class DeviceTextDocSet:
     def apply_batches(self, batches: dict):
         """Merge {obj_id: TextChangeBatch}: vmapped fast path for runs-only
         ready batches; general per-doc engine otherwise."""
-        import jax.numpy as jnp
         from ..ops.ingest import bucket
         from ..ops.ingest import expand_runs_dense
 
@@ -174,12 +216,14 @@ class DeviceTextDocSet:
         expanded = jax.vmap(
             lambda *a: expand_runs_dense(*a, out_cap=out_cap))(
             *tables,
-            jnp.asarray(cols["head_slot"]), jnp.asarray(cols["parent_slot"]),
-            jnp.asarray(cols["ctr0"]), jnp.asarray(cols["actor"]),
-            jnp.asarray(cols["win_actor"]), jnp.asarray(cols["win_seq"]),
-            jnp.asarray(elem_base), jnp.asarray(has_val),
-            jnp.asarray(blob), jnp.asarray(n_pairs_v),
-            jnp.asarray(base_slot_v))
+            self._put(cols["head_slot"], "doc"),
+            self._put(cols["parent_slot"], "doc"),
+            self._put(cols["ctr0"], "doc"), self._put(cols["actor"], "doc"),
+            self._put(cols["win_actor"], "doc"),
+            self._put(cols["win_seq"], "doc"),
+            self._put(elem_base, "doc"), self._put(has_val, "doc"),
+            self._put(blob, "doc"), self._put(n_pairs_v, "doc"),
+            self._put(base_slot_v, "doc"))
         self._dev = dict(zip(self._TABLE_KEYS, expanded))
         self._cap = out_cap
 
@@ -198,8 +242,8 @@ class DeviceTextDocSet:
                 ta_[d, : len(ps)] = as_
             chain_n = jax.vmap(break_chains)(
                 self._dev["chain"], self._dev["parent"], self._dev["ctr"],
-                self._dev["actor"], jnp.asarray(tp), jnp.asarray(tc_),
-                jnp.asarray(ta_))
+                self._dev["actor"], self._put(tp, "doc"),
+                self._put(tc_, "doc"), self._put(ta_, "doc"))
             self._dev["chain"] = chain_n
 
         for p in fast:
@@ -316,7 +360,6 @@ class DeviceTextDocSet:
     def texts(self) -> dict:
         """Materialize every document: one vmapped program + one fetch."""
         import jax
-        import numpy as np
         from ..ops.ingest import bucket, materialize_codes
 
         out = {}
@@ -329,7 +372,6 @@ class DeviceTextDocSet:
                 S = bucket(max(self._meta[d].seg_bound
                                for d in stacked_idx) + 2, 64)
                 n_el = np.asarray([m.n_elems for m in self._meta], np.int32)
-                import jax.numpy as jnp
 
                 def run(S):
                     return jax.vmap(
@@ -337,7 +379,7 @@ class DeviceTextDocSet:
                                                      as_u8=all_ascii))(
                         dev["parent"], dev["ctr"], dev["actor"],
                         dev["value"], dev["has_value"], dev["chain"],
-                        jnp.asarray(n_el))
+                        self._put(n_el, "doc"))
 
                 codes, scalars = run(S)
                 scalars_np = np.asarray(scalars)     # (D, 2): n_vis, n_segs

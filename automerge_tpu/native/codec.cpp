@@ -498,8 +498,9 @@ void* amtpu_detect_runs(
     const int32_t* row, int64_t base_elems) {
     auto* p = new RunPlan();
     constexpr int8_t INS = 0, SET = 1;
+    constexpr int64_t NO_PAIR = INT64_MIN;  // can never equal i-2
     int64_t ins_count = 0;
-    int64_t prev_pair = -2;  // op index of the previous pair's INS
+    int64_t prev_pair = NO_PAIR;  // op index of the previous pair's INS
     int64_t i = 0;
     while (i < n) {
         bool pair = (kind[i] == INS && i + 1 < n && kind[i + 1] == SET
@@ -531,7 +532,7 @@ void* amtpu_detect_runs(
             } else {
                 p->res_new_slot.push_back(-1);
             }
-            prev_pair = -2;
+            prev_pair = NO_PAIR;
             i += 1;
         }
     }

@@ -129,6 +129,35 @@ def test_sequential_same_actor_batch_stays_fast():
     assert not ds._overlay
 
 
+def test_sharded_docset_matches_unsharded():
+    """The same merges on a (doc, elem)-sharded mesh produce identical
+    texts — XLA inserts the collectives; semantics don't change."""
+    from automerge_tpu.engine import TextChangeBatch
+    from automerge_tpu.parallel import make_mesh
+
+    mesh = make_mesh(8)  # virtual CPU devices from conftest XLA_FLAGS
+    ids = [f"m{i}" for i in range(mesh.shape["doc"] * 2)]
+    plain = DeviceTextDocSet(ids)
+    sharded = DeviceTextDocSet(ids, mesh=mesh)
+    for rnd in range(2):
+        batches = {}
+        for i, o in enumerate(ids):
+            changes = [
+                typing_change(
+                    f"w{a}", rnd + 1, f"r{rnd}a{a}d{i % 7}xy",
+                    start_ctr=16 * rnd + 1,
+                    after="w0:8" if rnd else None,
+                    deps={"w0": rnd} if rnd and a != 0 else {},
+                    obj=o)
+                for a in range(2)]
+            batches[o] = TextChangeBatch.from_changes(changes, o)
+        plain.apply_batches(batches)
+        sharded.apply_batches(batches)
+    texts = sharded.texts()
+    assert texts == plain.texts()
+    assert all(len(t) == 32 for t in texts.values())
+
+
 @pytest.mark.parametrize("seed", range(3))
 def test_random_docsets_match_single(seed):
     from automerge_tpu.engine import TextChangeBatch
