@@ -1,0 +1,34 @@
+"""Environment recipe for forcing JAX onto a virtual CPU device mesh.
+
+The real-TPU plugin (axon) registers itself from sitecustomize at interpreter
+start; once registered, jax initializes it regardless of JAX_PLATFORMS. Any
+process that needs the N-device virtual CPU platform (tests, the driver's
+multichip dryrun) must therefore start a FRESH interpreter with this scrubbed
+environment — setting the variables after startup is too late when the plugin
+is present. This module is jax-free and safe to import anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def virtual_cpu_env(n_devices: int, base: dict | None = None) -> dict:
+    """A copy of ``base`` (default: os.environ) rewritten so that a fresh
+    interpreter lands on an ``n_devices``-device virtual CPU platform:
+    the axon plugin trigger is removed, JAX_PLATFORMS is forced to cpu, any
+    existing --xla_force_host_platform_device_count is replaced, and the
+    shared persistent compile cache is defaulted."""
+    env = dict(os.environ if base is None else base)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disables the axon TPU plugin
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(_REPO, ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    return env

@@ -1,26 +1,24 @@
 import os
 import sys
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 # The test suite targets a deterministic 8-device virtual CPU mesh: the
 # sharding tests need multiple devices, and unit tests must not depend on
 # TPU-tunnel health or remote-compile latency. The axon TPU plugin registers
 # itself from sitecustomize at interpreter start and, once registered, jax
 # initializes it regardless of JAX_PLATFORMS — so when it is present, the
 # whole pytest process re-execs with the plugin disabled (restoring pytest's
-# captured fds first). Set AUTOMERGE_TPU_TESTS_ON_TPU=1 to run on the real
-# chip instead.
+# captured fds first). The scrub recipe is shared with the driver's multichip
+# dryrun (automerge_tpu/_env.py — jax-free import). Set
+# AUTOMERGE_TPU_TESTS_ON_TPU=1 to run on the real chip instead.
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+from automerge_tpu._env import virtual_cpu_env  # noqa: E402
 
-# Persistent XLA compile cache shared with bench.py: repeated test runs skip
-# kernel recompiles.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+_env = virtual_cpu_env(8)
+for _k in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_COMPILATION_CACHE_DIR",
+           "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
+    os.environ[_k] = _env[_k]
 
 
 def pytest_configure(config):
@@ -29,13 +27,7 @@ def pytest_configure(config):
         capman = config.pluginmanager.getplugin("capturemanager")
         if capman is not None:
             capman.stop_global_capturing()
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        flags = env.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            flags = (flags + " --xla_force_host_platform_device_count=8").strip()
-        env["XLA_FLAGS"] = flags
         os.execve(sys.executable,
-                  [sys.executable, "-m", "pytest", *config.invocation_params.args],
-                  env)
+                  [sys.executable, "-m", "pytest",
+                   *config.invocation_params.args],
+                  virtual_cpu_env(8))
