@@ -302,7 +302,11 @@ class OpSetIndex:
             self.by_object[op["value"]].inbound.append(op)
         if op["action"] in ("set", "link"):
             remaining = remaining + [op]
-        remaining = sorted(remaining, key=lambda o: o["actor"], reverse=True)
+        # ascending stable sort then full reverse (not reverse=True): mirrors
+        # the reference's sortBy(actor).reverse(), whose same-actor ties land
+        # in reverse insertion order so the last-written op wins
+        # (/root/reference/backend/op_set.js:245)
+        remaining = sorted(remaining, key=lambda o: o["actor"])[::-1]
         rec.keys[op["key"]] = remaining
 
         if object_id == ROOT_ID or obj_type == "makeMap":

@@ -160,24 +160,51 @@ class CausalDeviceDoc:
 
         for b, rows in by_batch.values():
             rows_arr = np.asarray(sorted(rows), np.int32)
-            for row in rows_arr:
-                actor, seq = b.actors[row], int(b.seqs[row])
-                self._all_deps[(actor, seq)] = self._compute_all_deps(
-                    actor, seq, b.deps[row])
-                self.clock[actor] = seq
-
             # ops may reference ids minted by actors whose own changes sit
-            # in other rounds, so intern the batch's whole actor table
+            # in other rounds, so intern the batch's whole actor table.
+            # Interning runs BEFORE the clock advances: a raising remap then
+            # leaves the causal state untouched (extra interned actors are
+            # harmless — interning only renames ranks consistently, it adds
+            # no document content).
             remap = self._intern_actors(b.actor_table)
             if remap is not None:
                 self._apply_remap(remap)
+
+            # _ingest needs clock/_all_deps populated for this round's
+            # changes (the slow register path reads them), but a raising
+            # _ingest must leave them untouched or a corrected redelivery
+            # of the same (actor, seq) is silently skipped as a duplicate —
+            # so snapshot and roll back on failure.
+            prev_clock: dict = {}
+            prev_deps: dict = {}
+            for row in rows_arr:
+                actor, seq = b.actors[row], int(b.seqs[row])
+                if actor not in prev_clock:
+                    prev_clock[actor] = self.clock.get(actor)
+                prev_deps[(actor, seq)] = self._all_deps.get((actor, seq))
+                self._all_deps[(actor, seq)] = self._compute_all_deps(
+                    actor, seq, b.deps[row])
+                self.clock[actor] = seq
 
             if len(rows_arr) == b.n_changes:
                 mask = slice(None)  # whole batch ready: no filtering needed
             else:
                 mask = np.isin(b.op_change, rows_arr)
             if b.n_ops:
-                self._ingest(b, mask)
+                try:
+                    self._ingest(b, mask)
+                except BaseException:
+                    for actor, old in prev_clock.items():
+                        if old is None:
+                            self.clock.pop(actor, None)
+                        else:
+                            self.clock[actor] = old
+                    for key, old in prev_deps.items():
+                        if old is None:
+                            self._all_deps.pop(key, None)
+                        else:
+                            self._all_deps[key] = old
+                    raise
 
     # ------------------------------------------------------------------
     # slow register path (host; matches oracle applyAssign semantics)
@@ -252,7 +279,11 @@ class CausalDeviceDoc:
         w_wc = np.zeros(S, bool)
         for i, s in enumerate(uniq):
             s = int(s)
-            ops = sorted(regs[s], key=lambda o: o["actor_rank"], reverse=True)
+            # ascending stable sort + full reverse mirrors the reference's
+            # sortBy(actor).reverse(): same-actor ties (one change assigning
+            # a key twice) resolve to the LAST-written op, matching the
+            # oracle (backend/op_set.py _apply_assign)
+            ops = sorted(regs[s], key=lambda o: o["actor_rank"])[::-1]
             if ops:
                 w = ops[0]
                 w_v[i], w_h[i] = w["value"], True

@@ -189,6 +189,12 @@ class DeviceTextDocSet:
         # (inactive docs write only past their live region)
         need = max(m.n_elems for m in self._meta) + 1 + N
         out_cap = max(bucket(need), self._cap)
+        if self.mesh is not None:
+            # bucket() can yield 3*2^(k-1) sizes that a power-of-two elem
+            # axis doesn't divide; keep the constructor's sharding invariant
+            # by rounding up to a multiple of the elem axis
+            e = self.mesh.shape["elem"]
+            out_cap = -(-out_cap // e) * e
         D = self.n_docs
 
         cols = {k: np.zeros((D, R), np.int32) for k in

@@ -17,6 +17,7 @@
 // Build: g++ -O2 -shared -fPIC codec.cpp -o libamtpu_codec.so (driven by
 // automerge_tpu/native/__init__.py, cached; ctypes binding, no pybind11).
 
+#include <climits>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -121,7 +122,13 @@ struct Parser {
         if (p < end && *p == '-') { neg = true; ++p; }
         if (p >= end || *p < '0' || *p > '9') { fail("expected int"); return false; }
         long long v = 0;
-        while (p < end && *p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+        while (p < end && *p >= '0' && *p <= '9') {
+            if (v > (LLONG_MAX - 9) / 10) {
+                fail("int out of range");  // would wrap -> python fallback
+                return false;
+            }
+            v = v * 10 + (*p++ - '0');
+        }
         if (p < end && (*p == '.' || *p == 'e' || *p == 'E')) {
             fail("float value");  // unsupported -> python fallback
             return false;
@@ -210,6 +217,7 @@ bool parse_elem_id(Batch& b, const std::string& id, int32_t& a, int32_t& c) {
     for (size_t i = pos + 1; i < id.size(); i++) {
         if (id[i] < '0' || id[i] > '9') return false;
         ctr = ctr * 10 + (id[i] - '0');
+        if (ctr > INT32_MAX) return false;  // python fallback, no truncation
     }
     a = b.intern(id.substr(0, pos));
     c = (int32_t)ctr;
@@ -262,20 +270,33 @@ bool parse_op(Parser& ps, Batch& b, const std::string& obj_id,
     if (obj != obj_id) { b.unsupported = true; b.err = "op targets other object"; return true; }
     b.op_change.push_back(change_row);
     if (action == "ins") {
+        if (elem < 0 || elem > INT32_MAX) {
+            // missing 'elem' field (stays -1) or out of int32 range: defer
+            // to the python decoder rather than emit a corrupt packed key
+            b.unsupported = true;
+            b.err = elem < 0 ? "ins without elem" : "elem out of range";
+        }
         b.op_kind.push_back(KIND_INS);
         b.op_ta.push_back(-2);  // filled by caller: the change's actor
-        b.op_tc.push_back((int32_t)elem);
+        b.op_tc.push_back(elem < 0 || elem > INT32_MAX ? 0 : (int32_t)elem);
         if (key == "_head") { b.op_pa.push_back(HEAD_PARENT); b.op_pc.push_back(0); }
         else {
-            int32_t a, c;
-            if (!parse_elem_id(b, key, a, c)) { b.unsupported = true; b.err = "bad elemId"; return true; }
+            int32_t a = HEAD_PARENT, c = 0;
+            if (!parse_elem_id(b, key, a, c)) {
+                // keep columns aligned: the post-parse fixup loop walks all
+                // columns of this change even on the unsupported path
+                b.unsupported = true; b.err = "bad elemId";
+            }
             b.op_pa.push_back(a); b.op_pc.push_back(c);
         }
         b.op_value.push_back(0);
     } else if (action == "set" || action == "del" || action == "inc") {
         b.op_kind.push_back(action == "set" ? KIND_SET : action == "del" ? KIND_DEL : KIND_INC);
-        int32_t a, c;
-        if (!parse_elem_id(b, key, a, c)) { b.unsupported = true; b.err = "bad elemId"; return true; }
+        int32_t a = 0, c = 0;
+        if (!parse_elem_id(b, key, a, c)) {
+            b.unsupported = true; b.err = "bad elemId";  // columns stay aligned
+            a = 0; c = 0;
+        }
         b.op_ta.push_back(a); b.op_tc.push_back(c);
         b.op_pa.push_back(HEAD_PARENT); b.op_pc.push_back(0);
         if (action == "set") {
@@ -322,7 +343,11 @@ bool parse_change(Parser& ps, Batch& b) {
                 b.unsupported = true; b.err = "newline in actor id";
             }
         }
-        else if (k == "seq") { long long s; if (!ps.integer(s)) return false; b.seqs[row] = (int32_t)s; }
+        else if (k == "seq") {
+            long long s; if (!ps.integer(s)) return false;
+            if (s < 0 || s > INT32_MAX) { b.unsupported = true; b.err = "seq out of range"; s = 0; }
+            b.seqs[row] = (int32_t)s;
+        }
         else if (k == "deps") {
             // deps is a flat {actor: seq} map; re-serialize compactly (the
             // python side json-decodes each line, so no raw input slices —

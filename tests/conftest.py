@@ -16,9 +16,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from automerge_tpu._env import virtual_cpu_env  # noqa: E402
 
 _env = virtual_cpu_env(8)
-for _k in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_COMPILATION_CACHE_DIR",
+if os.environ.get("AUTOMERGE_TPU_TESTS_ON_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = _env["JAX_PLATFORMS"]
+    os.environ["XLA_FLAGS"] = _env["XLA_FLAGS"]
+for _k in ("JAX_COMPILATION_CACHE_DIR",
            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
-    os.environ[_k] = _env[_k]
+    os.environ.setdefault(_k, _env[_k])
 
 
 def pytest_configure(config):

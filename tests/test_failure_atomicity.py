@@ -1,0 +1,76 @@
+"""Failure atomicity + register tie-break semantics (advisor round-1 items).
+
+A raising batch must leave the document state untouched — including the
+causal clock, or a corrected redelivery of the same (actor, seq) is silently
+skipped as a duplicate. And same-actor register ties (one change assigning a
+key twice) must resolve like the reference's sortBy(actor).reverse(): the
+last-written op wins (/root/reference/backend/op_set.js:245).
+"""
+
+import pytest
+
+from automerge_tpu._common import ROOT_ID
+from automerge_tpu.backend import Backend
+from automerge_tpu.engine import DeviceMapDoc, DeviceTextDoc
+
+
+def ins(obj, key, elem):
+    return {"action": "ins", "obj": obj, "key": key, "elem": elem}
+
+
+def setop(obj, key, value):
+    return {"action": "set", "obj": obj, "key": key, "value": value}
+
+
+class TestClockRollbackOnFailedIngest:
+    def test_redelivery_after_failed_batch_applies(self):
+        doc = DeviceTextDoc("obj1")
+        bad = {"actor": "a", "seq": 1, "deps": {},
+               "ops": [ins("obj1", "ghost:99", 1),
+                       setop("obj1", "a:1", "x")]}
+        with pytest.raises(ValueError, match="unknown parent"):
+            doc.apply_changes([bad])
+        assert doc.clock == {}
+        assert ("a", 1) not in doc._all_deps
+
+        good = {"actor": "a", "seq": 1, "deps": {},
+                "ops": [ins("obj1", "_head", 1), setop("obj1", "a:1", "x")]}
+        doc.apply_changes([good])
+        assert doc.text() == "x"
+        assert doc.clock == {"a": 1}
+
+    def test_prior_state_survives_failed_batch(self):
+        doc = DeviceTextDoc("obj1")
+        doc.apply_changes([{"actor": "a", "seq": 1, "deps": {},
+                            "ops": [ins("obj1", "_head", 1),
+                                    setop("obj1", "a:1", "h")]}])
+        bad = {"actor": "b", "seq": 1, "deps": {},
+               "ops": [ins("obj1", "nowhere:7", 1),
+                       setop("obj1", "b:1", "y")]}
+        with pytest.raises(ValueError):
+            doc.apply_changes([bad])
+        assert doc.clock == {"a": 1}
+        assert doc.text() == "h"
+        # the failed actor can still deliver a corrected change
+        doc.apply_changes([{"actor": "b", "seq": 1, "deps": {},
+                            "ops": [ins("obj1", "a:1", 1),
+                                    setop("obj1", "b:1", "i")]}])
+        assert doc.text() == "hi"
+
+
+class TestSameActorTieBreak:
+    CHANGE = {"actor": "a", "seq": 1, "deps": {},
+              "ops": [setop(ROOT_ID, "k", 1), setop(ROOT_ID, "k", 2)]}
+
+    def test_oracle_last_written_wins(self):
+        state = Backend.init()
+        state, patch = Backend.apply_changes(state, [self.CHANGE])
+        final = patch["diffs"][-1]
+        assert final["value"] == 2
+        assert [c["value"] for c in final["conflicts"]] == [1]
+
+    def test_engine_last_written_wins(self):
+        doc = DeviceMapDoc(ROOT_ID)
+        doc.apply_changes([self.CHANGE])
+        assert doc.to_dict() == {"k": 2}
+        assert doc.conflicts_for("k") == {"a": 1}

@@ -183,3 +183,53 @@ def test_decode_speed_sanity():
     t_python = time.perf_counter() - t0
     assert_batches_equal(fast, slow)
     assert t_native < t_python  # typically 20-100x
+
+
+@needs_native
+def test_ins_without_elem_falls_back():
+    # the python decoder rejects this input (KeyError); the native path must
+    # not silently accept it with a corrupt -1 counter
+    changes = [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+        {"action": "ins", "obj": "t", "key": "_head"}]}]
+    assert native.decode_text_changes(json.dumps(changes), "t") is None
+
+
+@needs_native
+def test_out_of_int32_range_falls_back():
+    # oversized elem / elemId counter / seq must defer to python, not truncate
+    big = 2 ** 31
+    payloads = [
+        [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+            {"action": "ins", "obj": "t", "key": "_head", "elem": big}]}],
+        [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+            {"action": "del", "obj": "t", "key": f"a:{big}"}]}],
+        [{"actor": "a", "seq": big, "deps": {}, "ops": []}],
+    ]
+    for changes in payloads:
+        assert native.decode_text_changes(json.dumps(changes), "t") is None
+
+
+@needs_native
+def test_malformed_elem_id_falls_back_without_crash():
+    # column alignment: the per-change fixup loop walks every column even on
+    # the unsupported path, so a bad elemId must not short-push columns
+    for key in ("nocolon", "a:", "a:12x"):
+        changes = [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+            {"action": "del", "obj": "t", "key": key},
+            {"action": "ins", "obj": "t", "key": "_head", "elem": 1}]}]
+        assert native.decode_text_changes(json.dumps(changes), "t") is None
+        changes = [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+            {"action": "ins", "obj": "t", "key": key, "elem": 1},
+            {"action": "set", "obj": "t", "key": "a:1", "value": "x"}]}]
+        assert native.decode_text_changes(json.dumps(changes), "t") is None
+
+
+@needs_native
+def test_llong_wrapping_int_falls_back():
+    # 2**64+1 wraps long long accumulation without a guard; must fall back
+    huge = str(2 ** 64 + 1)
+    for payload in (
+        '[{"actor": "a", "seq": 1, "deps": {}, "ops": [{"action": "ins", "obj": "t", "key": "_head", "elem": %s}]}]' % huge,
+        '[{"actor": "a", "seq": %s, "deps": {}, "ops": []}]' % huge,
+    ):
+        assert native.decode_text_changes(payload, "t") is None
