@@ -10,8 +10,8 @@ from __future__ import annotations
 
 import json
 
-from . import backend as Backend
 from . import frontend as Frontend
+from .backend import default as Backend
 from ._common import ROOT_ID
 from ._uuid import uuid  # noqa: F401  (re-exported, like the reference)
 from .frontend import Counter, Table, Text  # noqa: F401

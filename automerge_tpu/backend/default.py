@@ -1,0 +1,28 @@
+"""Default backend binding for the facade and sync layers.
+
+The public API routes through the device-engine backend (``device.py``) —
+flat documents ride the TPU columnar engine, everything else graduates to
+the oracle transparently. Set ``AUTOMERGE_TPU_BACKEND=oracle`` to pin the
+pure-host oracle backend instead (the device module dispatches on state
+type, so documents built under either binding interoperate).
+"""
+
+import os as _os
+
+if _os.environ.get("AUTOMERGE_TPU_BACKEND") == "oracle":
+    from . import facade as _impl
+else:
+    from . import device as _impl
+
+init = _impl.init
+apply_changes = _impl.apply_changes
+apply_local_change = _impl.apply_local_change
+get_patch = _impl.get_patch
+get_changes = _impl.get_changes
+get_changes_for_actor = _impl.get_changes_for_actor
+get_missing_changes = _impl.get_missing_changes
+get_missing_deps = _impl.get_missing_deps
+merge = _impl.merge
+undo = _impl.undo
+redo = _impl.redo
+Backend = _impl.Backend

@@ -1,0 +1,698 @@
+"""Device-engine backend behind the frontend↔backend protocol seam.
+
+This is the framework's north-star wiring: the TPU columnar engine serves the
+real public API through the same plain-JSON change/patch protocol as the
+oracle backend (the reference's backend-injection seam,
+/root/reference/frontend/index.js:110-114, /root/reference/src/automerge.js:20-29).
+
+Scope and strategy — device-first with graduation:
+
+- **Flat documents ride the device.** A root map (``DeviceMapDoc`` registers)
+  plus any number of text/list objects (``DeviceTextDoc`` columnar element
+  tables) created by ``makeText``/``makeList`` and linked into root keys.
+  That covers the reference's hot workloads (text editing, map/counter
+  registers) with batched device merges.
+- **Everything else graduates.** The first change (or undo/redo request)
+  outside that shape — nested maps/tables, links below the root, ops on
+  unknown objects — replays the delivery log into the oracle backend
+  (``facade.py``) and hands the lineage over. Semantics are identical either
+  way; graduation is a performance cliff, not a behavior change.
+
+Patches are **net diffs**: instead of the reference's per-op incremental diff
+emission (skip-list order statistics per op, op_set.js:144-171), the device
+applies a whole batch, then one vectorized pass compares the before/after
+element tables and emits remove/insert/set diffs with sequentially-correct
+indexes (removes at descending old indexes, inserts at ascending final
+indexes). The diff *sequence* differs from the reference's, but patches are
+document-transformers, and the resulting document is identical — the parity
+tests compare materialized documents across both backends.
+
+States are immutable views ``(shared core, version)`` like the oracle's
+command-log design (facade.py): applying to a stale state forks the core by
+deterministic replay of the delivery log.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .._common import ROOT_ID, make_elem_id
+from . import facade as _oracle
+from .facade import BackendState as _OracleState
+
+_FLAT_MAKES = ("makeText", "makeList")
+_MAKES = ("makeMap", "makeList", "makeText", "makeTable")
+
+
+def _in_scope(changes, known) -> bool:
+    """True iff every op stays within the flat-document device shape, given
+    the text/list object ids `known` to exist at the target state."""
+    known = set(known)
+    for change in changes:
+        made_here = set()
+        for op in change.get("ops", ()):
+            action = op.get("action")
+            obj = op.get("obj")
+            if action in ("makeText", "makeList"):
+                made_here.add(op["obj"])
+            elif action in ("makeMap", "makeTable"):
+                return False
+            elif action == "link":
+                if obj != ROOT_ID:
+                    return False
+                if op.get("value") not in known and \
+                        op.get("value") not in made_here:
+                    return False
+            elif action == "ins":
+                if obj not in known and obj not in made_here:
+                    return False
+            elif action in ("set", "del", "inc"):
+                if obj != ROOT_ID and obj not in known \
+                        and obj not in made_here:
+                    return False
+            else:
+                return False
+        known |= made_here
+    return True
+
+
+def _transitive(states: dict, base_deps: dict) -> dict:
+    """Vector clock implied by `base_deps` (op_set.js:29-37)."""
+    deps: dict = {}
+    for a, s in base_deps.items():
+        if s <= 0:
+            continue
+        lst = states.get(a, [])
+        if s <= len(lst):
+            for a2, s2 in lst[s - 1]["allDeps"].items():
+                if s2 > deps.get(a2, 0):
+                    deps[a2] = s2
+        deps[a] = s
+    return deps
+
+
+def _clean(change: dict) -> dict:
+    if "requestType" in change or "undoable" in change:
+        return {k: v for k, v in change.items()
+                if k not in ("requestType", "undoable")}
+    return change
+
+
+def _sub_change(change: dict, ops: list) -> dict:
+    return {"actor": change["actor"], "seq": change["seq"],
+            "deps": change.get("deps", {}), "ops": ops}
+
+
+class _TextObj:
+    """Host wrapper for one device text/list object + diffing snapshots."""
+
+    __slots__ = ("kind", "doc", "max_elem", "prev_n", "prev_vis",
+                 "prev_value", "prev_conf", "announced")
+
+    def __init__(self, obj_id: str, kind: str):
+        from ..engine.text_doc import DeviceTextDoc
+        self.kind = kind                     # "text" | "list"
+        self.doc = DeviceTextDoc(obj_id, capacity=64)
+        self.max_elem = 0
+        self.prev_n = 0                      # n_elems at last snapshot
+        self.prev_vis = np.zeros(1, bool)    # slot-aligned visibility
+        self.prev_value = np.zeros(1, np.int32)
+        self.prev_conf: dict = {}            # slot -> conflict signature
+        self.announced = False               # create diff emitted?
+
+    def conflict_sig(self) -> dict:
+        """Comparable, decode-free conflict snapshot: slot -> tuple of
+        (actor_id, raw value ref, counter flag)."""
+        doc = self.doc
+        return {s: tuple((doc.actor_table[o["actor_rank"]], o["value"],
+                          o["counter"]) for o in ops)
+                for s, ops in doc.conflicts.items() if ops}
+
+    def snapshot(self):
+        doc = self.doc
+        n = doc.n_elems
+        h = doc._mirrors() if n else {"has_value": np.zeros(1, bool),
+                                      "value": np.zeros(1, np.int32)}
+        self.prev_n = n
+        self.prev_vis = np.array(h["has_value"][: n + 1], bool)
+        self.prev_value = np.array(h["value"][: n + 1], np.int32)
+        self.prev_conf = self.conflict_sig()
+
+
+class _RootObj:
+    """Host wrapper for the device root map + diffing snapshot."""
+
+    __slots__ = ("doc", "prev")
+
+    def __init__(self):
+        from ..engine.map_doc import DeviceMapDoc
+        self.doc = DeviceMapDoc(ROOT_ID, capacity=16)
+        self.prev: dict = {}                 # key -> (raw value, conflict sig)
+
+    def current(self) -> dict:
+        doc = self.doc
+        h = doc._mirrors()
+        conf = {}
+        for s, ops in doc.conflicts.items():
+            if ops:
+                conf[s] = tuple((doc.actor_table[o["actor_rank"]],
+                                 o["value"], o["counter"]) for o in ops)
+        out = {}
+        for key, slot in doc._key_slot.items():
+            if h["has_value"][slot]:
+                out[key] = (int(h["value"][slot]), conf.get(slot))
+        return out
+
+
+class _DeviceCore:
+    """Shared mutable engine state for one document lineage."""
+
+    def __init__(self):
+        self.states: dict = {}               # actor -> [{change, allDeps}]
+        self.history: list = []              # applied changes, application order
+        self.queue: list = []
+        self.clock: dict = {}
+        self.deps: dict = {}
+        self.undo_pos = 0                    # undoable local changes (device
+        # mode never pops it; actual undo graduates to the oracle)
+        self.objects: dict = {}              # obj_id -> _TextObj
+        self.obj_order: list = []            # creation order
+        self.root = _RootObj()
+        self.commands: list = []             # delivery log for fork/replay
+
+    # -- admission (mirror of op_set.js addChange/applyQueuedOps) -------
+
+    def _admit(self, change: dict, creations: dict) -> bool:
+        actor, seq = change["actor"], change["seq"]
+        prior = self.states.get(actor, [])
+        if seq <= len(prior):
+            if prior[seq - 1]["change"] != change:
+                raise RuntimeError(
+                    f"Inconsistent reuse of sequence number {seq} by {actor}")
+            return False  # idempotent duplicate
+        base = dict(change.get("deps", {}))
+        base[actor] = seq - 1
+        all_deps = _transitive(self.states, base)
+        if any(op.get("action") in _FLAT_MAKES
+               for op in change.get("ops", ())):
+            creations[(actor, seq)] = dict(self.clock)
+        self.states.setdefault(actor, []).append(
+            {"change": change, "allDeps": all_deps})
+        new_deps = {a: s for a, s in self.deps.items()
+                    if s > all_deps.get(a, 0)}
+        new_deps[actor] = seq
+        self.deps = new_deps
+        self.clock[actor] = seq
+        self.history.append(change)
+        return True
+
+    def _ready(self, change: dict) -> bool:
+        deps = dict(change.get("deps", {}))
+        deps[change["actor"]] = change["seq"] - 1
+        return all(self.clock.get(a, 0) >= s for a, s in deps.items())
+
+    # -- application ----------------------------------------------------
+
+    def apply(self, changes, undoable: bool) -> list:
+        """Admit + distribute + diff one delivery. Returns patch diffs."""
+        self.queue.extend(_clean(c) for c in changes)
+        applied: list = []
+        creations: dict = {}                 # (actor, seq) -> clock before
+        while True:
+            rest = []
+            progress = False
+            for ch in self.queue:
+                if self._ready(ch):
+                    if self._admit(ch, creations):
+                        applied.append(ch)
+                    progress = True
+                else:
+                    rest.append(ch)
+            self.queue = rest
+            if not progress:
+                break
+        if undoable:
+            self.undo_pos += 1
+        touched, created = self._distribute(applied, creations)
+        return self._emit_diffs(touched, created)
+
+    def _seed_all_deps(self) -> dict:
+        return {(a, i + 1): e["allDeps"]
+                for a, lst in self.states.items() for i, e in enumerate(lst)}
+
+    def _distribute(self, applied, creations):
+        """Feed applied changes to the per-object device docs."""
+        if not applied:
+            return set(), []
+        feeds = {oid: [] for oid in self.objects}
+        root_feed = []
+        touched: set = set()
+        created: list = []
+        for ch in applied:
+            by_obj: dict = {}
+            root_ops = []
+            for op in ch["ops"]:
+                action = op["action"]
+                obj = op["obj"]
+                if action in _FLAT_MAKES:
+                    kind = "text" if action == "makeText" else "list"
+                    tobj = _TextObj(obj, kind)
+                    tobj.doc.clock = dict(
+                        creations.get((ch["actor"], ch["seq"]), self.clock))
+                    tobj.doc.clock.pop(ch["actor"], None)
+                    if ch["seq"] > 1:
+                        tobj.doc.clock[ch["actor"]] = ch["seq"] - 1
+                    tobj.doc._all_deps = self._seed_all_deps()
+                    self.objects[obj] = tobj
+                    self.obj_order.append(obj)
+                    feeds[obj] = []
+                    created.append(obj)
+                elif obj == ROOT_ID:
+                    root_ops.append(op)
+                else:
+                    by_obj.setdefault(obj, []).append(op)
+                    if action == "ins":
+                        self.objects[obj].max_elem = max(
+                            self.objects[obj].max_elem, op["elem"])
+            for oid, sub in feeds.items():
+                ops = by_obj.get(oid, [])
+                sub.append(_sub_change(ch, ops))
+                if ops:
+                    touched.add(oid)
+            root_feed.append(_sub_change(ch, root_ops))
+            if root_ops:
+                touched.add(ROOT_ID)
+        self.root.doc.apply_changes(root_feed)
+        for oid, sub in feeds.items():
+            self.objects[oid].doc.apply_changes(sub)
+        return touched, created
+
+    # -- diff emission (net diffs, vectorized) --------------------------
+
+    def _decode_text(self, tobj: _TextObj, v: int) -> dict:
+        if v >= 0:
+            return {"value": chr(int(v))}
+        e = tobj.doc.value_pool[-int(v) - 1]
+        out = {"value": e["value"]}
+        if e.get("datatype"):
+            out["datatype"] = e["datatype"]
+        return out
+
+    def _decode_root(self, v: int) -> dict:
+        if v >= 0:
+            return {"value": int(v)}
+        e = self.root.doc.value_pool[-int(v) - 1]
+        out = {"value": e["value"]}
+        if e.get("datatype"):
+            out["datatype"] = e["datatype"]
+        if e.get("link"):
+            out["link"] = True
+        return out
+
+    def _text_conflicts(self, tobj: _TextObj, slot: int):
+        ops = tobj.doc.conflicts.get(slot)
+        if not ops:
+            return None
+        out = []
+        for op in ops:
+            c = {"actor": tobj.doc.actor_table[op["actor_rank"]]}
+            c.update(self._decode_text(tobj, op["value"]))
+            out.append(c)
+        return out
+
+    def _root_conflicts(self, slot: int):
+        doc = self.root.doc
+        ops = doc.conflicts.get(slot)
+        if not ops:
+            return None
+        out = []
+        for op in ops:
+            c = {"actor": doc.actor_table[op["actor_rank"]]}
+            c.update(self._decode_root(op["value"]))
+            out.append(c)
+        return out
+
+    def _paths(self) -> dict:
+        """obj_id -> root-relative path ([key]) for currently linked objects."""
+        doc = self.root.doc
+        h = doc._mirrors()
+        paths = {}
+        for key, slot in doc._key_slot.items():
+            if h["has_value"][slot]:
+                v = int(h["value"][slot])
+                if v < 0:
+                    e = doc.value_pool[-v - 1]
+                    if e.get("link"):
+                        paths[e["value"]] = [key]
+        return paths
+
+    def _text_diffs(self, obj_id: str, tobj: _TextObj, path, out: list,
+                    rebuild: bool = False):
+        doc = tobj.doc
+        n = doc.n_elems
+        if not tobj.announced or rebuild:
+            out.append({"action": "create", "obj": obj_id, "type": tobj.kind})
+            tobj.announced = True
+        if n == 0:
+            if tobj.max_elem and (rebuild or tobj.prev_n != n):
+                out.append({"action": "maxElem", "obj": obj_id,
+                            "type": tobj.kind, "value": tobj.max_elem,
+                            "path": path})
+            return
+        pos = doc._positions()               # RGA position per slot, len n+1
+        order = np.empty(n, np.int64)
+        order[np.asarray(pos[1:])] = np.arange(1, n + 1)  # slots in list order
+        h = doc._mirrors()
+        vis = np.array(h["has_value"][: n + 1], bool)
+        val = np.array(h["value"][: n + 1], np.int32)
+        old_n = 0 if rebuild else tobj.prev_n
+        old_vis = np.zeros(n + 1, bool)
+        old_vis[: old_n + 1] = tobj.prev_vis[: old_n + 1] if not rebuild else False
+        old_val = np.zeros(n + 1, np.int32)
+        if not rebuild:
+            old_val[: old_n + 1] = tobj.prev_value[: old_n + 1]
+        conf = tobj.conflict_sig()
+        old_conf = {} if rebuild else tobj.prev_conf
+
+        o_vis = old_vis[order]
+        n_vis = vis[order]
+        old_rank = np.cumsum(o_vis) - o_vis   # old index per ordered slot
+        new_rank = np.cumsum(n_vis) - n_vis   # new index per ordered slot
+
+        typ = tobj.kind
+
+        # removes, descending old index
+        rem = np.flatnonzero(o_vis & ~n_vis)
+        for p in rem[::-1]:
+            out.append({"action": "remove", "obj": obj_id, "type": typ,
+                        "index": int(old_rank[p]), "path": path})
+        # inserts, ascending final index
+        ins = np.flatnonzero(~o_vis & n_vis)
+        actor_col = h["actor"]
+        ctr_col = h["ctr"]
+        for p in ins:
+            slot = int(order[p])
+            diff = {"action": "insert", "obj": obj_id, "type": typ,
+                    "index": int(new_rank[p]),
+                    "elemId": make_elem_id(
+                        doc.actor_table[int(actor_col[slot])],
+                        int(ctr_col[slot])),
+                    "path": path}
+            diff.update(self._decode_text(tobj, int(val[slot])))
+            c = self._text_conflicts(tobj, slot)
+            if c:
+                diff["conflicts"] = c
+            out.append(diff)
+        # sets: surviving elements whose value or conflicts changed
+        both = np.flatnonzero(o_vis & n_vis)
+        for p in both:
+            slot = int(order[p])
+            if val[slot] == old_val[slot] and \
+                    conf.get(slot) == old_conf.get(slot):
+                continue
+            diff = {"action": "set", "obj": obj_id, "type": typ,
+                    "index": int(new_rank[p]), "path": path}
+            diff.update(self._decode_text(tobj, int(val[slot])))
+            c = self._text_conflicts(tobj, slot)
+            if c:
+                diff["conflicts"] = c
+            out.append(diff)
+        if tobj.max_elem and (rebuild or ins.size or tobj.prev_n != n):
+            out.append({"action": "maxElem", "obj": obj_id, "type": typ,
+                        "value": tobj.max_elem, "path": path})
+
+    def _root_diffs(self, out: list, rebuild: bool = False):
+        doc = self.root.doc
+        cur = self.root.current()
+        prev = {} if rebuild else self.root.prev
+        for key in prev:
+            if key not in cur:
+                out.append({"action": "remove", "obj": ROOT_ID, "type": "map",
+                            "key": key, "path": []})
+        for key, (raw, sig) in cur.items():
+            if prev.get(key) == (raw, sig):
+                continue
+            diff = {"action": "set", "obj": ROOT_ID, "type": "map",
+                    "key": key, "path": []}
+            diff.update(self._decode_root(raw))
+            c = self._root_conflicts(doc._key_slot[key])
+            if c:
+                diff["conflicts"] = c
+            out.append(diff)
+        self.root.prev = cur
+
+    def _emit_diffs(self, touched: set, created: list) -> list:
+        diffs: list = []
+        paths = self._paths()
+        for oid in self.obj_order:
+            if oid in touched or oid in created:
+                tobj = self.objects[oid]
+                self._text_diffs(oid, tobj, paths.get(oid), diffs)
+                tobj.snapshot()
+        if ROOT_ID in touched:
+            self._root_diffs(diffs)
+        return diffs
+
+    def rebuild_diffs(self) -> list:
+        """Whole-document construction diffs (getPatch semantics)."""
+        diffs: list = []
+        paths = self._paths()
+        for oid in self.obj_order:
+            tobj = self.objects[oid]
+            self._text_diffs(oid, tobj, paths.get(oid), diffs, rebuild=True)
+        self._root_diffs(diffs, rebuild=True)
+        return diffs
+
+    # -- fork / restore -------------------------------------------------
+
+    def fork(self, version: int) -> "_DeviceCore":
+        """Deterministic replay of the delivery log prefix (facade's
+        fork-by-replay, paid only on divergence or restore)."""
+        clone = _DeviceCore()
+        for cmd in self.commands[:version]:
+            if cmd[0] == "apply":
+                clone.apply(cmd[1], cmd[2])
+            else:  # "local"
+                clone.apply([cmd[1]], cmd[1].get("undoable", True) is not False)
+            clone.commands.append(cmd)
+        return clone
+
+    def restore(self, version: int):
+        """Rebuild in place after a failed mutation (facade._restore)."""
+        clean = self.fork(version)
+        for slot in ("states", "history", "queue", "clock", "deps",
+                     "undo_pos", "objects", "obj_order", "root", "commands"):
+            setattr(self, slot, getattr(clean, slot))
+
+    def graduate(self, version: int) -> _OracleState:
+        """Replay the delivery log into an oracle backend state."""
+        state = _oracle.init()
+        for cmd in self.commands[:version]:
+            if cmd[0] == "apply":
+                state, _ = _oracle.apply_changes(state, cmd[1])
+            else:
+                state, _ = _oracle.apply_local_change(state, cmd[1])
+        return state
+
+
+class DeviceBackendState:
+    """Immutable view of one point in a device-backed document lineage."""
+
+    __slots__ = ("_core", "_version", "_fork_cache", "clock", "deps",
+                 "can_undo", "can_redo", "queue", "history_len")
+
+    def __init__(self, core: _DeviceCore, version: int):
+        self._core = core
+        self._version = version
+        self._fork_cache: Optional[_DeviceCore] = None
+        self.clock = dict(core.clock)
+        self.deps = dict(core.deps)
+        self.can_undo = core.undo_pos > 0
+        self.can_redo = False                # redo stack lives oracle-side
+        self.queue = tuple(core.queue)
+        self.history_len = len(core.history)
+
+    def _is_current(self) -> bool:
+        return len(self._core.commands) == self._version
+
+    def writable_core(self) -> _DeviceCore:
+        if self._is_current():
+            return self._core
+        return self._core.fork(self._version)
+
+    def read_core(self) -> _DeviceCore:
+        if self._is_current():
+            return self._core
+        if self._fork_cache is None:
+            self._fork_cache = self._core.fork(self._version)
+        return self._fork_cache
+
+    def history(self) -> list:
+        return self._core.history[: self.history_len]
+
+
+def _make_patch(state, diffs: list) -> dict:
+    return {"clock": dict(state.clock), "deps": dict(state.deps),
+            "canUndo": state.can_undo, "canRedo": state.can_redo,
+            "diffs": diffs}
+
+
+def init() -> DeviceBackendState:
+    return DeviceBackendState(_DeviceCore(), 0)
+
+
+def _device_apply(state: DeviceBackendState, changes, undoable: bool,
+                  command):
+    # scope gate BEFORE any forking: graduation replays the log prefix into
+    # the oracle and never needs a device fork. For the common current-state
+    # case the live object table answers scope directly; for a stale state,
+    # the flat makes in its applied history reconstruct the same set.
+    if state._is_current():
+        known = state._core.objects.keys()
+    else:
+        known = {op["obj"] for ch in state.history()
+                 for op in ch.get("ops", ())
+                 if op.get("action") in _FLAT_MAKES}
+    if not _in_scope(changes, known):
+        oracle_state = state._core.graduate(state._version)
+        if command[0] == "local":
+            return _oracle.apply_local_change(oracle_state, command[1])
+        return _oracle.apply_changes(oracle_state, changes)
+    core = state.writable_core()
+    try:
+        diffs = core.apply(changes, undoable)
+    except Exception:
+        core.restore(state._version)
+        raise
+    core.commands.append(command)
+    new_state = DeviceBackendState(core, len(core.commands))
+    return new_state, _make_patch(new_state, diffs)
+
+
+def apply_changes(state, changes):
+    if isinstance(state, _OracleState):
+        return _oracle.apply_changes(state, changes)
+    return _device_apply(state, changes, False, ("apply", list(changes), False))
+
+
+def apply_local_change(state, change: dict):
+    if isinstance(state, _OracleState):
+        return _oracle.apply_local_change(state, change)
+    if not isinstance(change.get("actor"), str) or \
+            not isinstance(change.get("seq"), int):
+        raise TypeError("Change request requires `actor` and `seq` properties")
+    if change["seq"] <= state.clock.get(change["actor"], 0):
+        raise ValueError("Change request has already been applied")
+    request_type = change.get("requestType")
+    if request_type == "change":
+        undoable = change.get("undoable", True) is not False
+        new_state, patch = _device_apply(state, [change], undoable,
+                                         ("local", change))
+    elif request_type in ("undo", "redo"):
+        # undo/redo synthesis needs the oracle's inverse-op capture: graduate
+        # (straight from the shared append-only log — no device fork needed)
+        oracle_state = state._core.graduate(state._version)
+        new_state, patch = _oracle.apply_local_change(oracle_state, change)
+    else:
+        raise ValueError(f"Unknown requestType: {request_type}")
+    patch["actor"] = change["actor"]
+    patch["seq"] = change["seq"]
+    return new_state, patch
+
+
+def get_patch(state) -> dict:
+    if isinstance(state, _OracleState):
+        return _oracle.get_patch(state)
+    core = state.read_core()
+    return _make_patch(state, core.rebuild_diffs())
+
+
+def _state_changes(state, have_deps: dict, clock_bound=None) -> list:
+    core = state._core
+    all_deps = _transitive(core.states, have_deps)
+    changes = []
+    for actor, lst in core.states.items():
+        upper = len(lst) if clock_bound is None else \
+            min(len(lst), clock_bound.get(actor, 0))
+        for entry in lst[all_deps.get(actor, 0): upper]:
+            changes.append(entry["change"])
+    return changes
+
+
+def get_changes(old_state, new_state) -> list:
+    if isinstance(new_state, _OracleState):
+        if isinstance(old_state, _OracleState):
+            return _oracle.get_changes(old_state, new_state)
+        # mixed lineage (graduated): diff by clocks via the oracle index
+        return _oracle.get_missing_changes(new_state, old_state.clock)
+    from .._common import less_or_equal
+    if not less_or_equal(old_state.clock, new_state.clock):
+        raise ValueError("Cannot diff two states that have diverged")
+    return _state_changes(new_state, old_state.clock, new_state.clock)
+
+
+def get_changes_for_actor(state, actor_id: str) -> list:
+    if isinstance(state, _OracleState):
+        return _oracle.get_changes_for_actor(state, actor_id)
+    lst = state._core.states.get(actor_id, [])
+    upper = min(len(lst), state.clock.get(actor_id, 0))
+    return [e["change"] for e in lst[:upper]]
+
+
+def get_missing_changes(state, clock: dict) -> list:
+    if isinstance(state, _OracleState):
+        return _oracle.get_missing_changes(state, clock)
+    return _state_changes(state, clock, state.clock)
+
+
+def get_missing_deps(state) -> dict:
+    if isinstance(state, _OracleState):
+        return _oracle.get_missing_deps(state)
+    from .op_set import OpSetIndex
+    return OpSetIndex.missing_deps_of_queue(state.queue, state.clock)
+
+
+def merge(local, remote):
+    changes = get_missing_changes(remote, local.clock)
+    return apply_changes(local, changes)
+
+
+def undo(state, request):
+    if isinstance(state, _OracleState):
+        return _oracle.undo(state, request)
+    return _oracle.undo(state._core.graduate(state._version), request)
+
+
+def redo(state, request):
+    if isinstance(state, _OracleState):
+        return _oracle.redo(state, request)
+    return _oracle.redo(state._core.graduate(state._version), request)
+
+
+class DeviceBackend:
+    """Injectable backend namespace (the options.backend seam) routing flat
+    documents to the device engine, with oracle graduation."""
+
+    init = staticmethod(init)
+    applyChanges = staticmethod(apply_changes)
+    applyLocalChange = staticmethod(apply_local_change)
+    getPatch = staticmethod(get_patch)
+    getChanges = staticmethod(get_changes)
+    getChangesForActor = staticmethod(get_changes_for_actor)
+    getMissingChanges = staticmethod(get_missing_changes)
+    getMissingDeps = staticmethod(get_missing_deps)
+    merge = staticmethod(merge)
+    apply_changes = staticmethod(apply_changes)
+    apply_local_change = staticmethod(apply_local_change)
+    get_patch = staticmethod(get_patch)
+    get_changes = staticmethod(get_changes)
+    get_changes_for_actor = staticmethod(get_changes_for_actor)
+    get_missing_changes = staticmethod(get_missing_changes)
+    get_missing_deps = staticmethod(get_missing_deps)
+    undo = staticmethod(undo)
+    redo = staticmethod(redo)
+
+
+Backend = DeviceBackend

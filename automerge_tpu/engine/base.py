@@ -117,6 +117,7 @@ class CausalDeviceDoc:
     def apply_batch(self, batch):
         """Merge a columnar change batch (causally gated, idempotent)."""
         # --- admission: schedule rows in causal rounds over a host clock ---
+        prior_queue = list(self.queue)  # restored if a round fails below
         pending = list(range(batch.n_changes)) + self.queue
         clock = dict(self.clock)
         scheduled: set = set()  # (actor, seq) admitted in this call
@@ -147,8 +148,23 @@ class CausalDeviceDoc:
         else:
             self.queue = []
 
-        for ready in rounds:
-            self._apply_round(ready)
+        applied: set = set()
+        try:
+            for ready in rounds:
+                self._apply_round(ready)
+                applied |= {(b.actors[r], int(b.seqs[r])) for b, r in ready}
+        except BaseException:
+            # a failed round must not swallow changes that were queued before
+            # this call: admission consumed self.queue into the round plan, so
+            # put back every prior item that did not actually apply. Changes
+            # delivered IN this call are dropped wholesale — the call raised,
+            # so the caller redelivers (matching the reference's all-or-
+            # nothing applyChanges; completed earlier rounds are the
+            # documented change-granularity deviation).
+            self.queue = [
+                it for it in prior_queue
+                if (it[0].actors[it[1]], int(it[0].seqs[it[1]])) not in applied]
+            raise
         self._invalidate()
         return self
 

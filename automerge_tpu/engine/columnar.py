@@ -77,12 +77,13 @@ class MapChangeBatch:
                     raise ValueError(
                         f"op targets {op.get('obj')}, batch is for {obj_id}")
                 action = op["action"]
-                if action not in ("set", "del", "inc"):
+                if action not in ("set", "del", "inc", "link"):
                     raise ValueError(
                         f"unsupported map op action: {action}")
                 cols["change"].append(row)
                 cols["kind"].append(
-                    {"set": KIND_SET, "del": KIND_DEL, "inc": KIND_INC}[action])
+                    {"set": KIND_SET, "del": KIND_DEL, "inc": KIND_INC,
+                     "link": KIND_SET}[action])
                 cols["key"].append(intern_key(op["key"]))
                 if action == "set":
                     value = op["value"]
@@ -93,6 +94,11 @@ class MapChangeBatch:
                         value_pool.append(
                             {"value": value, "datatype": op.get("datatype")})
                         cols["val"].append(-len(value_pool))
+                elif action == "link":
+                    # a link is a register op whose value is an object id
+                    # (reference op_set.js:196-258 treats set/link uniformly)
+                    value_pool.append({"value": op["value"], "link": True})
+                    cols["val"].append(-len(value_pool))
                 elif action == "inc":
                     cols["val"].append(op["value"])
                 else:

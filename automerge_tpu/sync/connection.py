@@ -11,7 +11,7 @@ than their clock is sent; clock-only messages advertise or request state.
 
 from __future__ import annotations
 
-from .. import backend as Backend
+from ..backend import default as Backend
 from .. import frontend as Frontend
 from .._common import less_or_equal
 

@@ -7,7 +7,7 @@ batches over (many documents merged in one call).
 
 from __future__ import annotations
 
-from .. import backend as Backend
+from ..backend import default as Backend
 from .. import frontend as Frontend
 
 

@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from .. import backend as Backend
+from ..backend import default as Backend
 from .. import frontend as Frontend
 
 

@@ -1,0 +1,258 @@
+"""The device-engine backend behind the public API (the options.backend seam).
+
+Parity strategy: every scenario runs twice — once on the device backend (the
+default binding), once on the oracle — and the *materialized documents* must
+match: to_json, conflicts, element ids, text content. Patches are net diffs
+on the device path, so raw diff lists are not compared (they are equivalent
+document-transformers, not byte-identical streams).
+"""
+
+import random
+
+import pytest
+
+import automerge_tpu as _am
+from automerge_tpu import backend as oracle_backend
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.backend import device as device_backend
+from automerge_tpu.backend.device import DeviceBackendState
+from automerge_tpu.backend.facade import BackendState as OracleState
+
+
+def init_with(backend, actor):
+    return Frontend.init({"actorId": actor, "backend": backend})
+
+
+BACKENDS = {"device": device_backend.DeviceBackend,
+            "oracle": oracle_backend.Backend}
+
+
+def both(fn):
+    """Run a scenario on each backend; return {name: result}."""
+    return {name: fn(be) for name, be in BACKENDS.items()}
+
+
+def doc_fingerprint(doc):
+    """Everything user-visible: values, conflicts, element ids."""
+    out = {"json": _am.to_json(doc)}
+    conf = {}
+    for key in doc.keys():
+        c = Frontend.get_conflicts(doc, key)
+        if c:
+            conf[key] = {a: _am.to_json(v) if hasattr(v, "_object_id") else v
+                         for a, v in c.items()}
+        value = doc[key]
+        if isinstance(value, Frontend.Text):
+            out.setdefault("elem_ids", {})[key] = \
+                Frontend.get_element_ids(value)
+    out["conflicts"] = conf
+    return out
+
+
+class TestTextFlowsStayOnDevice:
+    def test_change_merge_apply_changes_use_device_state(self):
+        d = init_with(device_backend.DeviceBackend, "alice")
+        d = _am.change(d, lambda doc: doc.__setitem__("t", Frontend.Text("hi")))
+        assert isinstance(Frontend.get_backend_state(d), DeviceBackendState)
+        e = init_with(device_backend.DeviceBackend, "bob")
+        e = _am.apply_changes(e, _am.get_all_changes(d))
+        assert isinstance(Frontend.get_backend_state(e), DeviceBackendState)
+        e = _am.change(e, lambda doc: doc["t"].insert_at(2, "!"))
+        m = _am.merge(d, e)
+        assert isinstance(Frontend.get_backend_state(m), DeviceBackendState)
+        assert str(m["t"]) == "hi!"
+
+    def test_nested_objects_graduate_to_oracle(self):
+        d = init_with(device_backend.DeviceBackend, "alice")
+        d = _am.change(d, lambda doc: doc.__setitem__("card", {"x": 1}))
+        assert isinstance(Frontend.get_backend_state(d), OracleState)
+        assert _am.to_json(d) == {"card": {"x": 1}}
+
+    def test_graduated_doc_keeps_working(self):
+        d = init_with(device_backend.DeviceBackend, "alice")
+        d = _am.change(d, lambda doc: doc.__setitem__("t", Frontend.Text("abc")))
+        d = _am.change(d, lambda doc: doc.__setitem__("m", {"k": 1}))  # graduates
+        d = _am.change(d, lambda doc: doc["t"].insert_at(3, "d"))
+        assert str(d["t"]) == "abcd"
+        assert _am.to_json(d)["m"] == {"k": 1}
+
+
+def scenario_typing(be):
+    d = init_with(be, "alice")
+    d = _am.change(d, lambda doc: doc.__setitem__("t", Frontend.Text("")))
+    for i, ch in enumerate("hello world"):
+        d = _am.change(d, lambda doc, c=ch, i=i: doc["t"].insert_at(i, c))
+    return doc_fingerprint(d)
+
+
+def scenario_concurrent_text(be):
+    a = init_with(be, "alice")
+    a = _am.change(a, lambda doc: doc.__setitem__("t", Frontend.Text("base")))
+    b = init_with(be, "bob")
+    b = _am.apply_changes(b, _am.get_all_changes(a))
+    a = _am.change(a, lambda doc: doc["t"].insert_at(4, "A", "A"))
+    b = _am.change(b, lambda doc: doc["t"].insert_at(0, "B"))
+    b = _am.change(b, lambda doc: doc["t"].delete_at(1))
+    m1 = _am.merge(a, b)
+    m2 = _am.merge(b, a)
+    f1, f2 = doc_fingerprint(m1), doc_fingerprint(m2)
+    assert f1 == f2
+    return f1
+
+
+def scenario_map_conflicts(be):
+    a = init_with(be, "aaa")
+    b = init_with(be, "zzz")
+    a = _am.change(a, lambda doc: doc.__setitem__("k", "from-a"))
+    b = _am.change(b, lambda doc: doc.__setitem__("k", "from-z"))
+    b = _am.change(b, lambda doc: doc.__setitem__("other", 42))
+    m = _am.merge(a, b)
+    return doc_fingerprint(m)
+
+
+def scenario_counters(be):
+    a = init_with(be, "alice")
+    a = _am.change(a, lambda doc: doc.__setitem__("c", Frontend.Counter(10)))
+    b = init_with(be, "bob")
+    b = _am.apply_changes(b, _am.get_all_changes(a))
+    a = _am.change(a, lambda doc: doc["c"].increment(3))
+    b = _am.change(b, lambda doc: doc["c"].increment(5))
+    m1, m2 = _am.merge(a, b), _am.merge(b, a)
+    f1, f2 = doc_fingerprint(m1), doc_fingerprint(m2)
+    assert f1 == f2
+    assert f1["json"]["c"] == 18
+    return f1
+
+
+def scenario_delete_and_resurrect(be):
+    a = init_with(be, "alice")
+    a = _am.change(a, lambda doc: doc.__setitem__("t", Frontend.Text("xyz")))
+    b = init_with(be, "bob")
+    b = _am.apply_changes(b, _am.get_all_changes(a))
+    a = _am.change(a, lambda doc: doc["t"].delete_at(1))
+    b = _am.change(b, lambda doc: doc["t"].set(1, "Y"))  # concurrent set: add-wins
+    m1, m2 = _am.merge(a, b), _am.merge(b, a)
+    f1, f2 = doc_fingerprint(m1), doc_fingerprint(m2)
+    assert f1 == f2
+    return f1
+
+
+def scenario_key_delete(be):
+    a = init_with(be, "alice")
+    a = _am.change(a, lambda doc: doc.update({"x": 1, "y": 2}))
+    a = _am.change(a, lambda doc: doc.__delitem__("x"))
+    return doc_fingerprint(a)
+
+
+@pytest.mark.parametrize("scenario", [
+    scenario_typing, scenario_concurrent_text, scenario_map_conflicts,
+    scenario_counters, scenario_delete_and_resurrect, scenario_key_delete,
+], ids=lambda f: f.__name__)
+def test_backend_parity(scenario):
+    results = both(scenario)
+    assert results["device"] == results["oracle"]
+
+
+class TestCausalBuffering:
+    def test_out_of_order_delivery_through_api(self):
+        a = init_with(device_backend.DeviceBackend, "alice")
+        a = _am.change(a, lambda doc: doc.__setitem__("t", Frontend.Text("a")))
+        a = _am.change(a, lambda doc: doc["t"].insert_at(1, "b"))
+        changes = _am.get_all_changes(a)
+        assert len(changes) == 2
+        b = init_with(device_backend.DeviceBackend, "bob")
+        b = _am.apply_changes(b, [changes[1]])   # seq 2 before seq 1
+        assert _am.to_json(b) == {}
+        assert _am.get_missing_deps(b) == {"alice": 1}
+        b = _am.apply_changes(b, [changes[0]])
+        assert str(b["t"]) == "ab"
+        assert _am.get_missing_deps(b) == {}
+
+    def test_duplicate_changes_idempotent(self):
+        a = init_with(device_backend.DeviceBackend, "alice")
+        a = _am.change(a, lambda doc: doc.__setitem__("t", Frontend.Text("hi")))
+        changes = _am.get_all_changes(a)
+        b = init_with(device_backend.DeviceBackend, "bob")
+        b = _am.apply_changes(b, changes)
+        b = _am.apply_changes(b, changes)
+        assert str(b["t"]) == "hi"
+
+
+class TestRandomizedParity:
+    """Random flat histories: N actors typing/deleting/setting concurrently
+    with random merges, device vs oracle, checked after every merge."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_flat_history(self, seed):
+        rng = random.Random(seed)
+        n_actors = 3
+
+        def run(be):
+            base = init_with(be, "base")
+            base = _am.change(base, lambda doc: doc.update(
+                {"t": Frontend.Text("seed"), "n": 0}))
+            changes = _am.get_all_changes(base)
+            docs = [
+                _am.apply_changes(init_with(be, f"ac{i}"), changes)
+                for i in range(n_actors)]
+            r = random.Random(seed + 1)
+            prints = []
+            for _ in range(6):
+                i = r.randrange(n_actors)
+
+                def edit(d, r=r):
+                    t = d["t"]
+                    for _ in range(r.randrange(1, 4)):
+                        op = r.random()
+                        if op < 0.5 or len(t) == 0:
+                            t.insert_at(r.randint(0, len(t)),
+                                        chr(97 + r.randrange(26)))
+                        elif op < 0.75:
+                            t.delete_at(r.randrange(len(t)))
+                        else:
+                            d["n"] = r.randrange(100)
+                docs[i] = _am.change(docs[i], edit)
+                i, j = r.sample(range(n_actors), 2)
+                docs[i] = _am.merge(docs[i], docs[j])
+                prints.append(doc_fingerprint(docs[i]))
+            return prints
+
+        assert run(device_backend.DeviceBackend) == run(oracle_backend.Backend)
+
+
+class TestSaveLoadHistory:
+    def test_save_load_round_trip(self):
+        d = init_with(device_backend.DeviceBackend, "alice")
+        d = _am.change(d, lambda doc: doc.__setitem__("t", Frontend.Text("persist")))
+        d = _am.change(d, lambda doc: doc["t"].delete_at(0))
+        loaded = _am.load(_am.save(d))
+        assert _am.to_json(loaded) == _am.to_json(d)
+
+    def test_history_snapshots(self):
+        d = init_with(device_backend.DeviceBackend, "alice")
+        d = _am.change(d, lambda doc: doc.__setitem__("t", Frontend.Text("ab")))
+        d = _am.change(d, lambda doc: doc["t"].insert_at(2, "c"))
+        hist = _am.get_history(d)
+        assert len(hist) == 2
+        assert str(hist[0].snapshot["t"]) == "ab"
+        assert str(hist[1].snapshot["t"]) == "abc"
+
+    def test_diff_between_states(self):
+        d = init_with(device_backend.DeviceBackend, "alice")
+        d = _am.change(d, lambda doc: doc.__setitem__("t", Frontend.Text("x")))
+        d2 = _am.change(d, lambda doc: doc["t"].insert_at(1, "y"))
+        diffs = _am.diff(d, d2)
+        assert any(x["action"] == "insert" for x in diffs)
+
+
+class TestUndoGraduation:
+    def test_undo_after_device_changes(self):
+        d = init_with(device_backend.DeviceBackend, "u")
+        d = _am.change(d, lambda doc: doc.__setitem__("a", 1))
+        d = _am.change(d, lambda doc: doc.__setitem__("a", 2))
+        assert Frontend.can_undo(d)
+        d = _am.undo(d)
+        assert isinstance(Frontend.get_backend_state(d), OracleState)
+        assert _am.to_json(d) == {"a": 1}
+        d = _am.redo(d)
+        assert _am.to_json(d) == {"a": 2}

@@ -58,6 +58,29 @@ class TestClockRollbackOnFailedIngest:
         assert doc.text() == "hi"
 
 
+class TestQueueSurvivesFailedRound:
+    def test_previously_queued_change_not_dropped(self):
+        doc = DeviceTextDoc("obj1")
+        # B2 queues awaiting b:1
+        b2 = {"actor": "b", "seq": 2, "deps": {},
+              "ops": [ins("obj1", "b:1", 2), setop("obj1", "b:2", "2")]}
+        doc.apply_changes([b2])
+        assert len(doc.queue) == 1
+        # bad b1 unblocks B2's round but fails its own; B2 must requeue
+        bad_b1 = {"actor": "b", "seq": 1, "deps": {},
+                  "ops": [ins("obj1", "ghost:1", 1), setop("obj1", "b:1", "x")]}
+        with pytest.raises(ValueError, match="unknown parent"):
+            doc.apply_changes([bad_b1])
+        assert doc.clock == {}
+        assert len(doc.queue) == 1  # B2 still waiting
+        # corrected b1: both apply
+        good_b1 = {"actor": "b", "seq": 1, "deps": {},
+                   "ops": [ins("obj1", "_head", 1), setop("obj1", "b:1", "1")]}
+        doc.apply_changes([good_b1])
+        assert doc.text() == "12"
+        assert doc.queue == []
+
+
 class TestSameActorTieBreak:
     CHANGE = {"actor": "a", "seq": 1, "deps": {},
               "ops": [setop(ROOT_ID, "k", 1), setop(ROOT_ID, "k", 2)]}

@@ -10,8 +10,27 @@ import random
 import numpy as np
 import pytest
 
-import automerge_tpu as am
+import automerge_tpu as _am
+from automerge_tpu import backend as oracle_backend
 from automerge_tpu import frontend as Frontend
+
+
+class am:
+    """Thin view of the public API with init pinned to the ORACLE backend:
+    these tests introspect the oracle's OpSetIndex (read_index), so docs must
+    be built on it regardless of the default device-backend binding."""
+
+    change = staticmethod(_am.change)
+    apply_changes = staticmethod(_am.apply_changes)
+    get_all_changes = staticmethod(_am.get_all_changes)
+    merge = staticmethod(_am.merge)
+
+    @staticmethod
+    def init(options=None):
+        if isinstance(options, str):
+            options = {"actorId": options}
+        return Frontend.init(
+            {"backend": oracle_backend.Backend, **(options or {})})
 
 
 def oracle_order(doc, list_key):
