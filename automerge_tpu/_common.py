@@ -52,3 +52,22 @@ def parse_elem_id(elem_id: str):
 
 def make_elem_id(actor_id: str, counter: int) -> str:
     return f"{actor_id}:{counter}"
+
+
+def transitive_deps(states: dict, base_deps: dict) -> dict:
+    """Full vector clock implied by `base_deps` over an actor-states map
+    ``{actor: [{"change": ..., "allDeps": ...}, ...]}`` (the reference's
+    transitiveDeps, /root/reference/backend/op_set.js:29-37). Shared by the
+    oracle index and the device backend so the closure semantics cannot
+    drift."""
+    deps: dict = {}
+    for dep_actor, dep_seq in base_deps.items():
+        if dep_seq <= 0:
+            continue
+        lst = states.get(dep_actor, [])
+        if dep_seq <= len(lst):  # unknown deps contribute no closure
+            for a, s in lst[dep_seq - 1]["allDeps"].items():
+                if s > deps.get(a, 0):
+                    deps[a] = s
+        deps[dep_actor] = dep_seq
+    return deps

@@ -38,7 +38,7 @@ from typing import Optional
 
 import numpy as np
 
-from .._common import ROOT_ID, make_elem_id
+from .._common import ROOT_ID, make_elem_id, transitive_deps
 from . import facade as _oracle
 from .facade import BackendState as _OracleState
 
@@ -78,19 +78,7 @@ def _in_scope(changes, known) -> bool:
     return True
 
 
-def _transitive(states: dict, base_deps: dict) -> dict:
-    """Vector clock implied by `base_deps` (op_set.js:29-37)."""
-    deps: dict = {}
-    for a, s in base_deps.items():
-        if s <= 0:
-            continue
-        lst = states.get(a, [])
-        if s <= len(lst):
-            for a2, s2 in lst[s - 1]["allDeps"].items():
-                if s2 > deps.get(a2, 0):
-                    deps[a2] = s2
-        deps[a] = s
-    return deps
+_transitive = transitive_deps  # shared closure (see _common.transitive_deps)
 
 
 def _clean(change: dict) -> dict:
@@ -284,10 +272,27 @@ class _DeviceCore:
             root_feed.append(_sub_change(ch, root_ops))
             if root_ops:
                 touched.add(ROOT_ID)
-        self.root.doc.apply_changes(root_feed)
+        self._feed(self.root.doc, root_feed,
+                   active=ROOT_ID in touched)
         for oid, sub in feeds.items():
-            self.objects[oid].doc.apply_changes(sub)
+            self._feed(self.objects[oid].doc, sub,
+                       active=oid in touched or oid in created)
         return touched, created
+
+    def _feed(self, doc, sub_changes, active: bool):
+        """Deliver a change window to one device doc. Docs the window never
+        touches skip device work entirely: their causal state (clock +
+        allDeps, needed for future covering checks) advances directly from
+        the backend's already-computed entries."""
+        if active:
+            doc.apply_changes(sub_changes)
+            return
+        for ch in sub_changes:
+            actor, seq = ch["actor"], ch["seq"]
+            if seq > doc.clock.get(actor, 0):
+                doc.clock[actor] = seq
+            doc._all_deps[(actor, seq)] = \
+                self.states[actor][seq - 1]["allDeps"]
 
     # -- diff emission (net diffs, vectorized) --------------------------
 
@@ -572,9 +577,11 @@ def _device_apply(state: DeviceBackendState, changes, undoable: bool,
 
 
 def apply_changes(state, changes):
+    changes = list(changes)  # materialize BEFORE logging: iterator inputs
+    # must see identical content in the live apply and the replay log
     if isinstance(state, _OracleState):
         return _oracle.apply_changes(state, changes)
-    return _device_apply(state, changes, False, ("apply", list(changes), False))
+    return _device_apply(state, changes, False, ("apply", changes, False))
 
 
 def apply_local_change(state, change: dict):

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
-from .._common import ROOT_ID, make_elem_id, parse_elem_id
+from .._common import ROOT_ID, make_elem_id, parse_elem_id, transitive_deps
 from .skip_list import SkipList
 
 _MAKE_ACTIONS = ("makeMap", "makeList", "makeText", "makeTable")
@@ -83,17 +83,7 @@ class OpSetIndex:
 
     def transitive_deps(self, base_deps: dict) -> dict:
         """Full vector clock implied by `base_deps` (op_set.js:29-37)."""
-        deps: dict[str, int] = {}
-        for dep_actor, dep_seq in base_deps.items():
-            if dep_seq <= 0:
-                continue
-            states = self.states.get(dep_actor, [])
-            if dep_seq <= len(states):  # unknown deps contribute no transitive closure
-                for a, s in states[dep_seq - 1]["allDeps"].items():
-                    if s > deps.get(a, 0):
-                        deps[a] = s
-            deps[dep_actor] = dep_seq
-        return deps
+        return transitive_deps(self.states, base_deps)
 
     # ------------------------------------------------------------------
     # object-tree navigation

@@ -62,6 +62,7 @@ class CausalDeviceDoc:
         self._actor_rank: dict = {}
         self.clock: dict = {}                 # actor id -> seq
         self._all_deps: dict = {}             # (actor, seq) -> allDeps dict
+        self._closure_memo: dict = {}         # frozen base deps -> allDeps
         self.queue: list = []                 # (batch, row) not causally ready
         self.conflicts: dict = {}             # slot -> extra surviving ops
         self.value_pool: list = []            # rich values (non-inline)
@@ -99,7 +100,19 @@ class CausalDeviceDoc:
     # ------------------------------------------------------------------
 
     def _compute_all_deps(self, actor: str, seq: int, deps: dict) -> dict:
-        return transitive_closure(self._all_deps, actor, seq, deps)
+        # batches of concurrent changes typically share one dep frontier
+        # (e.g. 10k actors all depending on {base: 1}); the closure depends
+        # only on the base dep set, so memoize on it. Entries are treated as
+        # read-only by every consumer.
+        base = dict(deps)
+        if seq > 1:
+            base[actor] = seq - 1
+        key = tuple(sorted(base.items()))
+        hit = self._closure_memo.get(key)
+        if hit is None:
+            hit = transitive_closure(self._all_deps, actor, 0, base)
+            self._closure_memo[key] = hit
+        return hit
 
     def _causally_covers(self, all_deps: dict, op: dict) -> bool:
         if op["actor_rank"] < 0:
@@ -220,6 +233,8 @@ class CausalDeviceDoc:
                             self._all_deps.pop(key, None)
                         else:
                             self._all_deps[key] = old
+                    # closures derived from the rolled-back entries are stale
+                    self._closure_memo.clear()
                     raise
 
     # ------------------------------------------------------------------

@@ -256,3 +256,32 @@ class TestUndoGraduation:
         assert _am.to_json(d) == {"a": 1}
         d = _am.redo(d)
         assert _am.to_json(d) == {"a": 2}
+
+
+def test_apply_changes_accepts_iterator():
+    # the command log and the live core must see identical content when the
+    # caller passes a generator (regression: iterator exhausted into the log)
+    a = init_with(device_backend.DeviceBackend, "alice")
+    a = _am.change(a, lambda doc: doc.__setitem__("t", Frontend.Text("gen")))
+    changes = _am.get_all_changes(a)
+    b = init_with(device_backend.DeviceBackend, "bob")
+    b = _am.apply_changes(b, iter(changes))
+    assert str(b["t"]) == "gen"
+    # a stale-state fork (diff path) replays the log: must match the live doc
+    b2 = _am.change(b, lambda doc: doc["t"].insert_at(3, "!"))
+    assert any(d["action"] == "insert" for d in _am.diff(b, b2))
+
+
+def test_untouched_objects_skip_device_work_but_track_causality():
+    a = init_with(device_backend.DeviceBackend, "alice")
+    a = _am.change(a, lambda doc: doc.update(
+        {"t1": Frontend.Text("one"), "t2": Frontend.Text("two")}))
+    b = init_with(device_backend.DeviceBackend, "bob")
+    b = _am.apply_changes(b, _am.get_all_changes(a))
+    # edits touching only t1; t2's doc must stay causally current
+    a = _am.change(a, lambda doc: doc["t1"].insert_at(3, "!"))
+    b = _am.apply_changes(b, _am.get_changes(b, a))
+    # now a dependent edit on t2 (deps reference the t1-only change)
+    a = _am.change(a, lambda doc: doc["t2"].insert_at(3, "?"))
+    b = _am.apply_changes(b, _am.get_changes(b, a))
+    assert str(b["t1"]) == "one!" and str(b["t2"]) == "two?"
