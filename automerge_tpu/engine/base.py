@@ -23,11 +23,32 @@ interning order change).
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
 
 from .._common import KIND_INC, KIND_SET
+
+
+@dataclass
+class PreparedBatch:
+    """A batch planned + staged against a document's current state.
+
+    Produced by `CausalDeviceDoc.prepare_batch`, consumed exactly once by
+    `commit_prepared`. Holds the admission plan (causal rounds) and each
+    round's staged device inputs, so the commit path is pure bookkeeping +
+    kernel dispatch — all host->device byte movement already happened.
+    This is the engine's ingestion pipelining seam: prepare batch k+1
+    (host planning + transfers) while the device still executes batch k."""
+
+    gen: int                  # document generation the plan is valid for
+    rounds: list              # [(batch, rows_arr, book, exec_plan), ...]
+    #   book = ([(actor, seq), ...], [allDeps closure, ...]) per round group
+    queue_after: list         # queue state once the batch is admitted
+    prior_queue: list         # queue state to restore on failure
+    memo_overlay: dict        # closure-memo entries minted while planning
+    n_staged_bytes: int       # total bytes shipped host->device at prepare
 
 
 def transitive_closure(all_deps: dict, actor: str, seq: int,
@@ -68,6 +89,7 @@ class CausalDeviceDoc:
         self.value_pool: list = []            # rich values (non-inline)
         self._dev: Optional[dict] = None      # device arrays (lazy)
         self._host: Optional[dict] = None     # numpy mirrors (lazy)
+        self._gen = 0                         # bumps on every state mutation
 
     # ------------------------------------------------------------------
     # actor interning (order-preserving: rank order == lexicographic order)
@@ -99,19 +121,28 @@ class CausalDeviceDoc:
     # causality
     # ------------------------------------------------------------------
 
-    def _compute_all_deps(self, actor: str, seq: int, deps: dict) -> dict:
+    def _compute_all_deps(self, actor: str, seq: int, deps: dict,
+                          all_deps=None, memo=None) -> dict:
         # batches of concurrent changes typically share one dep frontier
         # (e.g. 10k actors all depending on {base: 1}); the closure depends
-        # only on the base dep set, so memoize on it. Entries are treated as
-        # read-only by every consumer.
-        base = dict(deps)
-        if seq > 1:
-            base[actor] = seq - 1
-        key = tuple(sorted(base.items()))
-        hit = self._closure_memo.get(key)
+        # only on the effective base dep set — (implicit self-dep, explicit
+        # deps) — so memoize on that key without building the merged dict on
+        # hits. Entries are treated as read-only by every consumer.
+        # `all_deps`/`memo` default to the document's maps; prepare_batch
+        # passes ChainMap overlays so planning stays side-effect-free.
+        if all_deps is None:
+            all_deps = self._all_deps
+        if memo is None:
+            memo = self._closure_memo
+        key = ((actor, seq - 1, tuple(sorted(deps.items()))) if seq > 1
+               else (None, 0, tuple(sorted(deps.items()))))
+        hit = memo.get(key)
         if hit is None:
-            hit = transitive_closure(self._all_deps, actor, 0, base)
-            self._closure_memo[key] = hit
+            base = dict(deps)
+            if seq > 1:
+                base[actor] = seq - 1
+            hit = transitive_closure(all_deps, actor, 0, base)
+            memo[key] = hit
         return hit
 
     def _causally_covers(self, all_deps: dict, op: dict) -> bool:
@@ -127,40 +158,55 @@ class CausalDeviceDoc:
         return self.apply_batch(
             type(self).batch_type.from_changes(changes, self.obj_id))
 
-    def apply_batch(self, batch):
-        """Merge a columnar change batch (causally gated, idempotent)."""
-        # --- admission: schedule rows in causal rounds over a host clock ---
-        prior_queue = list(self.queue)  # restored if a round fails below
+    def _schedule(self, batch):
+        """Admission scheduling: partition the batch + queued items into
+        causally-ready rounds over a host clock (no state mutation).
+        Returns (rounds, queue_after, prior_queue)."""
+        prior_queue = list(self.queue)
         pending = list(range(batch.n_changes)) + self.queue
         clock = dict(self.clock)
         scheduled: set = set()  # (actor, seq) admitted in this call
         rounds: list = []
+        queue_after: list = []
+        batch_actors = batch.actors
+        batch_seqs = batch.seqs.tolist() if batch.n_changes else []
         while pending:
             ready, not_ready = [], []
             for item in pending:
-                b, row = (batch, item) if isinstance(item, int) else item
-                actor, seq = b.actors[row], int(b.seqs[row])
+                if isinstance(item, int):
+                    b, row = batch, item
+                    actor, seq = batch_actors[row], batch_seqs[row]
+                else:
+                    b, row = item
+                    actor, seq = b.actors[row], int(b.seqs[row])
                 if seq <= clock.get(actor, 0) or (actor, seq) in scheduled:
                     continue  # duplicate: idempotent skip (inconsistent reuse
                     # of a seq by the same actor is not detected here; the
                     # oracle backend raises on it)
-                deps = dict(b.deps[row])
-                deps[actor] = seq - 1
-                if all(clock.get(a, 0) >= s for a, s in deps.items()):
+                # implicit self-dep on (actor, seq-1) OVERRIDES any explicit
+                # self-dep, matching the reference's causallyReady
+                # (/root/reference/backend/op_set.js:20-27)
+                deps = b.deps[row]
+                if (seq <= 1 or clock.get(actor, 0) >= seq - 1) and all(
+                        clock.get(a, 0) >= s for a, s in deps.items()
+                        if a != actor):
                     ready.append((b, row))
                     scheduled.add((actor, seq))
                 else:
                     not_ready.append(item if not isinstance(item, int) else (b, row))
             if not ready:
-                self.queue = not_ready
+                queue_after = not_ready
                 break
             for b, row in ready:
                 clock[b.actors[row]] = int(b.seqs[row])
             rounds.append(ready)
             pending = not_ready
-        else:
-            self.queue = []
+        return rounds, queue_after, prior_queue
 
+    def apply_batch(self, batch):
+        """Merge a columnar change batch (causally gated, idempotent)."""
+        rounds, queue_after, prior_queue = self._schedule(batch)
+        self.queue = queue_after
         applied: set = set()
         try:
             for ready in rounds:
@@ -177,18 +223,65 @@ class CausalDeviceDoc:
             self.queue = [
                 it for it in prior_queue
                 if (it[0].actors[it[1]], int(it[0].seqs[it[1]])) not in applied]
+            self._gen += 1  # queue changed: invalidate outstanding plans
             raise
         self._invalidate()
         return self
 
-    def _apply_round(self, ready):
-        """Apply causally-ready (batch, row) pairs: one device program each."""
+    @staticmethod
+    def _group_round(ready) -> list:
+        """Group one round's (batch, row) pairs by source batch and compute
+        each group's op mask."""
         by_batch: dict = {}
         for b, row in ready:
             by_batch.setdefault(id(b), (b, []))[1].append(row)
-
+        groups = []
         for b, rows in by_batch.values():
             rows_arr = np.asarray(sorted(rows), np.int32)
+            if len(rows_arr) == b.n_changes:
+                mask = slice(None)  # whole batch ready: no filtering needed
+            else:
+                mask = np.isin(b.op_change, rows_arr)
+            groups.append((b, rows_arr, mask))
+        return groups
+
+    def _round_bookkeeping(self, b, rows_arr):
+        """Advance clock/_all_deps for a round's rows; returns the snapshots
+        `_rollback_bookkeeping` needs if the round's ingest fails."""
+        prev_clock: dict = {}
+        prev_deps: dict = {}
+        clock = self.clock
+        all_deps = self._all_deps
+        actors, deps_list = b.actors, b.deps
+        seqs = b.seqs.tolist()
+        for row in rows_arr.tolist():
+            actor, seq = actors[row], seqs[row]
+            if actor not in prev_clock:
+                prev_clock[actor] = clock.get(actor)
+            prev_deps[(actor, seq)] = all_deps.get((actor, seq))
+            all_deps[(actor, seq)] = self._compute_all_deps(
+                actor, seq, deps_list[row])
+            clock[actor] = seq
+        return prev_clock, prev_deps
+
+    def _rollback_bookkeeping(self, snapshots):
+        prev_clock, prev_deps = snapshots
+        for actor, old in prev_clock.items():
+            if old is None:
+                self.clock.pop(actor, None)
+            else:
+                self.clock[actor] = old
+        for key, old in prev_deps.items():
+            if old is None:
+                self._all_deps.pop(key, None)
+            else:
+                self._all_deps[key] = old
+        # closures derived from the rolled-back entries are stale
+        self._closure_memo.clear()
+
+    def _apply_round(self, ready):
+        """Apply causally-ready (batch, row) pairs: one device program each."""
+        for b, rows_arr, mask in self._group_round(ready):
             # ops may reference ids minted by actors whose own changes sit
             # in other rounds, so intern the batch's whole actor table.
             # Interning runs BEFORE the clock advances: a raising remap then
@@ -204,38 +297,133 @@ class CausalDeviceDoc:
             # _ingest must leave them untouched or a corrected redelivery
             # of the same (actor, seq) is silently skipped as a duplicate —
             # so snapshot and roll back on failure.
-            prev_clock: dict = {}
-            prev_deps: dict = {}
-            for row in rows_arr:
-                actor, seq = b.actors[row], int(b.seqs[row])
-                if actor not in prev_clock:
-                    prev_clock[actor] = self.clock.get(actor)
-                prev_deps[(actor, seq)] = self._all_deps.get((actor, seq))
-                self._all_deps[(actor, seq)] = self._compute_all_deps(
-                    actor, seq, b.deps[row])
-                self.clock[actor] = seq
-
-            if len(rows_arr) == b.n_changes:
-                mask = slice(None)  # whole batch ready: no filtering needed
-            else:
-                mask = np.isin(b.op_change, rows_arr)
+            snapshots = self._round_bookkeeping(b, rows_arr)
             if b.n_ops:
                 try:
                     self._ingest(b, mask)
                 except BaseException:
-                    for actor, old in prev_clock.items():
-                        if old is None:
-                            self.clock.pop(actor, None)
-                        else:
-                            self.clock[actor] = old
-                    for key, old in prev_deps.items():
-                        if old is None:
-                            self._all_deps.pop(key, None)
-                        else:
-                            self._all_deps[key] = old
-                    # closures derived from the rolled-back entries are stale
-                    self._closure_memo.clear()
+                    self._rollback_bookkeeping(snapshots)
                     raise
+
+    # ------------------------------------------------------------------
+    # two-phase ingestion (pipelining seam)
+    # ------------------------------------------------------------------
+
+    def prepare_batch(self, batch) -> PreparedBatch:
+        """Plan + stage a batch without mutating document content.
+
+        Runs admission scheduling, per-round host planning (run detection,
+        reference resolution, validity checks), and ships every device
+        input buffer host->device — so `commit_prepared` is bookkeeping +
+        kernel dispatch only. The only state this touches is actor
+        interning, which is content-free (it renames ranks consistently).
+
+        The plan binds to the document's current generation: any other
+        mutation between prepare and commit invalidates it (commit raises
+        ValueError, document unharmed). Use it to pipeline ingestion —
+        prepare batch k+1 while the device executes batch k — or to move
+        transfer latency off the merge critical path."""
+        remap = self._intern_actors(batch.actor_table)
+        if remap is not None:
+            self._apply_remap(remap)
+        rounds, queue_after, prior_queue = self._schedule(batch)
+        # intern queued batches' actors too, BEFORE planning: a remap after
+        # a round was planned would invalidate its staged actor ranks
+        for ready in rounds:
+            for b, _ in ready:
+                if b is not batch:
+                    remap = self._intern_actors(b.actor_table)
+                    if remap is not None:
+                        self._apply_remap(remap)
+        gen = self._gen
+        shadow = self._plan_shadow()
+        planned_rounds = []
+        staged_bytes = 0
+        # precompute each round's clock/deps bookkeeping (the allDeps
+        # closures) so commit is dict updates only. Later rounds may depend
+        # on closures of earlier rounds of this same plan, which are not in
+        # self._all_deps yet — thread them through overlay maps.
+        from collections import ChainMap
+        deps_overlay: dict = {}
+        memo_overlay: dict = {}
+        all_map = ChainMap(deps_overlay, self._all_deps)
+        memo_map = ChainMap(memo_overlay, self._closure_memo)
+        for ready in rounds:
+            for b, rows_arr, mask in self._group_round(ready):
+                actors, deps_list = b.actors, b.deps
+                seqs_l = b.seqs.tolist()
+                pairs, closures = [], []
+                for row in rows_arr.tolist():
+                    actor, seq = actors[row], seqs_l[row]
+                    hit = self._compute_all_deps(
+                        actor, seq, deps_list[row], all_deps=all_map,
+                        memo=memo_map)
+                    deps_overlay[(actor, seq)] = hit
+                    pairs.append((actor, seq))
+                    closures.append(hit)
+                exec_plan = None
+                if b.n_ops:
+                    exec_plan, shadow = self._plan_round(b, mask, shadow)
+                if exec_plan is not None:
+                    staged_bytes += sum(
+                        x.size * x.dtype.itemsize for x in exec_plan.staged)
+                planned_rounds.append((b, rows_arr, (pairs, closures),
+                                       exec_plan))
+        # barrier: the prepared plan is complete only once its buffers are
+        # resident (keeps commit free of transfer stalls)
+        import jax
+        jax.block_until_ready(
+            [x for _, _, _, p in planned_rounds if p is not None
+             for x in p.staged])
+        return PreparedBatch(gen=gen, rounds=planned_rounds,
+                             queue_after=queue_after,
+                             prior_queue=prior_queue,
+                             memo_overlay=memo_overlay,
+                             n_staged_bytes=staged_bytes)
+
+    def commit_prepared(self, prepared: PreparedBatch):
+        """Commit a `prepare_batch` plan: clock/deps bookkeeping + staged
+        kernel dispatch. Raises ValueError (document untouched) if the
+        document mutated since the plan was prepared."""
+        if prepared.gen != self._gen:
+            raise ValueError(
+                "document changed since prepare_batch; re-prepare the batch")
+        self.queue = prepared.queue_after
+        applied: set = set()
+        self._closure_memo.update(prepared.memo_overlay)
+        try:
+            for b, rows_arr, book, exec_plan in prepared.rounds:
+                pairs, closures = book
+                # bulk bookkeeping: closures were precomputed at prepare
+                prev_clock = {a: self.clock.get(a) for a, _ in pairs}
+                prev_deps = {p: self._all_deps.get(p) for p in pairs}
+                self._all_deps.update(zip(pairs, closures))
+                self.clock.update(pairs)
+                if exec_plan is not None:
+                    try:
+                        self._execute_plan(b, exec_plan)
+                    except BaseException:
+                        self._rollback_bookkeeping((prev_clock, prev_deps))
+                        raise
+                applied.update(pairs)
+        except BaseException:
+            self.queue = [
+                it for it in prepared.prior_queue
+                if (it[0].actors[it[1]], int(it[0].seqs[it[1]])) not in applied]
+            self._gen += 1  # queue changed: invalidate outstanding plans
+            raise
+        self._invalidate()
+        return self
+
+    def _plan_shadow(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support two-phase ingestion")
+
+    def _plan_round(self, b, mask, shadow):
+        raise NotImplementedError
+
+    def _execute_plan(self, b, exec_plan):
+        raise NotImplementedError
 
     # ------------------------------------------------------------------
     # slow register path (host; matches oracle applyAssign semantics)
@@ -359,3 +547,4 @@ class CausalDeviceDoc:
 
     def _invalidate(self):
         self._host = None
+        self._gen += 1

@@ -34,6 +34,9 @@ wire to the device, instead of 2k op rows.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+from typing import Any, Optional
+
 import numpy as np
 
 from .._common import HEAD_PARENT, KIND_SET, make_elem_id
@@ -42,6 +45,34 @@ from .columnar import TextChangeBatch
 from .runs import detect_runs
 from .host_index import (DuplicateElemId, ElemRangeIndex, pack_keys,
                          unpack_key)
+
+
+@dataclass
+class _RoundExec:
+    """A planned causally-ready round: staged device inputs + the host
+    state deltas `_execute_plan` commits (see `_plan_round`)."""
+
+    index_after: ElemRangeIndex
+    n_elems_after: int
+    out_cap: int
+    dense: bool
+    n_runs: int
+    n_pairs: int
+    n_res: int
+    base_slot: int
+    desc: Any                 # staged (8, R) int32 device matrix (or None)
+    blob: Any                 # staged value blob (uint8/int32, or None)
+    res: Any                  # staged (8, M) int32 residual matrix (or None)
+    touch: Any                # staged (3, T) chain-touch matrix (or None)
+    ascii_clear: bool
+    res_host: Optional[tuple]  # (kind, val64, actor_rank, seq) per residual
+    seg_inc: int
+
+    @property
+    def staged(self) -> list:
+        """The round's device buffers (for transfer-completion barriers)."""
+        return [x for x in (self.desc, self.blob, self.res, self.touch)
+                if x is not None]
 
 
 class DeviceTextDoc(CausalDeviceDoc):
@@ -69,6 +100,8 @@ class DeviceTextDoc(CausalDeviceDoc):
         self._cap = bucket(max(capacity, 16))
         self._seg_bound = 2                   # upper bound for S sizing
         self._mat = None                      # materialization cache (device)
+        self._mat_S = 0                       # S the cached kernel ran with
+        self._scal = None                     # fetched [n_vis, n_segs]
         self._pos_cache = None
 
     # ------------------------------------------------------------------
@@ -95,7 +128,9 @@ class DeviceTextDoc(CausalDeviceDoc):
     def _invalidate(self):
         self._host = None
         self._mat = None
+        self._scal = None
         self._pos_cache = None
+        self._gen += 1
 
     def _mirrors(self) -> dict:
         """Host numpy mirrors of the element tables (one packed fetch)."""
@@ -114,16 +149,36 @@ class DeviceTextDoc(CausalDeviceDoc):
         dev.update(actor=actor_n, win_actor=wa_n)
         self.index.remap_actors(remap.astype(np.int64))
 
+    def _plan_shadow(self):
+        """Planning shadow state threaded through multi-round preparation."""
+        return (self.n_elems, self.index, self._cap)
+
     def _ingest(self, b: TextChangeBatch, mask):
         """One causally-ready round of one batch: host resolution + at most
         two device programs (run expansion, residual ops)."""
-        import jax.numpy as jnp
-        from ..ops.ingest import apply_residual, bucket, expand_runs
+        plan, _ = self._plan_round(b, mask, self._plan_shadow())
+        if plan is not None:
+            self._execute_plan(b, plan)
 
+    def _plan_round(self, b: TextChangeBatch, mask, shadow):
+        """Host planning of one causally-ready round: run detection, elemId
+        resolution, validity checks, and h2d staging of the packed device
+        inputs. Mutates NOTHING (actor interning must already cover the
+        batch); returns (plan, shadow') where shadow' reflects the round as
+        if committed — `_execute_plan` later applies it for real."""
+        import jax.numpy as jnp
+        from ..ops.ingest import (DESC_ACTOR, DESC_CTR0, DESC_ELEM_BASE,
+                                  DESC_HAS_VALUE, DESC_HEAD_SLOT,
+                                  DESC_PARENT_SLOT, DESC_WIN_ACTOR,
+                                  DESC_WIN_SEQ, RES_ACTOR, RES_CTR, RES_KIND,
+                                  RES_NEW_SLOT, RES_SLOT, RES_VALUE,
+                                  RES_WIN_ACTOR, RES_WIN_SEQ, bucket)
+
+        base_elems, base_index, base_cap = shadow
         kind = np.ascontiguousarray(b.op_kind[mask])
         n_ops = len(kind)
         if n_ops == 0:
-            return
+            return None, shadow
         ta = b.op_target_actor[mask]
         tc = b.op_target_ctr[mask]
         pa = b.op_parent_actor[mask]
@@ -139,7 +194,7 @@ class DeviceTextDoc(CausalDeviceDoc):
 
         # --- typing-run detection: INS immediately followed by its SET,
         # chained with consecutive counters (the dominant text workload) ---
-        plan = detect_runs(kind, ta, tc, pa, pc, val64, op_row, self.n_elems)
+        plan = detect_runs(kind, ta, tc, pa, pc, val64, op_row, base_elems)
         hpos, run_len, rpos, res_is_ins = (
             plan.hpos, plan.run_len, plan.rpos, plan.res_is_ins)
         n_ins, n_runs, n_pairs, n_res_ins = (
@@ -165,7 +220,7 @@ class DeviceTextDoc(CausalDeviceDoc):
 
         if new_starts:
             try:
-                merged_index = self.index.merge(
+                merged_index = base_index.merge(
                     np.concatenate(new_starts), np.concatenate(new_lens),
                     np.concatenate(new_slots))
             except DuplicateElemId as e:
@@ -173,7 +228,7 @@ class DeviceTextDoc(CausalDeviceDoc):
                     f"Duplicate list element ID {decode(e.key)} "
                     f"in {self.obj_id}") from None
         else:
-            merged_index = self.index
+            merged_index = base_index
 
         def resolve_parent(p_actor, p_ctr):
             """Parent refs -> slots (HEAD_PARENT -> slot 0)."""
@@ -211,122 +266,162 @@ class DeviceTextDoc(CausalDeviceDoc):
                         f"in {self.obj_id}")
                 res_target_slot[res_is_assign] = slots
 
-        # --- all validity checks passed: commit index + run device programs
-        self.index = merged_index
+        # --- all validity checks passed: stage packed device inputs. Each
+        # host->device transfer pays per-transfer latency (PCIe round trip;
+        # ~10^2 ms through the benchmarking tunnel), so the round ships at
+        # most three buffers: one (8,R) descriptor matrix, one value blob,
+        # and one (8,M) residual matrix ---
         dense = n_runs > 0 and n_res_ins == 0  # new slots form one window
         N = bucket(n_pairs, 256) if n_runs else 0
-        needed = self.n_elems + 1 + (N if dense else n_ins)
-        out_cap = max(bucket(needed), self._cap)
+        needed = base_elems + 1 + (N if dense else n_ins)
+        out_cap = max(bucket(needed), base_cap)
+
+        desc_dev = blob_dev = None
+        ascii_clear = False
+        if n_runs:
+            R = bucket(n_runs, 64)
+            desc = np.zeros((8, R), np.int32)
+            desc[DESC_ELEM_BASE] = N              # padding sentinel
+            desc[DESC_HEAD_SLOT, :n_runs] = plan.head_slot
+            desc[DESC_PARENT_SLOT, :n_runs] = run_parent_slot
+            desc[DESC_CTR0, :n_runs] = tc[hpos]
+            desc[DESC_ACTOR, :n_runs] = batch_rank[ta[hpos]]
+            desc[DESC_WIN_ACTOR, :n_runs] = row_actor_rank[op_row[hpos]]
+            desc[DESC_WIN_SEQ, :n_runs] = row_seq[op_row[hpos]]
+            desc[DESC_ELEM_BASE, :n_runs] = np.cumsum(run_len) - run_len
+            desc[DESC_HAS_VALUE, :n_runs] = 1
+            if not plan.blob_lt_128:
+                ascii_clear = True
+            blob = np.zeros(N, np.uint8 if plan.blob_lt_256 else np.int32)
+            blob[:n_pairs] = plan.blob
+            desc_dev = jnp.asarray(desc)
+            blob_dev = jnp.asarray(blob)
+
+        res_dev = res_host = None
+        n_res = len(rpos)
+        if n_res:
+            M = bucket(n_res, 128)
+            res = np.zeros((8, M), np.int32)
+            res[RES_KIND] = -1
+            res[RES_SLOT] = out_cap
+            res[RES_NEW_SLOT] = out_cap
+            res[RES_KIND, :n_res] = res_kind
+            res[RES_SLOT, :n_res] = np.where(
+                res_is_ins, res_parent_slot, res_target_slot)
+            res[RES_NEW_SLOT, :n_res] = np.where(
+                res_is_ins, plan.res_new_slot, out_cap)
+            res[RES_CTR, :n_res] = tc[rpos]
+            res[RES_ACTOR, :n_res] = batch_rank[ta[rpos]]
+            res_vals = val64[rpos]
+            if not np.logical_or(
+                    res_kind != KIND_SET, (res_vals >= 0) & (res_vals < 128)
+            ).all():
+                ascii_clear = True
+            res[RES_VALUE, :n_res] = np.clip(res_vals, -2**31, 2**31 - 1)
+            res[RES_WIN_ACTOR, :n_res] = row_actor_rank[op_row[rpos]]
+            res[RES_WIN_SEQ, :n_res] = row_seq[op_row[rpos]]
+            res_dev = jnp.asarray(res)
+            # host columns the slow register path needs at execute time
+            res_host = (res_kind, res_vals, row_actor_rank[op_row[rpos]],
+                        row_seq[op_row[rpos]])
+        elif n_runs == 0:
+            return None, shadow
+
+        # chain bits of elements that lost Lamport-max-child status to this
+        # round's inserts (R-sized; keeps materialize census-free). The
+        # dense path's breaks are fused into expand_runs_dense_packed, so
+        # only mixed rounds stage a touch matrix.
+        touch_dev = None
+        if not dense:
+            touch_p, touch_c, touch_a = [], [], []
+            if n_runs:
+                touch_p.append(run_parent_slot)
+                touch_c.append(tc[hpos].astype(np.int64))
+                touch_a.append(batch_rank[ta[hpos]])
+            if n_res_ins:
+                ri = rpos[res_is_ins]
+                touch_p.append(res_parent_slot[res_is_ins])
+                touch_c.append(tc[ri].astype(np.int64))
+                touch_a.append(batch_rank[ta[ri]])
+            if touch_p:
+                arr_p = np.concatenate(touch_p)
+                T = bucket(len(arr_p), 64)
+                touch = np.zeros((3, T), np.int32)
+                touch[1:] = -1
+                touch[0, : len(arr_p)] = arr_p
+                touch[1, : len(arr_p)] = np.concatenate(touch_c)
+                touch[2, : len(arr_p)] = np.concatenate(touch_a)
+                touch_dev = jnp.asarray(touch)
+
+        exec_plan = _RoundExec(
+            index_after=merged_index, n_elems_after=base_elems + n_ins,
+            out_cap=out_cap, dense=dense, n_runs=n_runs, n_pairs=n_pairs,
+            n_res=n_res, base_slot=base_elems + 1, desc=desc_dev,
+            blob=blob_dev, res=res_dev, touch=touch_dev,
+            ascii_clear=ascii_clear, res_host=res_host,
+            seg_inc=3 * (n_runs + n_res_ins) + 2)
+        return exec_plan, (base_elems + n_ins, merged_index, out_cap)
+
+    def _execute_plan(self, b: TextChangeBatch, plan: "_RoundExec"):
+        """Commit a planned round: index/count bookkeeping + device
+        dispatches (+ the host slow-register path when flagged)."""
+        import jax.numpy as jnp
+        from ..ops.ingest import (apply_residual_packed, break_chains_packed,
+                                  bucket, expand_runs_dense_packed,
+                                  expand_runs_packed)
+
+        out_cap = plan.out_cap
+        self.index = plan.index_after
         dev = self._ensure_dev()
         tables = tuple(dev[k] for k in self._TABLE_KEYS)
 
-        if n_runs:
-            from ..ops.ingest import expand_runs_dense
-            R = bucket(n_runs, 64)
-
-            def padr(arr, fill, dtype=np.int32):
-                out = np.full(R, fill, dtype)
-                out[:n_runs] = arr
-                return jnp.asarray(out)
-
-            if self.all_ascii and not plan.blob_lt_128:
-                self.all_ascii = False
-            blob = np.zeros(N, np.uint8 if plan.blob_lt_256 else np.int32)
-            blob[:n_pairs] = plan.blob
-            elem_base = np.full(R, N, np.int32)
-            elem_base[:n_runs] = np.cumsum(run_len) - run_len
-            run_args = (
-                padr(plan.head_slot, 0), padr(run_parent_slot, 0),
-                padr(tc[hpos], 0), padr(batch_rank[ta[hpos]], 0),
-                padr(row_actor_rank[op_row[hpos]], 0),
-                padr(row_seq[op_row[hpos]], 0), jnp.asarray(elem_base),
-                padr(np.ones(n_runs, bool), False, bool),
-                jnp.asarray(blob), np.int32(n_pairs))
-            if dense:
-                tables = expand_runs_dense(
-                    *tables, *run_args, np.int32(self.n_elems + 1),
+        if plan.n_runs:
+            if plan.dense:
+                tables = expand_runs_dense_packed(
+                    *tables, plan.desc, plan.blob, np.int32(plan.n_pairs),
+                    np.int32(plan.base_slot), np.int32(plan.n_runs),
                     out_cap=out_cap)
             else:
-                tables = expand_runs(*tables, *run_args, out_cap=out_cap)
+                tables = expand_runs_packed(
+                    *tables, plan.desc, plan.blob, np.int32(plan.n_pairs),
+                    out_cap=out_cap)
 
         slow_info_np = None
-        if len(rpos):
-            M = bucket(len(rpos), 128)
-
-            def padm(arr, fill, dtype=np.int32):
-                out = np.full(M, fill, dtype)
-                out[: len(rpos)] = arr
-                return jnp.asarray(out)
-
+        if plan.n_res:
+            # conflict slots are built at execute time (NOT staged at plan
+            # time): an earlier round of the same prepared batch may have
+            # minted new conflicts through the slow path
             K = bucket(max(len(self.conflicts), 1), 64)
             conflict_slots = np.full(K, out_cap, np.int32)
             if self.conflicts:
                 conflict_slots[: len(self.conflicts)] = list(self.conflicts)
-
-            res_vals = val64[rpos]
-            if self.all_ascii and not np.logical_or(
-                    res_kind != KIND_SET, (res_vals >= 0) & (res_vals < 128)
-            ).all():
-                self.all_ascii = False
-            out = apply_residual(
-                *tables,
-                padm(res_kind, -1, np.int8),
-                padm(np.where(res_is_ins, res_parent_slot, res_target_slot),
-                     out_cap),
-                padm(np.where(res_is_ins, plan.res_new_slot, out_cap),
-                     out_cap),
-                padm(tc[rpos], 0), padm(batch_rank[ta[rpos]], 0),
-                padm(np.clip(res_vals, -2**31, 2**31 - 1), 0),
-                padm(row_actor_rank[op_row[rpos]], 0),
-                padm(row_seq[op_row[rpos]], 0),
-                jnp.asarray(conflict_slots), out_cap=out_cap)
+            out = apply_residual_packed(
+                *tables, plan.res, jnp.asarray(conflict_slots),
+                out_cap=out_cap)
             tables = out[:9]
             # one packed transfer: slow mask + slots + register state
-            slow_info_np = np.asarray(out[9])[:, : len(rpos)]
-        elif n_runs == 0:
-            return
+            slow_info_np = np.asarray(out[9])[:, : plan.n_res]
 
-        # break chain bits of elements that lost Lamport-max-child status to
-        # this round's inserts (R-sized; keeps materialize census-free)
-        touch_p, touch_c, touch_a = [], [], []
-        if n_runs:
-            touch_p.append(run_parent_slot)
-            touch_c.append(tc[hpos].astype(np.int64))
-            touch_a.append(batch_rank[ta[hpos]])
-        if n_res_ins:
-            ri = rpos[res_is_ins]
-            touch_p.append(res_parent_slot[res_is_ins])
-            touch_c.append(tc[ri].astype(np.int64))
-            touch_a.append(batch_rank[ta[ri]])
-        if touch_p:
-            from ..ops.ingest import break_chains
-            T = bucket(sum(len(x) for x in touch_p), 64)
-
-            def padt(parts, fill):
-                arr = np.concatenate(parts)
-                out = np.full(T, fill, np.int32)
-                out[: len(arr)] = arr
-                return jnp.asarray(out)
-
-            chain_n = break_chains(
-                tables[8], tables[0], tables[1], tables[2],
-                padt(touch_p, 0), padt(touch_c, -1), padt(touch_a, -1))
+        if plan.touch is not None:
+            chain_n = break_chains_packed(
+                tables[8], tables[0], tables[1], tables[2], plan.touch)
             tables = tables[:8] + (chain_n,)
 
         self._dev = dict(zip(self._TABLE_KEYS, tables))
         self._cap = out_cap
-        self.n_elems += n_ins
+        self.n_elems = plan.n_elems_after
+        if plan.ascii_clear:
+            self.all_ascii = False
         # every inserted run/element can split at most one existing segment
-        self._seg_bound += 3 * (n_runs + n_res_ins) + 2
+        self._seg_bound += plan.seg_inc
         self._invalidate()
 
         if slow_info_np is not None and slow_info_np[0].any():
+            res_kind, res_vals, res_rank, res_seq = plan.res_host
             idxs = np.nonzero(slow_info_np[0])[0]
-            ops_idx = rpos[idxs]
             self._apply_slow(
-                b, slow_info_np[1][idxs], kind[ops_idx], val64[ops_idx],
-                row_actor_rank[op_row[ops_idx]], row_seq[op_row[ops_idx]],
-                slot_cap=self._cap,
+                b, slow_info_np[1][idxs], res_kind[idxs], res_vals[idxs],
+                res_rank[idxs], res_seq[idxs], slot_cap=self._cap,
                 reg_state=tuple(slow_info_np[r][idxs] for r in range(2, 7)))
 
     # ------------------------------------------------------------------
@@ -334,36 +429,61 @@ class DeviceTextDoc(CausalDeviceDoc):
     # ------------------------------------------------------------------
 
     def _materialize(self, with_pos: bool = True):
-        """Cached device materialization -> (pos?, codes, [n_vis, n_segs]
-        as numpy). `with_pos=False` runs the cheaper codes-only kernel
-        (enough for `text()`); codes are uint8 when the doc is all-7-bit."""
+        """Cached device materialization -> (pos?, codes, scalars) with
+        scalars = [n_vis, n_segs] still ON DEVICE (dispatch only — no sync;
+        fetch through `_scalars()`). `with_pos=False` runs the cheaper
+        codes-only kernel (enough for `text()`); codes are uint8 when the
+        doc is all-7-bit. Correct by construction: `_seg_bound` is a proven
+        upper bound on n_segs (each insert splits at most one segment), so
+        the S bucket always fits — `_scalars()` still verifies and retries
+        defensively."""
         if self._mat is not None and (len(self._mat) == 3 or not with_pos):
             return self._mat
-        from ..ops.ingest import bucket, materialize_codes, materialize_text
+        from ..ops.ingest import bucket
+        S = bucket(self._seg_bound + 2, 64)
+        self._mat = self._run_materialize(with_pos, S)
+        self._mat_S = S
+        self._scal = None
+        return self._mat
+
+    def _run_materialize(self, with_pos: bool, S: int):
+        from ..ops.ingest import materialize_codes, materialize_text
         dev = self._ensure_dev()
         fn = materialize_text if with_pos else materialize_codes
-        S = bucket(self._seg_bound + 2, 64)
-        while True:
-            out = fn(dev["parent"], dev["ctr"], dev["actor"], dev["value"],
-                     dev["has_value"], dev["chain"], np.int32(self.n_elems),
-                     S=S, as_u8=self.all_ascii)
-            scalars = np.asarray(out[-1])
-            n_segs = int(scalars[1])
-            if n_segs + 2 <= S:
-                break
-            # bound was stale (e.g. a partial-round estimate)
-            S = bucket(n_segs + 2, 64)
-        self._seg_bound = n_segs  # tighten for the next materialize
-        self._mat = out[:-1] + (scalars,)
-        return self._mat
+        return fn(dev["parent"], dev["ctr"], dev["actor"], dev["value"],
+                  dev["has_value"], dev["chain"], np.int32(self.n_elems),
+                  S=S, as_u8=self.all_ascii)
+
+    def _scalars(self) -> np.ndarray:
+        """Fetch [n_vis, n_segs] of the cached materialization (the one
+        device->host sync of the read path); verifies the S bucket actually
+        fit and re-runs bigger if the host bound was ever stale."""
+        if self._scal is None:
+            from ..ops.ingest import bucket
+            if self._mat is None:
+                self._materialize(with_pos=False)
+            while True:
+                scalars = np.asarray(self._mat[-1])
+                n_segs = int(scalars[1])
+                if n_segs + 2 <= self._mat_S:
+                    break
+                # bound was stale (defensive; should be unreachable)
+                S = bucket(n_segs + 2, 64)
+                self._mat = self._run_materialize(len(self._mat) == 3, S)
+                self._mat_S = S
+            self._seg_bound = n_segs  # tighten for the next materialize
+            self._scal = scalars
+        return self._scal
 
     def _positions(self) -> np.ndarray:
         if self._pos_cache is None:
             if self.n_elems == 0:
                 self._pos_cache = np.full(1, -1, np.int32)
             elif self.use_condensed:
-                pos = self._materialize(with_pos=True)[0]
-                self._pos_cache = np.asarray(pos)[: self.n_elems + 1]
+                self._materialize(with_pos=True)
+                self._scalars()  # verify the S bucket fit (re-runs if not)
+                self._pos_cache = np.asarray(
+                    self._mat[0])[: self.n_elems + 1]
             else:
                 self._pos_cache = self._positions_full()
         return self._pos_cache
@@ -406,9 +526,9 @@ class DeviceTextDoc(CausalDeviceDoc):
         if self.n_elems == 0:
             return ""
         if self.use_condensed:
-            out = self._materialize(with_pos=False)
-            codes, n_vis = out[-2], int(out[-1][0])
-            values = np.asarray(codes)[:n_vis]
+            self._materialize(with_pos=False)
+            n_vis = int(self._scalars()[0])   # may re-run w/ bigger S
+            values = np.asarray(self._mat[-2])[:n_vis]
             if values.dtype == np.uint8:
                 return values.tobytes().decode("ascii")
         else:

@@ -121,37 +121,128 @@ def expand_runs_dense(
     [base_slot, base_slot + n_run_elems). The element columns are computed
     densely in run-element space and written with dynamic_update_slice —
     contiguous stores instead of 9 scatters. Caller guarantees
-    base_slot + N <= out_cap (N = padded blob length)."""
+    base_slot + N <= out_cap (N = padded blob length).
+
+    GATHER-FREE: every column is piecewise affine over runs (constant, or
+    +1 per element), so instead of `table[run_of]` gathers — ~140M elem/s
+    on v5e, they dominated the merge at bench scale — each column is a
+    run-boundary delta scatter (R elements) + a shared prefix sum: one
+    (4, N) cumsum and a handful of R-sized ops, all at vector throughput.
+    Slots past n_run_elems inside the padded window receive run-tail
+    garbage exactly as before (they are beyond n_elems until a later round
+    dus-overwrites them)."""
     R = run_head_slot.shape[0]
     N = blob.shape[0]
 
-    ridx = jnp.arange(R, dtype=jnp.int32)
-    run_of = jnp.zeros(N, jnp.int32).at[run_elem_base].max(ridx, mode="drop")
-    run_of = jax.lax.cummax(run_of)
+    # per-run deltas against the previous run's final element value
+    run_len_prev = run_elem_base - jnp.concatenate(
+        [jnp.zeros(1, run_elem_base.dtype), run_elem_base[:-1]])
+    prev = lambda a: jnp.concatenate([jnp.zeros(1, a.dtype), a[:-1]])
+    first = jnp.arange(R, dtype=jnp.int32) == 0
+    # ctr column: +1 per element, resets to run_ctr0 at run starts
+    # (cum[eb_r] = cum[eb_r - 1] + d_ctr[r] must equal run_ctr0[r], with
+    # cum[eb_r - 1] = ctr0[r-1] + len_{r-1} - 1)
+    d_ctr = jnp.where(first, run_ctr0,
+                      run_ctr0 - (prev(run_ctr0) + run_len_prev - 1))
+    # piecewise-constant columns: value deltas at run starts
+    wa_v = jnp.where(run_has_value, run_win_actor, -1)
+    ws_v = jnp.where(run_has_value, run_win_seq, 0)
+    has_v = run_has_value.astype(jnp.int32)
+    d_actor = jnp.where(first, run_actor, run_actor - prev(run_actor))
+    d_wa = jnp.where(first, wa_v, wa_v - prev(wa_v))
+    d_ws = jnp.where(first, ws_v, ws_v - prev(ws_v))
+    d_has = jnp.where(first, has_v, has_v - prev(has_v))
+
+    # one boundary scatter per column family + one shared (5, N) prefix sum
+    # (padding runs have elem_base == N: OOB, dropped)
+    deltas = jnp.ones((5, N), jnp.int32)
+    deltas = deltas.at[1:].set(0)
+    deltas = deltas.at[:, run_elem_base].set(
+        jnp.stack([d_ctr, d_actor, d_wa, d_ws, d_has]), mode="drop")
+    cols = jnp.cumsum(deltas, axis=1)
 
     j = jnp.arange(N, dtype=jnp.int32)
-    off = j - run_elem_base[run_of]
-    slot = base_slot + j
-    parent_e = jnp.where(off == 0, run_parent_slot[run_of], slot - 1)
-    has = run_has_value[run_of] & (j < n_run_elems)
+    live = j < n_run_elems
+    is_start = jnp.zeros(N, bool).at[run_elem_base].set(True, mode="drop")
+    # parent: slot-1 everywhere except run heads (R-sized scatter)
+    parent_col = (base_slot - 1) + j
+    parent_col = parent_col.at[run_elem_base].set(
+        run_parent_slot, mode="drop")
+    has_col = (cols[4] > 0) & live
 
     def dus(table, col, fill):
         return jax.lax.dynamic_update_slice(
             _ext(table, fill, out_cap), col.astype(table.dtype), (base_slot,))
 
-    return (dus(parent, parent_e, 0),
-            dus(ctr, run_ctr0[run_of] + off, 0),
-            dus(actor, run_actor[run_of], 0),
+    return (dus(parent, parent_col, 0),
+            dus(ctr, cols[0], 0),
+            dus(actor, cols[1], 0),
             dus(value, blob, 0),
-            dus(has_value, has, False),
-            dus(win_actor, jnp.where(has, run_win_actor[run_of], -1), -1),
-            dus(win_seq, jnp.where(has, run_win_seq[run_of], 0), 0),
+            dus(has_value, has_col, False),
+            dus(win_actor, jnp.where(has_col, cols[2], -1), -1),
+            dus(win_seq, jnp.where(has_col, cols[3], 0), 0),
             dus(win_counter, jnp.zeros(N, bool), False),
-            dus(chain, (off > 0) & (j < n_run_elems), False))
+            dus(chain, live & ~is_start, False))
 
 
-@jax.jit
-def break_chains(chain, parent, ctr, actor, p_slots, h_ctr, h_actor):
+# Packed-descriptor row layout for expand_runs*_packed: one (8, R) int32
+# host->device transfer replaces eight separate array transfers (each costs
+# a tunnel/PCIe round trip of latency; on the remote-attached chip used for
+# benchmarking, per-transfer overhead dominates the payload).
+DESC_HEAD_SLOT, DESC_PARENT_SLOT, DESC_CTR0, DESC_ACTOR, DESC_WIN_ACTOR, \
+    DESC_WIN_SEQ, DESC_ELEM_BASE, DESC_HAS_VALUE = range(8)
+
+# Residual-op packed layout for apply_residual_packed: one (8, M) int32.
+RES_KIND, RES_SLOT, RES_NEW_SLOT, RES_CTR, RES_ACTOR, RES_VALUE, \
+    RES_WIN_ACTOR, RES_WIN_SEQ = range(8)
+
+
+def _unpack_desc(desc):
+    return (desc[DESC_HEAD_SLOT], desc[DESC_PARENT_SLOT], desc[DESC_CTR0],
+            desc[DESC_ACTOR], desc[DESC_WIN_ACTOR], desc[DESC_WIN_SEQ],
+            desc[DESC_ELEM_BASE], desc[DESC_HAS_VALUE].astype(bool))
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def expand_runs_packed(
+    parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
+    chain, desc, blob, n_run_elems, *, out_cap: int,
+):
+    """`expand_runs` taking the run descriptors as one packed (8, R) int32
+    matrix (row layout: DESC_*). Single h2d transfer + single dispatch."""
+    return expand_runs(
+        parent, ctr, actor, value, has_value, win_actor, win_seq,
+        win_counter, chain, *_unpack_desc(desc), blob, n_run_elems,
+        out_cap=out_cap)
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def expand_runs_dense_packed(
+    parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
+    chain, desc, blob, n_run_elems, base_slot, n_runs, *, out_cap: int,
+):
+    """`expand_runs_dense` + fused `break_chains`, packed descriptors.
+
+    The dense path's chain breaks touch exactly the run heads' parents,
+    whose (slot, ctr, actor) already sit in the descriptor matrix — so the
+    whole common-case merge round is ONE descriptor transfer, ONE value-blob
+    transfer, and ONE device program."""
+    (head_slot, parent_slot, ctr0, ractor, rwa, rws, elem_base,
+     has) = _unpack_desc(desc)
+    tables = expand_runs_dense(
+        parent, ctr, actor, value, has_value, win_actor, win_seq,
+        win_counter, chain, head_slot, parent_slot, ctr0, ractor, rwa, rws,
+        elem_base, has, blob, n_run_elems, base_slot, out_cap=out_cap)
+    R = desc.shape[1]
+    live = jnp.arange(R, dtype=jnp.int32) < n_runs
+    chain_n = _break_chains_core(
+        tables[8], tables[0], tables[1], tables[2],
+        jnp.where(live, parent_slot, 0), jnp.where(live, ctr0, -1),
+        jnp.where(live, ractor, -1))
+    return tables[:8] + (chain_n,)
+
+
+def _break_chains_core(chain, parent, ctr, actor, p_slots, h_ctr, h_actor):
     """Clear the chain bit of slot p+1 for every touched parent p whose new
     child Lamport-exceeds (ctr, actor) of p+1.
 
@@ -166,6 +257,32 @@ def break_chains(chain, parent, ctr, actor, p_slots, h_ctr, h_actor):
     aq = actor[q]
     brk = (p_slots >= 1) & ((h_ctr > cq) | ((h_ctr == cq) & (h_actor > aq)))
     return chain.at[jnp.where(brk, q, C)].set(False, mode="drop")
+
+
+break_chains = jax.jit(_break_chains_core)
+
+
+@jax.jit
+def break_chains_packed(chain, parent, ctr, actor, touch):
+    """`break_chains` with the (p_slot, ctr, actor) touch rows packed as one
+    (3, T) int32 transfer."""
+    return _break_chains_core(chain, parent, ctr, actor,
+                              touch[0], touch[1], touch[2])
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def apply_residual_packed(
+    parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
+    chain, res, conflict_slots, *, out_cap: int,
+):
+    """`apply_residual` taking the residual op columns as one packed
+    (8, M) int32 matrix (row layout: RES_*)."""
+    return apply_residual(
+        parent, ctr, actor, value, has_value, win_actor, win_seq,
+        win_counter, chain,
+        res[RES_KIND].astype(jnp.int8), res[RES_SLOT], res[RES_NEW_SLOT],
+        res[RES_CTR], res[RES_ACTOR], res[RES_VALUE], res[RES_WIN_ACTOR],
+        res[RES_WIN_SEQ], conflict_slots, out_cap=out_cap)
 
 
 @partial(jax.jit, static_argnames=("out_cap",))
@@ -363,7 +480,11 @@ def _materialize_core(parent, ctr, actor, value, has_value, chain, n_elems,
     idx = jnp.arange(C, dtype=jnp.int32)
     is_elem = (idx >= 1) & (idx <= n_elems)
     seg_start = is_elem & ~chain
-    rank_incl = jnp.cumsum(seg_start.astype(jnp.int32))  # node id per slot
+    vis = has_value & is_elem
+    # one fused (2, C) prefix sum: segment ranks + inclusive visible counts
+    two = jnp.cumsum(jnp.stack([seg_start.astype(jnp.int32),
+                                vis.astype(jnp.int32)]), axis=1)
+    rank_incl, cumvis = two[0], two[1]                   # node id per slot
     seg_head = jax.lax.cummax(jnp.where(seg_start, idx, 0))
     offset = idx - seg_head
     n_segs = rank_incl[-1]
@@ -389,8 +510,6 @@ def _materialize_core(parent, ctr, actor, value, has_value, chain, n_elems,
 
     # visible ranking, segment-space: rank = (visible in segments placed
     # earlier) + (visible before me inside my segment)
-    vis = has_value & is_elem
-    cumvis = jnp.cumsum(vis.astype(jnp.int32))           # inclusive
     n_vis = cumvis[C - 1]
     head_pre = cumvis[heads] - vis[heads].astype(jnp.int32)
     last = jnp.clip(next_head - 1, 0, C - 1)
@@ -403,7 +522,27 @@ def _materialize_core(parent, ctr, actor, value, has_value, chain, n_elems,
     base_perm = jnp.cumsum(sv_perm) - sv_perm            # exclusive, by pos
     rank_base = jnp.zeros(S, jnp.int32).at[perm].set(base_perm)
     seg_base = rank_base - head_pre                      # one combined table
-    vis_rank = seg_base[rank_incl] + cumvis - vis.astype(jnp.int32)
+
+    # expand S-space tables to slot space GATHER-FREE: `rank_incl` is
+    # non-decreasing, so table[rank_incl] is piecewise constant with jumps
+    # at segment heads — scatter per-segment deltas at head slots (S-sized)
+    # and prefix-sum, instead of a C-sized gather (~140M elem/s on v5e vs
+    # vector-rate cumsum). Segment k covers slots [heads[k], heads[k+1]);
+    # slots before heads[1] (the head slot 0) read 0, and are never visible.
+    def expand_S(table):
+        prev = jnp.concatenate([jnp.zeros(1, table.dtype), table[:-1]])
+        d = jnp.where(sidx == 1, table, table - prev)
+        tgt = jnp.where(live_seg, heads, C)
+        return jnp.zeros(C, table.dtype).at[tgt].set(d, mode="drop")
+
+    if with_pos:
+        d2 = jnp.stack([expand_S(seg_base), expand_S(starts)])
+        exp = jnp.cumsum(d2, axis=1)
+        sb_exp, starts_exp = exp[0], exp[1]
+    else:
+        sb_exp = jnp.cumsum(expand_S(seg_base))
+        starts_exp = None
+    vis_rank = sb_exp + cumvis - vis.astype(jnp.int32)
 
     if as_u8:
         # known-7-bit documents scatter 1-byte codes: 4x less HBM traffic
@@ -417,7 +556,7 @@ def _materialize_core(parent, ctr, actor, value, has_value, chain, n_elems,
     scalars = jnp.stack([n_vis, n_segs])   # one packed scalar fetch
 
     if with_pos:
-        pos = jnp.where(is_elem, starts[rank_incl] + offset,
+        pos = jnp.where(is_elem, starts_exp + offset,
                         jnp.where(idx == 0, -1, C + 1))
         return pos, codes, scalars
     return codes, scalars

@@ -1,0 +1,126 @@
+"""Two-phase ingestion (prepare_batch / commit_prepared): the pipelining
+seam the headline bench times. Equivalence with apply_batch is the contract:
+same changes, same final document, regardless of phase split."""
+
+import numpy as np
+import pytest
+
+from automerge_tpu.engine import DeviceTextDoc, TextChangeBatch
+
+
+def typing_change(actor, seq, deps, text, start_ctr, parent):
+    """A change typing `text` as one run after `parent` ('_head' or elemId)."""
+    ops = []
+    for i, ch in enumerate(text):
+        ctr = start_ctr + i
+        key = "_head" if (i == 0 and parent == "_head") else (
+            parent if i == 0 else f"{actor}:{ctr - 1}")
+        ops.append({"action": "ins", "obj": "t", "key": key, "elem": ctr})
+        ops.append({"action": "set", "obj": "t", "key": f"{actor}:{ctr}",
+                    "value": ch})
+    return {"actor": actor, "seq": seq, "deps": deps, "ops": ops}
+
+
+def build_batch(changes):
+    return TextChangeBatch.from_changes(changes, "t")
+
+
+def seed_doc():
+    doc = DeviceTextDoc("t")
+    doc.apply_changes([typing_change("base", 1, {}, "hello world", 1, "_head")])
+    return doc
+
+
+CONCURRENT = [
+    typing_change("alice", 1, {"base": 1}, "AAA", 100, "base:5"),
+    typing_change("bob", 1, {"base": 1}, "BB", 100, "base:5"),
+    # a residual-heavy change: delete + overwrite (no runs)
+    {"actor": "carol", "seq": 1, "deps": {"base": 1}, "ops": [
+        {"action": "del", "obj": "t", "key": "base:1"},
+        {"action": "set", "obj": "t", "key": "base:2", "value": "X"},
+    ]},
+]
+
+
+def test_prepare_commit_matches_apply():
+    direct = seed_doc().apply_batch(build_batch(CONCURRENT))
+    two_phase = seed_doc()
+    prepared = two_phase.prepare_batch(build_batch(CONCURRENT))
+    assert prepared.n_staged_bytes > 0
+    two_phase.commit_prepared(prepared)
+    assert two_phase.text() == direct.text()
+    assert two_phase.elem_ids() == direct.elem_ids()
+    assert two_phase.clock == direct.clock
+
+
+def test_prepare_commit_multi_round():
+    """seq-2 changes depending on seq-1 changes in the same batch force
+    multiple causal rounds; planning threads shadow state through them."""
+    changes = [
+        typing_change("alice", 1, {"base": 1}, "AA", 100, "base:5"),
+        typing_change("alice", 2, {}, "CC", 200, "alice:101"),
+        typing_change("bob", 1, {"alice": 1, "base": 1}, "B", 300, "alice:100"),
+    ]
+    direct = seed_doc().apply_batch(build_batch(changes))
+    two_phase = seed_doc()
+    prepared = two_phase.prepare_batch(build_batch(changes))
+    assert len(prepared.rounds) >= 2
+    two_phase.commit_prepared(prepared)
+    assert two_phase.text() == direct.text()
+    assert two_phase.elem_ids() == direct.elem_ids()
+
+
+def test_prepare_commit_with_queued_unready():
+    """Changes whose deps are missing stay queued across the phases."""
+    doc = seed_doc()
+    future = typing_change("dave", 2, {}, "Z", 400, "dave:399")
+    doc.apply_batch(build_batch([future]))  # unready: queued
+    assert doc.queue
+    prepared = doc.prepare_batch(build_batch(CONCURRENT))
+    doc.commit_prepared(prepared)
+    assert doc.queue  # still waiting on dave seq 1
+    direct = seed_doc()
+    direct.apply_batch(build_batch([future]))
+    direct.apply_batch(build_batch(CONCURRENT))
+    assert doc.text() == direct.text()
+
+
+def test_commit_rejects_stale_plan():
+    doc = seed_doc()
+    prepared = doc.prepare_batch(build_batch(CONCURRENT))
+    doc.apply_changes([typing_change("eve", 1, {"base": 1}, "!", 500,
+                                     "base:11")])
+    with pytest.raises(ValueError, match="re-prepare"):
+        doc.commit_prepared(prepared)
+
+
+def test_prepare_does_not_mutate_content():
+    doc = seed_doc()
+    before = doc.text()
+    n_elems = doc.n_elems
+    clock = dict(doc.clock)
+    doc.prepare_batch(build_batch(CONCURRENT))
+    assert doc.text() == before
+    assert doc.n_elems == n_elems
+    assert doc.clock == clock
+
+
+def test_prepare_rejects_invalid_batch_without_damage():
+    doc = seed_doc()
+    bad = build_batch([
+        typing_change("alice", 1, {"base": 1}, "A", 100, "base:999")])
+    with pytest.raises(ValueError, match="unknown parent"):
+        doc.prepare_batch(bad)
+    # document unharmed, further ingestion fine
+    doc.apply_batch(build_batch(CONCURRENT))
+
+
+def test_duplicate_delivery_through_prepare():
+    """Re-preparing an already-applied batch admits nothing (idempotent)."""
+    doc = seed_doc()
+    doc.apply_batch(build_batch(CONCURRENT))
+    text = doc.text()
+    prepared = doc.prepare_batch(build_batch(CONCURRENT))
+    assert all(p is None for _, _, _, p in prepared.rounds)
+    doc.commit_prepared(prepared)
+    assert doc.text() == text
