@@ -57,21 +57,21 @@ class _RoundExec:
     out_cap: int
     dense: bool
     n_runs: int
-    n_pairs: int
     n_res: int
-    base_slot: int
-    desc: Any                 # staged (8, R) int32 device matrix (or None)
+    desc: Any                 # staged (9, R) int32 device matrix (or None)
     blob: Any                 # staged value blob (uint8/int32, or None)
     res: Any                  # staged (8, M) int32 residual matrix (or None)
     touch: Any                # staged (3, T) chain-touch matrix (or None)
     ascii_clear: bool
     res_host: Optional[tuple]  # (kind, val64, actor_rank, seq) per residual
     seg_inc: int
+    n_elems_dev: Any = None   # staged device mirror of n_elems_after
 
     @property
     def staged(self) -> list:
         """The round's device buffers (for transfer-completion barriers)."""
-        return [x for x in (self.desc, self.blob, self.res, self.touch)
+        return [x for x in (self.desc, self.blob, self.res, self.touch,
+                            self.n_elems_dev)
                 if x is not None]
 
 
@@ -102,6 +102,7 @@ class DeviceTextDoc(CausalDeviceDoc):
         self._mat = None                      # materialization cache (device)
         self._mat_S = 0                       # S the cached kernel ran with
         self._scal = None                     # fetched [n_vis, n_segs]
+        self._n_elems_dev = None              # (count, device scalar) mirror
         self._pos_cache = None
 
     # ------------------------------------------------------------------
@@ -269,7 +270,7 @@ class DeviceTextDoc(CausalDeviceDoc):
         # --- all validity checks passed: stage packed device inputs. Each
         # host->device transfer pays per-transfer latency (PCIe round trip;
         # ~10^2 ms through the benchmarking tunnel), so the round ships at
-        # most three buffers: one (8,R) descriptor matrix, one value blob,
+        # most three buffers: one (9,R) descriptor matrix, one value blob,
         # and one (8,M) residual matrix ---
         dense = n_runs > 0 and n_res_ins == 0  # new slots form one window
         N = bucket(n_pairs, 256) if n_runs else 0
@@ -279,8 +280,10 @@ class DeviceTextDoc(CausalDeviceDoc):
         desc_dev = blob_dev = None
         ascii_clear = False
         if n_runs:
+            from ..ops.ingest import (DESC_META, META_BASE_SLOT,
+                                      META_N_ELEMS, META_N_RUNS)
             R = bucket(n_runs, 64)
-            desc = np.zeros((8, R), np.int32)
+            desc = np.zeros((9, R), np.int32)
             desc[DESC_ELEM_BASE] = N              # padding sentinel
             desc[DESC_HEAD_SLOT, :n_runs] = plan.head_slot
             desc[DESC_PARENT_SLOT, :n_runs] = run_parent_slot
@@ -290,6 +293,9 @@ class DeviceTextDoc(CausalDeviceDoc):
             desc[DESC_WIN_SEQ, :n_runs] = row_seq[op_row[hpos]]
             desc[DESC_ELEM_BASE, :n_runs] = np.cumsum(run_len) - run_len
             desc[DESC_HAS_VALUE, :n_runs] = 1
+            desc[DESC_META, META_N_ELEMS] = n_pairs
+            desc[DESC_META, META_BASE_SLOT] = base_elems + 1
+            desc[DESC_META, META_N_RUNS] = n_runs
             if not plan.blob_lt_128:
                 ascii_clear = True
             blob = np.zeros(N, np.uint8 if plan.blob_lt_256 else np.int32)
@@ -355,11 +361,12 @@ class DeviceTextDoc(CausalDeviceDoc):
 
         exec_plan = _RoundExec(
             index_after=merged_index, n_elems_after=base_elems + n_ins,
-            out_cap=out_cap, dense=dense, n_runs=n_runs, n_pairs=n_pairs,
-            n_res=n_res, base_slot=base_elems + 1, desc=desc_dev,
+            out_cap=out_cap, dense=dense, n_runs=n_runs,
+            n_res=n_res, desc=desc_dev,
             blob=blob_dev, res=res_dev, touch=touch_dev,
             ascii_clear=ascii_clear, res_host=res_host,
-            seg_inc=3 * (n_runs + n_res_ins) + 2)
+            seg_inc=3 * (n_runs + n_res_ins) + 2,
+            n_elems_dev=jnp.asarray(np.int32(base_elems + n_ins)))
         return exec_plan, (base_elems + n_ins, merged_index, out_cap)
 
     def _execute_plan(self, b: TextChangeBatch, plan: "_RoundExec"):
@@ -378,13 +385,10 @@ class DeviceTextDoc(CausalDeviceDoc):
         if plan.n_runs:
             if plan.dense:
                 tables = expand_runs_dense_packed(
-                    *tables, plan.desc, plan.blob, np.int32(plan.n_pairs),
-                    np.int32(plan.base_slot), np.int32(plan.n_runs),
-                    out_cap=out_cap)
+                    *tables, plan.desc, plan.blob, out_cap=out_cap)
             else:
                 tables = expand_runs_packed(
-                    *tables, plan.desc, plan.blob, np.int32(plan.n_pairs),
-                    out_cap=out_cap)
+                    *tables, plan.desc, plan.blob, out_cap=out_cap)
 
         slow_info_np = None
         if plan.n_res:
@@ -410,6 +414,9 @@ class DeviceTextDoc(CausalDeviceDoc):
         self._dev = dict(zip(self._TABLE_KEYS, tables))
         self._cap = out_cap
         self.n_elems = plan.n_elems_after
+        # staged device mirror of the element count: materialize dispatches
+        # with it instead of uploading a fresh host scalar
+        self._n_elems_dev = (plan.n_elems_after, plan.n_elems_dev)
         if plan.ascii_clear:
             self.all_ascii = False
         # every inserted run/element can split at most one existing segment
@@ -447,12 +454,22 @@ class DeviceTextDoc(CausalDeviceDoc):
         return self._mat
 
     def _run_materialize(self, with_pos: bool, S: int):
-        from ..ops.ingest import materialize_codes, materialize_text
+        from ..ops.ingest import bucket, materialize_codes, materialize_text
         dev = self._ensure_dev()
         fn = materialize_text if with_pos else materialize_codes
+        # the kernel slices the columns to the live-window bucket: capacity
+        # can exceed the live prefix by up to 50% and every pass scales
+        # with operand length
+        L = min(bucket(self.n_elems + 2), self._cap)
+        # use the staged device mirror of n_elems when current (avoids a
+        # commit-path host->device scalar upload)
+        if self._n_elems_dev and self._n_elems_dev[0] == self.n_elems:
+            n = self._n_elems_dev[1]
+        else:
+            n = np.int32(self.n_elems)
         return fn(dev["parent"], dev["ctr"], dev["actor"], dev["value"],
-                  dev["has_value"], dev["chain"], np.int32(self.n_elems),
-                  S=S, as_u8=self.all_ascii)
+                  dev["has_value"], dev["chain"], n,
+                  S=S, as_u8=self.all_ascii, L=L)
 
     def _scalars(self) -> np.ndarray:
         """Fetch [n_vis, n_segs] of the cached materialization (the one

@@ -127,7 +127,7 @@ def expand_runs_dense(
     +1 per element), so instead of `table[run_of]` gathers — ~140M elem/s
     on v5e, they dominated the merge at bench scale — each column is a
     run-boundary delta scatter (R elements) + a shared prefix sum: one
-    (4, N) cumsum and a handful of R-sized ops, all at vector throughput.
+    (5, N) cumsum and a handful of R-sized ops, all at vector throughput.
     Slots past n_run_elems inside the padded window receive run-tail
     garbage exactly as before (they are beyond n_elems until a later round
     dus-overwrites them)."""
@@ -185,12 +185,15 @@ def expand_runs_dense(
             dus(chain, live & ~is_start, False))
 
 
-# Packed-descriptor row layout for expand_runs*_packed: one (8, R) int32
+# Packed-descriptor row layout for expand_runs*_packed: one (9, R) int32
 # host->device transfer replaces eight separate array transfers (each costs
 # a tunnel/PCIe round trip of latency; on the remote-attached chip used for
-# benchmarking, per-transfer overhead dominates the payload).
+# benchmarking, per-transfer overhead dominates the payload). The META row
+# carries the round's scalars ([n_run_elems, base_slot, n_runs], rest 0) so
+# commit-time dispatch uploads NOTHING host->device.
 DESC_HEAD_SLOT, DESC_PARENT_SLOT, DESC_CTR0, DESC_ACTOR, DESC_WIN_ACTOR, \
-    DESC_WIN_SEQ, DESC_ELEM_BASE, DESC_HAS_VALUE = range(8)
+    DESC_WIN_SEQ, DESC_ELEM_BASE, DESC_HAS_VALUE, DESC_META = range(9)
+META_N_ELEMS, META_BASE_SLOT, META_N_RUNS = range(3)
 
 # Residual-op packed layout for apply_residual_packed: one (8, M) int32.
 RES_KIND, RES_SLOT, RES_NEW_SLOT, RES_CTR, RES_ACTOR, RES_VALUE, \
@@ -206,20 +209,21 @@ def _unpack_desc(desc):
 @partial(jax.jit, static_argnames=("out_cap",))
 def expand_runs_packed(
     parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
-    chain, desc, blob, n_run_elems, *, out_cap: int,
+    chain, desc, blob, *, out_cap: int,
 ):
-    """`expand_runs` taking the run descriptors as one packed (8, R) int32
-    matrix (row layout: DESC_*). Single h2d transfer + single dispatch."""
+    """`expand_runs` taking the run descriptors as one packed (9, R) int32
+    matrix (row layout: DESC_*, scalars in the META row). Single h2d
+    transfer + single dispatch, no commit-time scalar uploads."""
     return expand_runs(
         parent, ctr, actor, value, has_value, win_actor, win_seq,
-        win_counter, chain, *_unpack_desc(desc), blob, n_run_elems,
-        out_cap=out_cap)
+        win_counter, chain, *_unpack_desc(desc), blob,
+        desc[DESC_META, META_N_ELEMS], out_cap=out_cap)
 
 
 @partial(jax.jit, static_argnames=("out_cap",))
 def expand_runs_dense_packed(
     parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
-    chain, desc, blob, n_run_elems, base_slot, n_runs, *, out_cap: int,
+    chain, desc, blob, *, out_cap: int,
 ):
     """`expand_runs_dense` + fused `break_chains`, packed descriptors.
 
@@ -229,6 +233,9 @@ def expand_runs_dense_packed(
     transfer, and ONE device program."""
     (head_slot, parent_slot, ctr0, ractor, rwa, rws, elem_base,
      has) = _unpack_desc(desc)
+    n_run_elems = desc[DESC_META, META_N_ELEMS]
+    base_slot = desc[DESC_META, META_BASE_SLOT]
+    n_runs = desc[DESC_META, META_N_RUNS]
     tables = expand_runs_dense(
         parent, ctr, actor, value, has_value, win_actor, win_seq,
         win_counter, chain, head_slot, parent_slot, ctr0, ractor, rwa, rws,
@@ -485,16 +492,18 @@ def _materialize_core(parent, ctr, actor, value, has_value, chain, n_elems,
     two = jnp.cumsum(jnp.stack([seg_start.astype(jnp.int32),
                                 vis.astype(jnp.int32)]), axis=1)
     rank_incl, cumvis = two[0], two[1]                   # node id per slot
-    seg_head = jax.lax.cummax(jnp.where(seg_start, idx, 0))
-    offset = idx - seg_head
     n_segs = rank_incl[-1]
 
-    heads = jnp.zeros(S, jnp.int32).at[
-        jnp.where(seg_start, rank_incl, S)].set(idx, mode="drop")
+    # head slot of segment k: rank_incl is non-decreasing and jumps to k at
+    # the k-th segment start, so a binary search replaces the C-sized
+    # scatter (scatter cost is per-INDEX: ~190M/s over all C slots on v5e;
+    # this is S*log C gathers)
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    heads = jnp.searchsorted(rank_incl, sidx, side="left").astype(jnp.int32)
+    heads = jnp.clip(heads, 0, C - 1)
 
     # segment ranks are assigned in slot order, so heads is sorted by slot
     # and each segment's size is the gap to the next head
-    sidx = jnp.arange(S, dtype=jnp.int32)
     valid = sidx <= n_segs
     live_seg = valid & (sidx >= 1)
     next_head = jnp.where((sidx + 1 <= n_segs) & (sidx + 1 < S),
@@ -502,7 +511,9 @@ def _materialize_core(parent, ctr, actor, value, has_value, chain, n_elems,
 
     p_slot = parent[heads]
     node_parent = rank_incl[p_slot]
-    attach = offset[p_slot]
+    # attach offset of a parent slot inside its own segment, S-sized:
+    # seg_head[p] == heads[rank_incl[p]]
+    attach = p_slot - heads[jnp.clip(node_parent, 0, S - 1)]
     nctr = ctr[heads]
     nactor = actor[heads]
     weight = jnp.where(live_seg, next_head - heads, 0)
@@ -536,12 +547,13 @@ def _materialize_core(parent, ctr, actor, value, has_value, chain, n_elems,
         return jnp.zeros(C, table.dtype).at[tgt].set(d, mode="drop")
 
     if with_pos:
-        d2 = jnp.stack([expand_S(seg_base), expand_S(starts)])
-        exp = jnp.cumsum(d2, axis=1)
-        sb_exp, starts_exp = exp[0], exp[1]
+        d3 = jnp.stack([expand_S(seg_base), expand_S(starts),
+                        expand_S(heads)])
+        exp = jnp.cumsum(d3, axis=1)
+        sb_exp, starts_exp, seg_head_exp = exp[0], exp[1], exp[2]
     else:
         sb_exp = jnp.cumsum(expand_S(seg_base))
-        starts_exp = None
+        starts_exp = seg_head_exp = None
     vis_rank = sb_exp + cumvis - vis.astype(jnp.int32)
 
     if as_u8:
@@ -556,30 +568,39 @@ def _materialize_core(parent, ctr, actor, value, has_value, chain, n_elems,
     scalars = jnp.stack([n_vis, n_segs])   # one packed scalar fetch
 
     if with_pos:
-        pos = jnp.where(is_elem, starts_exp + offset,
+        pos = jnp.where(is_elem, starts_exp + (idx - seg_head_exp),
                         jnp.where(idx == 0, -1, C + 1))
         return pos, codes, scalars
     return codes, scalars
 
 
-@partial(jax.jit, static_argnames=("S", "as_u8"))
+def _slice_live(cols, L):
+    """Restrict the element columns to the live-window bucket `L` (static):
+    table capacity can exceed the live prefix by up to 50%, and every pass
+    in the materialize kernel scales with operand length."""
+    if L is None or L >= cols[0].shape[0]:
+        return cols
+    return tuple(c[:L] for c in cols)
+
+
+@partial(jax.jit, static_argnames=("S", "as_u8", "L"))
 def materialize_text(parent, ctr, actor, value, has_value, chain, n_elems,
-                     *, S: int, as_u8: bool = False):
+                     *, S: int, as_u8: bool = False, L: int = None):
     """Full materialization: (pos, codes, [n_vis, n_segs]). `pos` includes
     tombstones (head = -1, padding > n); `codes` is visible values scattered
     into list order (uint8 when `as_u8` — the host tracks 7-bit-ness). The
     host retries with a bigger S when n_segs+1 > S."""
-    return _materialize_core(parent, ctr, actor, value, has_value, chain,
-                             n_elems, S, with_pos=True, as_u8=as_u8)
+    cols = _slice_live((parent, ctr, actor, value, has_value, chain), L)
+    return _materialize_core(*cols, n_elems, S, with_pos=True, as_u8=as_u8)
 
 
-@partial(jax.jit, static_argnames=("S", "as_u8"))
+@partial(jax.jit, static_argnames=("S", "as_u8", "L"))
 def materialize_codes(parent, ctr, actor, value, has_value, chain, n_elems,
-                      *, S: int, as_u8: bool = False):
+                      *, S: int, as_u8: bool = False, L: int = None):
     """Codes-only materialization for `text()`: skips the per-element
     position gather."""
-    return _materialize_core(parent, ctr, actor, value, has_value, chain,
-                             n_elems, S, with_pos=False, as_u8=as_u8)
+    cols = _slice_live((parent, ctr, actor, value, has_value, chain), L)
+    return _materialize_core(*cols, n_elems, S, with_pos=False, as_u8=as_u8)
 
 
 @jax.jit

@@ -1,47 +1,94 @@
-"""Stage-by-stage timing of the headline bench (not part of the suite)."""
-import os, time
+"""Stage + per-kernel profiling of the headline bench (not part of the suite).
+
+Two modes:
+  python profile_bench.py          # wall timers per stage
+  python profile_bench.py --trace  # jax.profiler device trace -> top ops
+
+NOTE (docs/PROFILE_r3.md): on this runtime `block_until_ready` is lazy —
+only a data fetch (np.asarray) reliably flushes and waits, so stage wall
+times attribute all pending device work to the stage containing the fetch.
+Per-kernel truth comes from the --trace mode.
+"""
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
 os.makedirs(".jax_cache", exist_ok=True)
-import jax
+import jax  # noqa: E402
+
 jax.config.update("jax_compilation_cache_dir", ".jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-import numpy as np
-from bench import BASE_LEN, N_ACTORS, OPS_PER_CHANGE, base_batch, merge_batch, run_once
-from automerge_tpu.engine import DeviceTextDoc
+
+from bench import (BASE_LEN, N_ACTORS, OPS_PER_CHANGE, base_batch,  # noqa: E402
+                   merge_batch, run_once)
+from automerge_tpu.engine import DeviceTextDoc  # noqa: E402
 
 t = time.perf_counter
-def lap(msg, t0):
-    t1 = t(); print(f"{msg}: {t1-t0:.3f}s", flush=True); return t1
 
-batch = merge_batch("bench-text", N_ACTORS, OPS_PER_CHANGE, BASE_LEN)
-run_once(batch)  # warm compiles
 
-t0 = t()
-doc = DeviceTextDoc("bench-text")
-doc.apply_batch(base_batch("bench-text", BASE_LEN))
-doc.text()
-t0 = lap("base build+text (warm)", t0)
+def build():
+    doc = DeviceTextDoc("bench-text")
+    doc.apply_batch(base_batch("bench-text", BASE_LEN))
+    doc.text()
+    return doc
 
-# instrument second pass manually
-import automerge_tpu.engine.text_doc as td
 
-orig_ingest = td.DeviceTextDoc._ingest
-orig_mat = td.DeviceTextDoc._materialize
+def stage_timers(batch):
+    doc = build()
+    t0 = t()
+    prepared = doc.prepare_batch(batch)
+    t1 = t()
+    print(f"prepare (host plan + h2d staging): {(t1-t0)*1e3:8.1f} ms "
+          f"({prepared.n_staged_bytes/1e6:.1f} MB staged)")
+    doc.commit_prepared(prepared)
+    t2 = t()
+    print(f"commit dispatch (bookkeeping+enqueue): {(t2-t1)*1e3:6.1f} ms")
+    doc._materialize(with_pos=False)
+    t3 = t()
+    print(f"materialize dispatch: {(t3-t2)*1e3:23.1f} ms")
+    scal = doc._scalars()
+    t4 = t()
+    print(f"scalar fetch (flush+exec+sync): {(t4-t3)*1e3:13.1f} ms")
+    print(f"TIMED REGION (commit..sync): {(t4-t1)*1e3:16.1f} ms")
+    text = doc.text()
+    t5 = t()
+    print(f"text() d2h pull + decode (untimed): {(t5-t4)*1e3:9.1f} ms")
+    assert len(text) == int(scal[0])
 
-def timed_ingest(self, b, mask):
-    t0 = t(); r = orig_ingest(self, b, mask)
-    print(f"  _ingest: {t()-t0:.3f}s", flush=True); return r
 
-def timed_mat(self, with_pos=True):
-    t0 = t(); r = orig_mat(self, with_pos)
-    if t()-t0 > 0.01: print(f"  _materialize: {t()-t0:.3f}s", flush=True)
-    return r
+def device_trace(batch):
+    doc = build()
+    prepared = doc.prepare_batch(batch)
+    os.system("rm -rf /tmp/jxtrace")
+    jax.profiler.start_trace("/tmp/jxtrace")
+    t0 = t()
+    doc.commit_prepared(prepared)
+    doc._materialize(with_pos=False)
+    scal = doc._scalars()
+    dt = t() - t0
+    jax.profiler.stop_trace()
+    print(f"timed region: {dt*1e3:.1f} ms, n_vis={int(scal[0])}")
+    for f in glob.glob("/tmp/jxtrace/**/*.trace.json.gz", recursive=True):
+        with gzip.open(f, "rt") as fh:
+            data = json.load(fh)
+        events = data.get("traceEvents", [])
+        pids = {e["pid"]: e["args"].get("name", "") for e in events
+                if e.get("ph") == "M" and e.get("name") == "process_name"}
+        by_name: dict = {}
+        for e in events:
+            if e.get("ph") == "X" and "TPU" in pids.get(e.get("pid"), ""):
+                by_name[e["name"]] = by_name.get(e["name"], 0) + e["dur"]
+        for name, dur in sorted(by_name.items(), key=lambda kv: -kv[1])[:20]:
+            print(f"{dur/1e3:10.2f} ms  {name[:90]}")
 
-td.DeviceTextDoc._ingest = timed_ingest
-td.DeviceTextDoc._materialize = timed_mat
 
-t0 = t()
-doc.apply_batch(batch)
-t0 = lap("apply_batch total", t0)
-text = doc.text()
-t0 = lap("text() total", t0)
-print("len", len(text))
+if __name__ == "__main__":
+    batch = merge_batch("bench-text", N_ACTORS, OPS_PER_CHANGE, BASE_LEN)
+    run_once(batch)  # warm compiles
+    if "--trace" in sys.argv:
+        device_trace(batch)
+    else:
+        stage_timers(batch)
