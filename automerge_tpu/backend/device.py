@@ -7,16 +7,21 @@ oracle backend (the reference's backend-injection seam,
 
 Scope and strategy — device-first with graduation:
 
-- **Flat documents ride the device.** A root map (``DeviceMapDoc`` registers)
-  plus any number of text/list objects (``DeviceTextDoc`` columnar element
-  tables) created by ``makeText``/``makeList`` and linked into root keys.
-  That covers the reference's hot workloads (text editing, map/counter
-  registers) with batched device merges.
-- **Everything else graduates.** The first change (or undo/redo request)
-  outside that shape — nested maps/tables, links below the root, ops on
-  unknown objects — replays the delivery log into the oracle backend
-  (``facade.py``) and hands the lineage over. Semantics are identical either
-  way; graduation is a performance cliff, not a behavior change.
+- **Arbitrary document trees ride the device.** The root map and every
+  ``makeMap``/``makeTable`` object are ``DeviceMapDoc`` register tables;
+  every ``makeText``/``makeList`` object is a ``DeviceTextDoc`` columnar
+  element table; ``link`` ops store interned child-object references in the
+  owning object's registers (map keys or list elements), mirroring the
+  reference's uniform link handling (/root/reference/backend/op_set.js:196-258).
+  Paths resolve host-side by walking winning link values from the root.
+- **Only undo/redo (and unknown op shapes) graduate.** Undo needs the
+  oracle's inverse-op synthesis, so such a request replays the delivery log
+  into the oracle backend (``facade.py``) and hands the lineage over.
+  Semantics are identical either way; graduation is a performance cliff,
+  not a behavior change — and it is SURFACED: each graduation logs via
+  ``logging.getLogger("automerge_tpu.backend.device")`` and increments the
+  module-level ``GRADUATION_STATS`` counters so users can tell which tier
+  served them.
 
 Patches are **net diffs**: instead of the reference's per-op incremental diff
 emission (skip-list order statistics per op, op_set.js:144-171), the device
@@ -34,6 +39,7 @@ deterministic replay of the delivery log.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import numpy as np
@@ -42,39 +48,55 @@ from .._common import ROOT_ID, make_elem_id, transitive_deps
 from . import facade as _oracle
 from .facade import BackendState as _OracleState
 
-_FLAT_MAKES = ("makeText", "makeList")
-_MAKES = ("makeMap", "makeList", "makeText", "makeTable")
+logger = logging.getLogger("automerge_tpu.backend.device")
+
+# obj kinds minted by each make action (reference op_set.js applyMake :63-82)
+_MAKE_KIND = {"makeMap": "map", "makeTable": "table",
+              "makeText": "text", "makeList": "list"}
+_MAKES = tuple(_MAKE_KIND)
+
+#: How often (and why) lineages left the device tier. Keys: reason strings
+#: ("undo_redo", "out_of_scope"). Reset-able by tests; documented in
+#: docs/INTERNALS.md (graduation contract).
+GRADUATION_STATS: dict = {}
 
 
-def _in_scope(changes, known) -> bool:
-    """True iff every op stays within the flat-document device shape, given
-    the text/list object ids `known` to exist at the target state."""
-    known = set(known)
+def _graduate_signal(reason: str, detail: str = ""):
+    GRADUATION_STATS[reason] = GRADUATION_STATS.get(reason, 0) + 1
+    logger.info("device lineage graduating to oracle backend: %s%s",
+                reason, f" ({detail})" if detail else "")
+
+
+def _in_scope(changes, known_kinds) -> bool:
+    """True iff every op stays within the device shape: makes of any kind,
+    link/set/del/inc on known objects, ins on known list/text objects.
+    `known_kinds` maps object id -> kind at the target state."""
+    known = dict(known_kinds)
+    # collect the delivery's makes first: causal admission may apply a make
+    # delivered after an op that references it in this same list
     for change in changes:
-        made_here = set()
+        for op in change.get("ops", ()):
+            if op.get("action") in _MAKE_KIND:
+                known[op["obj"]] = _MAKE_KIND[op["action"]]
+    for change in changes:
         for op in change.get("ops", ()):
             action = op.get("action")
             obj = op.get("obj")
-            if action in ("makeText", "makeList"):
-                made_here.add(op["obj"])
-            elif action in ("makeMap", "makeTable"):
-                return False
-            elif action == "link":
-                if obj != ROOT_ID:
+            if action in _MAKE_KIND:
+                continue
+            if action == "link":
+                if obj != ROOT_ID and obj not in known:
                     return False
-                if op.get("value") not in known and \
-                        op.get("value") not in made_here:
+                if op.get("value") not in known:
                     return False
             elif action == "ins":
-                if obj not in known and obj not in made_here:
+                if known.get(obj) not in ("text", "list"):
                     return False
             elif action in ("set", "del", "inc"):
-                if obj != ROOT_ID and obj not in known \
-                        and obj not in made_here:
+                if obj != ROOT_ID and obj not in known:
                     return False
             else:
                 return False
-        known |= made_here
     return True
 
 
@@ -129,15 +151,19 @@ class _TextObj:
         self.prev_conf = self.conflict_sig()
 
 
-class _RootObj:
-    """Host wrapper for the device root map + diffing snapshot."""
+class _MapObj:
+    """Host wrapper for one device map/table object + diffing snapshot
+    (the root map is `_MapObj(ROOT_ID, "map")`)."""
 
-    __slots__ = ("doc", "prev")
+    __slots__ = ("kind", "doc", "max_elem", "prev", "announced")
 
-    def __init__(self):
+    def __init__(self, obj_id: str, kind: str):
         from ..engine.map_doc import DeviceMapDoc
-        self.doc = DeviceMapDoc(ROOT_ID, capacity=16)
+        self.kind = kind                     # "map" | "table"
+        self.doc = DeviceMapDoc(obj_id, capacity=16)
+        self.max_elem = 0                    # uniform wrapper interface
         self.prev: dict = {}                 # key -> (raw value, conflict sig)
+        self.announced = False
 
     def current(self) -> dict:
         doc = self.doc
@@ -165,9 +191,9 @@ class _DeviceCore:
         self.deps: dict = {}
         self.undo_pos = 0                    # undoable local changes (device
         # mode never pops it; actual undo graduates to the oracle)
-        self.objects: dict = {}              # obj_id -> _TextObj
+        self.objects: dict = {}              # obj_id -> _TextObj | _MapObj
         self.obj_order: list = []            # creation order
-        self.root = _RootObj()
+        self.root = _MapObj(ROOT_ID, "map")
         self.commands: list = []             # delivery log for fork/replay
 
     # -- admission (mirror of op_set.js addChange/applyQueuedOps) -------
@@ -183,7 +209,7 @@ class _DeviceCore:
         base = dict(change.get("deps", {}))
         base[actor] = seq - 1
         all_deps = _transitive(self.states, base)
-        if any(op.get("action") in _FLAT_MAKES
+        if any(op.get("action") in _MAKE_KIND
                for op in change.get("ops", ())):
             creations[(actor, seq)] = dict(self.clock)
         self.states.setdefault(actor, []).append(
@@ -244,22 +270,34 @@ class _DeviceCore:
             for op in ch["ops"]:
                 action = op["action"]
                 obj = op["obj"]
-                if action in _FLAT_MAKES:
-                    kind = "text" if action == "makeText" else "list"
-                    tobj = _TextObj(obj, kind)
-                    tobj.doc.clock = dict(
+                if action in _MAKE_KIND:
+                    kind = _MAKE_KIND[action]
+                    if kind in ("text", "list"):
+                        wrapper = _TextObj(obj, kind)
+                    else:
+                        wrapper = _MapObj(obj, kind)
+                    wrapper.doc.clock = dict(
                         creations.get((ch["actor"], ch["seq"]), self.clock))
-                    tobj.doc.clock.pop(ch["actor"], None)
+                    wrapper.doc.clock.pop(ch["actor"], None)
                     if ch["seq"] > 1:
-                        tobj.doc.clock[ch["actor"]] = ch["seq"] - 1
-                    tobj.doc._all_deps = self._seed_all_deps()
-                    self.objects[obj] = tobj
+                        wrapper.doc.clock[ch["actor"]] = ch["seq"] - 1
+                    wrapper.doc._all_deps = self._seed_all_deps()
+                    self.objects[obj] = wrapper
                     self.obj_order.append(obj)
                     feeds[obj] = []
                     created.append(obj)
                 elif obj == ROOT_ID:
                     root_ops.append(op)
                 else:
+                    if obj not in self.objects:
+                        # use-before-make inside one delivery: causal
+                        # admission guarantees make-before-use order when
+                        # the using change depends on the making one, so
+                        # reaching here means the delivery is malformed —
+                        # raise like the oracle (op_set.js:88,199); the
+                        # caller's restore path rolls the core back
+                        raise ValueError(
+                            f"Modification of unknown object {obj}")
                     by_obj.setdefault(obj, []).append(op)
                     if action == "ins":
                         self.objects[obj].max_elem = max(
@@ -303,12 +341,14 @@ class _DeviceCore:
         out = {"value": e["value"]}
         if e.get("datatype"):
             out["datatype"] = e["datatype"]
+        if e.get("link"):
+            out["link"] = True
         return out
 
-    def _decode_root(self, v: int) -> dict:
+    def _decode_map(self, doc, v: int) -> dict:
         if v >= 0:
             return {"value": int(v)}
-        e = self.root.doc.value_pool[-int(v) - 1]
+        e = doc.value_pool[-int(v) - 1]
         out = {"value": e["value"]}
         if e.get("datatype"):
             out["datatype"] = e["datatype"]
@@ -327,39 +367,60 @@ class _DeviceCore:
             out.append(c)
         return out
 
-    def _root_conflicts(self, slot: int):
-        doc = self.root.doc
+    def _map_conflicts(self, doc, slot: int):
         ops = doc.conflicts.get(slot)
         if not ops:
             return None
         out = []
         for op in ops:
             c = {"actor": doc.actor_table[op["actor_rank"]]}
-            c.update(self._decode_root(op["value"]))
+            c.update(self._decode_map(doc, op["value"]))
             out.append(c)
         return out
 
-    def _paths(self) -> dict:
-        """obj_id -> root-relative path ([key]) for currently linked objects."""
-        doc = self.root.doc
-        h = doc._mirrors()
-        paths = {}
-        for key, slot in doc._key_slot.items():
-            if h["has_value"][slot]:
+    def _link_children(self, wrapper) -> list:
+        """(path-step, child obj id) pairs for a wrapper's winning link
+        values. Text/list objects without pooled link entries short-circuit
+        host-side (no device work)."""
+        doc = wrapper.doc
+        out = []
+        if isinstance(wrapper, _TextObj):
+            if not any(e.get("link") for e in doc.value_pool):
+                return out
+            if doc.n_elems == 0:
+                return out
+            h = doc._mirrors()
+            for idx, slot in enumerate(doc.visible_order()):
                 v = int(h["value"][slot])
-                if v < 0:
-                    e = doc.value_pool[-v - 1]
-                    if e.get("link"):
-                        paths[e["value"]] = [key]
+                if v < 0 and doc.value_pool[-v - 1].get("link"):
+                    out.append((idx, doc.value_pool[-v - 1]["value"]))
+        else:
+            h = doc._mirrors()
+            for key, slot in doc._key_slot.items():
+                if h["has_value"][slot]:
+                    v = int(h["value"][slot])
+                    if v < 0 and doc.value_pool[-v - 1].get("link"):
+                        out.append((key, doc.value_pool[-v - 1]["value"]))
+        return out
+
+    def _paths(self) -> dict:
+        """obj_id -> root-relative path for currently reachable objects
+        (walks winning link values breadth-first from the root; the
+        reference's getPath, op_set.js:43-58)."""
+        paths: dict = {}
+        frontier = [(self.root, [])]
+        while frontier:
+            wrapper, base = frontier.pop(0)
+            for step, child in self._link_children(wrapper):
+                if child in self.objects and child not in paths:
+                    paths[child] = base + [step]
+                    frontier.append((self.objects[child], paths[child]))
         return paths
 
     def _text_diffs(self, obj_id: str, tobj: _TextObj, path, out: list,
                     rebuild: bool = False):
         doc = tobj.doc
         n = doc.n_elems
-        if not tobj.announced or rebuild:
-            out.append({"action": "create", "obj": obj_id, "type": tobj.kind})
-            tobj.announced = True
         if n == 0:
             if tobj.max_elem and (rebuild or tobj.prev_n != n):
                 out.append({"action": "maxElem", "obj": obj_id,
@@ -428,36 +489,60 @@ class _DeviceCore:
             out.append({"action": "maxElem", "obj": obj_id, "type": typ,
                         "value": tobj.max_elem, "path": path})
 
-    def _root_diffs(self, out: list, rebuild: bool = False):
-        doc = self.root.doc
-        cur = self.root.current()
-        prev = {} if rebuild else self.root.prev
+    def _map_diffs(self, obj_id: str, mobj: _MapObj, path, out: list,
+                   rebuild: bool = False):
+        doc = mobj.doc
+        cur = mobj.current()
+        prev = {} if rebuild else mobj.prev
+        typ = mobj.kind
         for key in prev:
             if key not in cur:
-                out.append({"action": "remove", "obj": ROOT_ID, "type": "map",
-                            "key": key, "path": []})
+                out.append({"action": "remove", "obj": obj_id, "type": typ,
+                            "key": key, "path": path})
         for key, (raw, sig) in cur.items():
             if prev.get(key) == (raw, sig):
                 continue
-            diff = {"action": "set", "obj": ROOT_ID, "type": "map",
-                    "key": key, "path": []}
-            diff.update(self._decode_root(raw))
-            c = self._root_conflicts(doc._key_slot[key])
-            if c:
-                diff["conflicts"] = c
+            diff = {"action": "set", "obj": obj_id, "type": typ,
+                    "key": key, "path": path}
+            diff.update(self._decode_map(doc, raw))
+            if typ == "map":
+                # table rows carry no conflict annotations in the patch
+                # protocol (reference apply_patch.js updateTableObject)
+                c = self._map_conflicts(doc, doc._key_slot[key])
+                if c:
+                    diff["conflicts"] = c
             out.append(diff)
-        self.root.prev = cur
+        mobj.prev = cur
+
+    def _content_diffs(self, oid: str, paths: dict, out: list,
+                       rebuild: bool = False):
+        wrapper = self.objects[oid]
+        if isinstance(wrapper, _TextObj):
+            self._text_diffs(oid, wrapper, paths.get(oid), out,
+                             rebuild=rebuild)
+            wrapper.snapshot()
+        else:
+            self._map_diffs(oid, wrapper, paths.get(oid), out,
+                            rebuild=rebuild)
 
     def _emit_diffs(self, touched: set, created: list) -> list:
+        # creates go FIRST (creation order): a link diff resolves its child
+        # by object id in the applier's updated/cache maps, so every child
+        # must be registered before any content diff references it; the
+        # applier's update_parent_objects pass re-links parents afterwards
         diffs: list = []
         paths = self._paths()
+        for oid in created:
+            wrapper = self.objects[oid]
+            if not wrapper.announced:
+                diffs.append({"action": "create", "obj": oid,
+                              "type": wrapper.kind})
+                wrapper.announced = True
         for oid in self.obj_order:
             if oid in touched or oid in created:
-                tobj = self.objects[oid]
-                self._text_diffs(oid, tobj, paths.get(oid), diffs)
-                tobj.snapshot()
+                self._content_diffs(oid, paths, diffs)
         if ROOT_ID in touched:
-            self._root_diffs(diffs)
+            self._map_diffs(ROOT_ID, self.root, [], diffs)
         return diffs
 
     def rebuild_diffs(self) -> list:
@@ -465,9 +550,11 @@ class _DeviceCore:
         diffs: list = []
         paths = self._paths()
         for oid in self.obj_order:
-            tobj = self.objects[oid]
-            self._text_diffs(oid, tobj, paths.get(oid), diffs, rebuild=True)
-        self._root_diffs(diffs, rebuild=True)
+            diffs.append({"action": "create", "obj": oid,
+                          "type": self.objects[oid].kind})
+        for oid in self.obj_order:
+            self._content_diffs(oid, paths, diffs, rebuild=True)
+        self._map_diffs(ROOT_ID, self.root, [], diffs, rebuild=True)
         return diffs
 
     # -- fork / restore -------------------------------------------------
@@ -553,14 +640,17 @@ def _device_apply(state: DeviceBackendState, changes, undoable: bool,
     # scope gate BEFORE any forking: graduation replays the log prefix into
     # the oracle and never needs a device fork. For the common current-state
     # case the live object table answers scope directly; for a stale state,
-    # the flat makes in its applied history reconstruct the same set.
+    # the makes in its applied history reconstruct the same kind map.
     if state._is_current():
-        known = state._core.objects.keys()
+        known = {oid: w.kind for oid, w in state._core.objects.items()}
     else:
-        known = {op["obj"] for ch in state.history()
+        known = {op["obj"]: _MAKE_KIND[op["action"]]
+                 for ch in state.history()
                  for op in ch.get("ops", ())
-                 if op.get("action") in _FLAT_MAKES}
+                 if op.get("action") in _MAKE_KIND}
     if not _in_scope(changes, known):
+        _graduate_signal("out_of_scope",
+                         f"{len(changes)} change(s) outside device op shape")
         oracle_state = state._core.graduate(state._version)
         if command[0] == "local":
             return _oracle.apply_local_change(oracle_state, command[1])
@@ -600,6 +690,7 @@ def apply_local_change(state, change: dict):
     elif request_type in ("undo", "redo"):
         # undo/redo synthesis needs the oracle's inverse-op capture: graduate
         # (straight from the shared append-only log — no device fork needed)
+        _graduate_signal("undo_redo", request_type)
         oracle_state = state._core.graduate(state._version)
         new_state, patch = _oracle.apply_local_change(oracle_state, change)
     else:
@@ -669,12 +760,14 @@ def merge(local, remote):
 def undo(state, request):
     if isinstance(state, _OracleState):
         return _oracle.undo(state, request)
+    _graduate_signal("undo_redo", "undo")
     return _oracle.undo(state._core.graduate(state._version), request)
 
 
 def redo(state, request):
     if isinstance(state, _OracleState):
         return _oracle.redo(state, request)
+    _graduate_signal("undo_redo", "redo")
     return _oracle.redo(state._core.graduate(state._version), request)
 
 

@@ -198,8 +198,9 @@ class TextChangeBatch:
                         cols["pa"].append(intern(p_actor))
                         cols["pc"].append(p_ctr)
                     cols["val"].append(0)
-                elif action in ("set", "del", "inc"):
-                    kind = {"set": KIND_SET, "del": KIND_DEL, "inc": KIND_INC}[action]
+                elif action in ("set", "del", "inc", "link"):
+                    kind = {"set": KIND_SET, "del": KIND_DEL, "inc": KIND_INC,
+                            "link": KIND_SET}[action]
                     cols["kind"].append(kind)
                     t_actor, t_ctr = parse_elem_id(op["key"])
                     cols["ta"].append(intern(t_actor))
@@ -215,6 +216,11 @@ class TextChangeBatch:
                             value_pool.append(
                                 {"value": value, "datatype": op.get("datatype")})
                             cols["val"].append(-len(value_pool))  # negative = pool ref
+                    elif action == "link":
+                        # a link is a register op whose value is an object id
+                        # (reference op_set.js:196-258 treats set/link alike)
+                        value_pool.append({"value": op["value"], "link": True})
+                        cols["val"].append(-len(value_pool))
                     elif action == "inc":
                         cols["val"].append(op["value"])
                     else:

@@ -10,6 +10,8 @@ import json
 import os
 import time
 
+RESULTS: list = []  # every emit() of the run, for the per-round record file
+
 
 def setup_jax_cache():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -33,7 +35,16 @@ def timed_once(fn) -> float:
     return time.perf_counter() - t0
 
 
-def emit(metric: str, value: float, unit: str, vs_baseline: float = 0.0):
-    print(json.dumps({"metric": metric, "value": round(value, 2),
-                      "unit": unit, "vs_baseline": round(vs_baseline, 4)}),
-          flush=True)
+def emit(metric: str, value: float, unit: str, vs_baseline: float = 0.0,
+         **extra):
+    rec = {"metric": metric, "value": round(value, 2), "unit": unit,
+           "vs_baseline": round(vs_baseline, 4), **extra}
+    RESULTS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def write_record(path: str):
+    """One JSON line per emitted config result (BENCH_CONFIGS_r<NN>.json)."""
+    with open(path, "w") as fh:
+        for rec in RESULTS:
+            fh.write(json.dumps(rec) + "\n")

@@ -1,17 +1,24 @@
-"""BASELINE.md benchmark configs 1-5. One JSON line per config.
+"""BASELINE.md benchmark configs 1-5 + a conflict-heavy config 6.
 
-Usage: python -m benchmarks.run_all [--quick]
+Usage: python -m benchmarks.run_all [--quick] [--record ROUND]
 
-Config 5 (the headline 1M-char / 10k-actor merge) is bench.py at the repo
-root — the driver runs it separately. --quick shrinks configs 3 and 4 for
+One JSON line per config on stdout; `--record 3` additionally writes them
+to BENCH_CONFIGS_r03.json (the per-round committed record). Config 5 (the
+headline 1M-char / 10k-actor merge) is bench.py at the repo root — the
+driver runs it separately; --record re-runs it here in a subprocess so the
+record file covers the whole surface. --quick shrinks configs 3 and 4 for
 fast iteration.
+
+Each config asserts it exercised the path it claims (e.g. cfg4 asserts the
+nested Trellis document stayed on the DEVICE tier with zero graduations;
+cfg6 asserts the residual/slow register path actually ran).
 """
 
 import sys
 
 import numpy as np
 
-from benchmarks.common import emit, setup_jax_cache, timed
+from benchmarks.common import emit, setup_jax_cache, timed, write_record
 
 setup_jax_cache()
 
@@ -120,9 +127,11 @@ def config3_docset(n_docs: int = 1000, n_actors: int = 10,
 
 def config4_trellis(n_actors: int = 1000, quick: bool = False):
     """Trellis-style nested cards[]/tasks[]: n_actors concurrent actors do
-    mixed insert/update/delete on a shared board (facade/oracle path — the
-    nested-document engine tier)."""
+    mixed insert/update/delete on a shared board, merged on the DEVICE
+    nested-document tier (asserted: no graduation)."""
     import automerge_tpu as am
+    from automerge_tpu import frontend as Frontend
+    from automerge_tpu.backend import device as device_backend
 
     if quick:
         n_actors = 100
@@ -146,21 +155,96 @@ def config4_trellis(n_actors: int = 1000, quick: bool = False):
     all_changes = [c for cs in changes_per_actor for c in cs]
     n_ops = sum(len(c["ops"]) for c in all_changes)
 
+    device_backend.GRADUATION_STATS.clear()
+
     def run():
         merged = am.apply_changes(base, all_changes)
         assert len(am.to_json(merged)["cards"]) == 10
+        # path assertion: the nested board was served by the device tier
+        assert isinstance(Frontend.get_backend_state(merged),
+                          device_backend.DeviceBackendState)
+        assert device_backend.GRADUATION_STATS == {}
 
     dt = timed(run, warmups=0, reps=1)
-    emit(f"cfg4_trellis_nested_{n_actors}_actors", n_ops / dt, "ops/s")
+    emit(f"cfg4_trellis_nested_{n_actors}_actors", n_ops / dt, "ops/s",
+         tier="device")
+
+
+def config6_conflict_heavy(n_actors: int = 200, n_targets: int = 500):
+    """Residual/slow-path config: n_actors concurrently overwrite the SAME
+    n_targets elements (multi-writer registers -> conflicts), plus deletes
+    and counter increments — everything the dense run path skips. Times
+    apply_residual + the host slow register path (asserted: conflicts
+    minted, i.e. the slow path actually ran)."""
+    from automerge_tpu.engine import DeviceTextDoc, TextChangeBatch
+
+    base_ops = []
+    for i in range(1, n_targets + 1):
+        key = "_head" if i == 1 else f"base:{i - 1}"
+        base_ops.append({"action": "ins", "obj": "t", "key": key, "elem": i})
+        base_ops.append({"action": "set", "obj": "t", "key": f"base:{i}",
+                         "value": chr(97 + i % 26)})
+    base = {"actor": "base", "seq": 1, "deps": {}, "ops": base_ops}
+
+    changes = []
+    for a in range(n_actors):
+        ops = []
+        for i in range(1, n_targets + 1):
+            if (a + i) % 5 == 0:
+                ops.append({"action": "del", "obj": "t",
+                            "key": f"base:{i}"})
+            else:
+                ops.append({"action": "set", "obj": "t", "key": f"base:{i}",
+                            "value": chr(65 + (a + i) % 26)})
+        changes.append({"actor": f"actor-{a:04d}", "seq": 1,
+                        "deps": {"base": 1}, "ops": ops})
+    batch = TextChangeBatch.from_changes(changes, "t")
+    n_ops = batch.n_ops
+    state = {}
+
+    def run():
+        doc = DeviceTextDoc("t")
+        doc.apply_changes([base])
+        doc.apply_batch(batch)
+        doc.text()
+        state["doc"] = doc
+
+    dt = timed(run, warmups=1, reps=2)
+    doc = state["doc"]
+    # path assertions: genuine multi-writer registers resolved on the host
+    # slow path and survive as conflicts
+    assert doc.conflicts, "conflict-heavy config minted no conflicts"
+    emit(f"cfg6_conflict_heavy_{n_actors}x{n_targets}", n_ops / dt, "ops/s",
+         n_conflicts=len(doc.conflicts))
 
 
 def main():
     quick = "--quick" in sys.argv
+    record_round = None
+    if "--record" in sys.argv:
+        record_round = int(sys.argv[sys.argv.index("--record") + 1])
     config1_text_two_actor()
     config2_map_counter()
     config3_docset(n_docs=100 if quick else 1000)
     config4_trellis(quick=quick)
-    if not quick:
+    config6_conflict_heavy()
+    if record_round is not None:
+        # cfg5 = the headline bench, folded into the record file
+        import json as _json
+        import os
+        import subprocess
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, os.path.join(root, "bench.py")],
+            capture_output=True, text=True, check=True, cwd=root)
+        line = out.stdout.strip().splitlines()[-1]
+        rec = _json.loads(line)
+        from benchmarks.common import RESULTS
+        RESULTS.append({**rec, "metric": "cfg5_" + rec["metric"]})
+        print(_json.dumps(RESULTS[-1]), flush=True)
+        write_record(os.path.join(
+            root, f"BENCH_CONFIGS_r{record_round:02d}.json"))
+    elif not quick:
         print("# cfg5 (headline): python bench.py", file=sys.stderr)
 
 

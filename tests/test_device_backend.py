@@ -62,19 +62,31 @@ class TestTextFlowsStayOnDevice:
         assert isinstance(Frontend.get_backend_state(m), DeviceBackendState)
         assert str(m["t"]) == "hi!"
 
-    def test_nested_objects_graduate_to_oracle(self):
+    def test_nested_objects_stay_on_device(self):
         d = init_with(device_backend.DeviceBackend, "alice")
         d = _am.change(d, lambda doc: doc.__setitem__("card", {"x": 1}))
-        assert isinstance(Frontend.get_backend_state(d), OracleState)
+        assert isinstance(Frontend.get_backend_state(d), DeviceBackendState)
         assert _am.to_json(d) == {"card": {"x": 1}}
 
-    def test_graduated_doc_keeps_working(self):
+    def test_mixed_flat_and_nested_stays_on_device(self):
         d = init_with(device_backend.DeviceBackend, "alice")
         d = _am.change(d, lambda doc: doc.__setitem__("t", Frontend.Text("abc")))
-        d = _am.change(d, lambda doc: doc.__setitem__("m", {"k": 1}))  # graduates
+        d = _am.change(d, lambda doc: doc.__setitem__("m", {"k": 1}))
         d = _am.change(d, lambda doc: doc["t"].insert_at(3, "d"))
+        d = _am.change(d, lambda doc: doc["m"].__setitem__("k", 2))
+        assert isinstance(Frontend.get_backend_state(d), DeviceBackendState)
         assert str(d["t"]) == "abcd"
-        assert _am.to_json(d)["m"] == {"k": 1}
+        assert _am.to_json(d)["m"] == {"k": 2}
+
+    def test_undo_graduates_with_signal(self):
+        device_backend.GRADUATION_STATS.clear()
+        d = init_with(device_backend.DeviceBackend, "alice")
+        d = _am.change(d, lambda doc: doc.__setitem__("x", 1))
+        assert isinstance(Frontend.get_backend_state(d), DeviceBackendState)
+        d = _am.undo(d)
+        assert isinstance(Frontend.get_backend_state(d), OracleState)
+        assert _am.to_json(d) == {}
+        assert device_backend.GRADUATION_STATS.get("undo_redo") == 1
 
 
 def scenario_typing(be):
@@ -144,13 +156,121 @@ def scenario_key_delete(be):
     return doc_fingerprint(a)
 
 
+def scenario_nested_maps(be):
+    a = init_with(be, "alice")
+    a = _am.change(a, lambda doc: doc.__setitem__(
+        "card", {"title": "hi", "meta": {"stars": 3}}))
+    a = _am.change(a, lambda doc: doc["card"]["meta"].__setitem__("stars", 4))
+    a = _am.change(a, lambda doc: doc["card"].__setitem__("done", True))
+    b = init_with(be, "bob")
+    b = _am.apply_changes(b, _am.get_all_changes(a))
+    a = _am.change(a, lambda doc: doc["card"].__delitem__("title"))
+    b = _am.change(b, lambda doc: doc["card"]["meta"].__setitem__("stars", 5))
+    m1, m2 = _am.merge(a, b), _am.merge(b, a)
+    f1, f2 = doc_fingerprint(m1), doc_fingerprint(m2)
+    assert f1 == f2
+    assert f1["json"]["card"]["meta"]["stars"] == 5
+    return f1
+
+
+def scenario_nested_lists(be):
+    a = init_with(be, "alice")
+    a = _am.change(a, lambda doc: doc.__setitem__(
+        "board", {"cards": [{"t": "one"}, {"t": "two"}]}))
+    b = init_with(be, "bob")
+    b = _am.apply_changes(b, _am.get_all_changes(a))
+    a = _am.change(a, lambda doc: doc["board"]["cards"].append({"t": "three"}))
+    b = _am.change(b, lambda doc: doc["board"]["cards"][0].__setitem__(
+        "t", "ONE"))
+    b = _am.change(b, lambda doc: doc["board"]["cards"].delete_at(1))
+    m1, m2 = _am.merge(a, b), _am.merge(b, a)
+    f1, f2 = doc_fingerprint(m1), doc_fingerprint(m2)
+    assert f1 == f2
+    assert [c["t"] for c in f1["json"]["board"]["cards"]] == \
+        ["ONE", "three"]
+    return f1
+
+
+def scenario_nested_conflicts(be):
+    a = init_with(be, "aaa")
+    a = _am.change(a, lambda doc: doc.__setitem__("m", {"k": "init"}))
+    b = init_with(be, "zzz")
+    b = _am.apply_changes(b, _am.get_all_changes(a))
+    a = _am.change(a, lambda doc: doc["m"].__setitem__("k", "from-a"))
+    b = _am.change(b, lambda doc: doc["m"].__setitem__("k", "from-z"))
+    m1, m2 = _am.merge(a, b), _am.merge(b, a)
+    f1, f2 = doc_fingerprint(m1), doc_fingerprint(m2)
+    assert f1 == f2
+    assert f1["json"]["m"]["k"] == "from-z"
+    m = m1
+    conf = Frontend.get_conflicts(m["m"], "k")
+    assert conf == {"aaa": "from-a"}
+    return f1
+
+
+def scenario_table(be):
+    # row ids are minted via the uuid factory: pin it so both backends see
+    # identical ids (the reference's uuid.setFactory determinism hook)
+    from automerge_tpu import _uuid
+    counter = iter(range(1, 1000))  # 0 would collide with the all-zero ROOT_ID
+    _uuid.set_factory(lambda: f"00000000-0000-0000-0000-{next(counter):012d}")
+    try:
+        return _scenario_table(be)
+    finally:
+        _uuid.reset()
+
+
+def _scenario_table(be):
+    a = init_with(be, "alice")
+
+    def setup(doc):
+        doc["todos"] = Frontend.Table()
+        doc["todos"].add({"title": "one", "done": False})
+    a = _am.change(a, setup)
+    b = init_with(be, "bob")
+    b = _am.apply_changes(b, _am.get_all_changes(a))
+    b = _am.change(b, lambda doc: doc["todos"].add(
+        {"title": "two", "done": True}))
+    m1, m2 = _am.merge(a, b), _am.merge(b, a)
+    f1, f2 = doc_fingerprint(m1), doc_fingerprint(m2)
+    assert f1 == f2
+    assert sorted(r["title"] for r in m1["todos"].rows) == ["one", "two"]
+    return f1
+
+
+def scenario_text_in_nested_map(be):
+    a = init_with(be, "alice")
+    a = _am.change(a, lambda doc: doc.__setitem__("card", {"n": 1}))
+    a = _am.change(a, lambda doc: doc["card"].__setitem__(
+        "notes", Frontend.Text("hey")))
+    b = init_with(be, "bob")
+    b = _am.apply_changes(b, _am.get_all_changes(a))
+    b = _am.change(b, lambda doc: doc["card"]["notes"].insert_at(3, "!"))
+    m1, m2 = _am.merge(a, b), _am.merge(b, a)
+    f1, f2 = doc_fingerprint(m1), doc_fingerprint(m2)
+    assert f1 == f2
+    assert str(m1["card"]["notes"]) == "hey!"
+    return f1
+
+
 @pytest.mark.parametrize("scenario", [
     scenario_typing, scenario_concurrent_text, scenario_map_conflicts,
     scenario_counters, scenario_delete_and_resurrect, scenario_key_delete,
+    scenario_nested_maps, scenario_nested_lists, scenario_nested_conflicts,
+    scenario_table, scenario_text_in_nested_map,
 ], ids=lambda f: f.__name__)
 def test_backend_parity(scenario):
     results = both(scenario)
     assert results["device"] == results["oracle"]
+
+
+def test_nested_never_graduates():
+    """Config-4-shaped (Trellis) nested mutations stay on the device tier."""
+    device_backend.GRADUATION_STATS.clear()
+    for scenario in (scenario_nested_maps, scenario_nested_lists,
+                     scenario_table, scenario_text_in_nested_map):
+        scenario(device_backend.DeviceBackend)
+    assert device_backend.GRADUATION_STATS == {}
 
 
 class TestCausalBuffering:
@@ -211,6 +331,47 @@ class TestRandomizedParity:
                             t.delete_at(r.randrange(len(t)))
                         else:
                             d["n"] = r.randrange(100)
+                docs[i] = _am.change(docs[i], edit)
+                i, j = r.sample(range(n_actors), 2)
+                docs[i] = _am.merge(docs[i], docs[j])
+                prints.append(doc_fingerprint(docs[i]))
+            return prints
+
+        assert run(device_backend.DeviceBackend) == run(oracle_backend.Backend)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_random_nested_history(self, seed):
+        """Random nested-tree mutations (maps in lists in maps) with random
+        merges: device vs oracle fingerprints after every merge."""
+        n_actors = 3
+
+        def run(be):
+            base = init_with(be, "base")
+            base = _am.change(base, lambda doc: doc.update(
+                {"cards": [{"title": "c0", "tags": ["x"]}], "n": 0}))
+            changes = _am.get_all_changes(base)
+            docs = [
+                _am.apply_changes(init_with(be, f"ac{i}"), changes)
+                for i in range(n_actors)]
+            r = random.Random(seed + 77)
+            prints = []
+            for _ in range(5):
+                i = r.randrange(n_actors)
+
+                def edit(d, r=r):
+                    cards = d["cards"]
+                    op = r.random()
+                    if op < 0.3:
+                        cards.append(
+                            {"title": f"c{r.randrange(100)}", "tags": []})
+                    elif op < 0.5 and len(cards) > 1:
+                        cards.delete_at(r.randrange(len(cards)))
+                    elif op < 0.75:
+                        card = cards[r.randrange(len(cards))]
+                        card["title"] = f"t{r.randrange(100)}"
+                    else:
+                        card = cards[r.randrange(len(cards))]
+                        card["tags"].append(chr(97 + r.randrange(26)))
                 docs[i] = _am.change(docs[i], edit)
                 i, j = r.sample(range(n_actors), 2)
                 docs[i] = _am.merge(docs[i], docs[j])
