@@ -195,6 +195,19 @@ class _DeviceCore:
         self.obj_order: list = []            # creation order
         self.root = _MapObj(ROOT_ID, "map")
         self.commands: list = []             # delivery log for fork/replay
+        self._cv = None                      # (actors, lens) vector cache
+        self.actor_rank: dict = {}           # actor -> dense rank (states order)
+
+    def clock_vectors(self):
+        """(actors list, per-actor applied-change counts as int64 vector),
+        ranks in `states` insertion order; cached until the next admit."""
+        if self._cv is None:
+            actors = list(self.states)
+            self.actor_rank = {a: i for i, a in enumerate(actors)}
+            lens = np.asarray([len(self.states[a]) for a in actors],
+                              np.int64)
+            self._cv = (actors, lens)
+        return self._cv
 
     # -- admission (mirror of op_set.js addChange/applyQueuedOps) -------
 
@@ -214,6 +227,7 @@ class _DeviceCore:
             creations[(actor, seq)] = dict(self.clock)
         self.states.setdefault(actor, []).append(
             {"change": change, "allDeps": all_deps})
+        self._cv = None                      # clock vectors are stale
         new_deps = {a: s for a, s in self.deps.items()
                     if s > all_deps.get(a, 0)}
         new_deps[actor] = seq
@@ -575,7 +589,8 @@ class _DeviceCore:
         """Rebuild in place after a failed mutation (facade._restore)."""
         clean = self.fork(version)
         for slot in ("states", "history", "queue", "clock", "deps",
-                     "undo_pos", "objects", "obj_order", "root", "commands"):
+                     "undo_pos", "objects", "obj_order", "root", "commands",
+                     "_cv", "actor_rank"):
             setattr(self, slot, getattr(clean, slot))
 
     def graduate(self, version: int) -> _OracleState:
@@ -708,13 +723,45 @@ def get_patch(state) -> dict:
 
 
 def _state_changes(state, have_deps: dict, clock_bound=None) -> list:
+    """Changes the holder of `have_deps` is missing, bounded by
+    `clock_bound` (a stale state's clock). Vectorized: per-actor clock
+    comparison happens as numpy ops over interned actor ranks, and the
+    host loop runs ONLY over actors the comparison flagged — not over
+    every actor in the document (the reference walks all of them,
+    op_set.js:388-395)."""
     core = state._core
+    actors, lens_vec = core.clock_vectors()
+    n = len(actors)
+    if n == 0:
+        return []
+    rank = core.actor_rank
+    # fast cover check: a peer whose raw clock already covers every actor
+    # is missing nothing — skip the transitive closure entirely (the
+    # common case for every broadcast after a peer caught up)
+    have_vec = np.zeros(n, np.int64)
+    for a, s in have_deps.items():
+        i = rank.get(a)
+        if i is not None and s > have_vec[i]:
+            have_vec[i] = s
+    bound_vec = lens_vec
+    if clock_bound is not None:
+        bound_vec = np.zeros(n, np.int64)
+        for a, s in clock_bound.items():
+            i = rank.get(a)
+            if i is not None:
+                bound_vec[i] = min(s, lens_vec[i])
+    if (have_vec >= bound_vec).all():
+        return []
     all_deps = _transitive(core.states, have_deps)
+    lo_vec = np.zeros(n, np.int64)
+    for a, s in all_deps.items():
+        i = rank.get(a)
+        if i is not None:
+            lo_vec[i] = s
     changes = []
-    for actor, lst in core.states.items():
-        upper = len(lst) if clock_bound is None else \
-            min(len(lst), clock_bound.get(actor, 0))
-        for entry in lst[all_deps.get(actor, 0): upper]:
+    for i in np.nonzero(bound_vec > lo_vec)[0]:
+        lst = core.states[actors[i]]
+        for entry in lst[int(lo_vec[i]): int(bound_vec[i])]:
             changes.append(entry["change"])
     return changes
 

@@ -1,3 +1,5 @@
 from .connection import Connection  # noqa: F401
+from .clock_index import ClockMatrix  # noqa: F401
 from .doc_set import DocSet  # noqa: F401
+from .hub import HubPeer, SyncHub  # noqa: F401
 from .watchable_doc import WatchableDoc  # noqa: F401

@@ -1,0 +1,114 @@
+"""Batched vector-clock index for multi-peer, multi-doc sync.
+
+The reference diffs one (peer, doc) pair at a time with a per-actor clock
+walk (`getMissingChanges`, /root/reference/backend/op_set.js:388-395, driven
+per peer by src/connection.js:58-74). Here the whole doc-set's clocks and
+every peer's believed clocks intern into dense int64 matrices, so "who needs
+what" for N peers x M docs x A actors is ONE numpy comparison — the
+framework's device-adjacent answer to SURVEY §5's "trivially vectorizable"
+note. Change extraction then touches only the (peer, doc) pairs the
+comparison flagged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _Interner:
+    __slots__ = ("idx", "items")
+
+    def __init__(self):
+        self.idx: dict = {}
+        self.items: list = []
+
+    def __call__(self, key) -> int:
+        i = self.idx.get(key)
+        if i is None:
+            i = self.idx[key] = len(self.items)
+            self.items.append(key)
+        return i
+
+    def __len__(self):
+        return len(self.items)
+
+
+def _grow(arr: np.ndarray, shape: tuple) -> np.ndarray:
+    if arr.shape == shape:
+        return arr
+    out = np.zeros(shape, arr.dtype)
+    if arr.size:
+        out[tuple(slice(0, s) for s in arr.shape)] = arr
+    return out
+
+
+class ClockMatrix:
+    """Dense (docs x actors) local clocks + (peers x docs x actors) believed
+    peer clocks; `pending()` compares them all at once."""
+
+    def __init__(self):
+        self._docs = _Interner()
+        self._actors = _Interner()
+        self._peers = _Interner()
+        self._ours = np.zeros((0, 0), np.int64)
+        self._theirs = np.zeros((0, 0, 0), np.int64)
+
+    def _sync_shapes(self):
+        d, a, p = len(self._docs), len(self._actors), len(self._peers)
+        self._ours = _grow(self._ours, (d, a))
+        self._theirs = _grow(self._theirs, (p, d, a))
+
+    def update_ours(self, doc_id: str, clock: dict):
+        di = self._docs(doc_id)
+        cols = [self._actors(actor) for actor in clock]
+        self._sync_shapes()
+        row = self._ours[di]
+        for actor, ci in zip(clock, cols):
+            if clock[actor] > row[ci]:
+                row[ci] = clock[actor]
+
+    def update_theirs(self, peer_id: str, doc_id: str, clock: dict):
+        pi = self._peers(peer_id)
+        di = self._docs(doc_id)
+        cols = [self._actors(actor) for actor in clock]
+        self._sync_shapes()
+        row = self._theirs[pi, di]
+        for actor, ci in zip(clock, cols):
+            if clock[actor] > row[ci]:
+                row[ci] = clock[actor]
+
+    def known_peer_doc(self, peer_id: str, doc_id: str) -> bool:
+        return peer_id in self._peers.idx and doc_id in self._docs.idx
+
+    def our_clock(self, doc_id: str) -> dict:
+        di = self._docs.idx.get(doc_id)
+        if di is None or di >= self._ours.shape[0]:
+            return {}
+        row = self._ours[di]
+        return {self._actors.items[i]: int(s)
+                for i, s in enumerate(row) if s > 0}
+
+    def their_clock(self, peer_id: str, doc_id: str) -> dict:
+        if not self.known_peer_doc(peer_id, doc_id):
+            return {}
+        self._sync_shapes()
+        row = self._theirs[self._peers.idx[peer_id], self._docs.idx[doc_id]]
+        return {self._actors.items[i]: int(s)
+                for i, s in enumerate(row) if s > 0}
+
+    def reset_peer(self, peer_id: str):
+        """Forget a peer's believed clocks (it may reconnect fresh later;
+        update_theirs is monotone max, so zeroing is the only way back)."""
+        pi = self._peers.idx.get(peer_id)
+        if pi is not None and pi < self._theirs.shape[0]:
+            self._theirs[pi] = 0
+
+    def pending(self) -> list:
+        """All (peer_id, doc_id) pairs where the peer is missing changes:
+        ONE vectorized comparison over every peer, doc, and actor."""
+        self._sync_shapes()
+        if not self._theirs.size:
+            return []
+        needy = (self._theirs < self._ours[None]).any(axis=2)
+        return [(self._peers.items[p], self._docs.items[d])
+                for p, d in zip(*np.nonzero(needy))]

@@ -1,0 +1,156 @@
+"""Multi-peer sync hub: N peers served from one DocSet with batched diffing.
+
+The reference instantiates one `Connection` per peer, each re-diffing every
+doc against that peer on every local change (src/connection.js:58-88 driven
+by the DocSet handler). A `SyncHub` keeps every peer's believed clocks in
+one `ClockMatrix`; a local change triggers ONE vectorized comparison across
+(peers x docs x actors) and change extraction runs only for the flagged
+pairs. Wire behavior per peer is identical to `Connection` — plain
+``{docId, clock, changes?}`` messages, changes only after a peer reveals a
+clock for the doc, advertisements otherwise — so a hub peer can talk to a
+plain `Connection` (or another hub) on the far side.
+"""
+
+from __future__ import annotations
+
+from ..backend import default as Backend
+from .. import frontend as Frontend
+from .._common import less_or_equal
+from .clock_index import ClockMatrix
+
+
+class HubPeer:
+    """One peer's endpoint on a SyncHub (the Connection-compatible face)."""
+
+    def __init__(self, hub: "SyncHub", peer_id: str, send_msg):
+        self._hub = hub
+        self.peer_id = peer_id
+        self.send_msg = send_msg
+
+    def receive_msg(self, msg: dict):
+        return self._hub._receive(self.peer_id, msg)
+
+
+class SyncHub:
+    def __init__(self, doc_set):
+        self._doc_set = doc_set
+        self._peers: dict = {}
+        self._matrix = ClockMatrix()
+        self._advertised: dict = {}   # (peer, doc) -> clock last advertised
+        self._revealed: set = set()   # (peer, doc) pairs that sent us a clock
+        self._had_doc: set = set()    # doc ids this hub ever held locally
+
+    # -- lifecycle ------------------------------------------------------
+
+    def add_peer(self, peer_id: str, send_msg) -> HubPeer:
+        if peer_id in self._peers:
+            raise ValueError(f"duplicate peer id: {peer_id}")
+        peer = HubPeer(self, peer_id, send_msg)
+        self._peers[peer_id] = peer
+        for doc_id in self._doc_set.doc_ids:
+            self._advertise(peer_id, doc_id)
+        return peer
+
+    def remove_peer(self, peer_id: str):
+        """Drop a peer; a later add_peer with the same id starts fresh."""
+        self._peers.pop(peer_id, None)
+        self._matrix.reset_peer(peer_id)
+        self._revealed = {pd for pd in self._revealed if pd[0] != peer_id}
+        self._advertised = {pd: c for pd, c in self._advertised.items()
+                            if pd[0] != peer_id}
+
+    def open(self):
+        self._doc_set.register_handler(self.doc_changed)
+        for doc_id in self._doc_set.doc_ids:
+            self.doc_changed(doc_id, self._doc_set.get_doc(doc_id))
+
+    def close(self):
+        self._doc_set.unregister_handler(self.doc_changed)
+
+    # -- outbound -------------------------------------------------------
+
+    def _state(self, doc_id: str):
+        doc = self._doc_set.get_doc(doc_id)
+        if doc is None:
+            return None
+        state = Frontend.get_backend_state(doc)
+        if state is None:
+            raise TypeError(
+                "This object cannot be used for network sync. Are you "
+                "trying to sync a snapshot from the history?")
+        return state
+
+    def _advertise(self, peer_id: str, doc_id: str):
+        state = self._state(doc_id)
+        if state is None:
+            return
+        clock = dict(state.clock)
+        if self._advertised.get((peer_id, doc_id)) == clock:
+            return
+        self._advertised[(peer_id, doc_id)] = clock
+        self._peers[peer_id].send_msg({"docId": doc_id, "clock": clock})
+
+    def doc_changed(self, doc_id: str, doc):
+        state = Frontend.get_backend_state(doc)
+        if state is None:
+            raise TypeError(
+                "This object cannot be used for network sync. Are you "
+                "trying to sync a snapshot from the history?")
+        if not less_or_equal(self._matrix.our_clock(doc_id), state.clock):
+            raise ValueError("Cannot pass an old state object to a connection")
+        self._had_doc.add(doc_id)
+        self._matrix.update_ours(doc_id, state.clock)
+        self.flush()
+        # peers that have never revealed a clock for this doc get an
+        # advertisement instead of speculative changes (Connection's
+        # unknown-peer behavior)
+        for peer_id in self._peers:
+            if (peer_id, doc_id) not in self._revealed:
+                self._advertise(peer_id, doc_id)
+
+    def flush(self):
+        """One batched comparison; send changes for every flagged pair."""
+        for peer_id, doc_id in self._matrix.pending():
+            if peer_id not in self._peers:
+                continue
+            if (peer_id, doc_id) not in self._revealed:
+                continue  # never send changes unsolicited (advertise path)
+            state = self._state(doc_id)
+            if state is None:
+                continue  # doc removed locally; clocks remain for history
+            their = self._matrix.their_clock(peer_id, doc_id)
+            changes = Backend.get_missing_changes(state, their)
+            clock = dict(state.clock)
+            if not changes:
+                # the peer's raw clock is behind ours but transitively
+                # covers it: record the cover so this pair stops being
+                # re-flagged (and re-diffed) on every flush
+                self._matrix.update_theirs(peer_id, doc_id, clock)
+                self._advertise(peer_id, doc_id)
+                continue
+            self._matrix.update_theirs(peer_id, doc_id, clock)
+            self._advertised[(peer_id, doc_id)] = clock
+            self._peers[peer_id].send_msg(
+                {"docId": doc_id, "clock": clock, "changes": changes})
+
+    # -- inbound --------------------------------------------------------
+
+    def _receive(self, peer_id: str, msg: dict):
+        doc_id = msg["docId"]
+        if msg.get("clock") is not None:
+            # an empty clock still registers the peer for this doc
+            self._revealed.add((peer_id, doc_id))
+            self._matrix.update_theirs(peer_id, doc_id, msg["clock"])
+        if msg.get("changes"):
+            return self._doc_set.apply_changes(doc_id, msg["changes"])
+        if self._doc_set.get_doc(doc_id) is not None:
+            self._matrix.update_ours(
+                doc_id, Frontend.get_backend_state(
+                    self._doc_set.get_doc(doc_id)).clock)
+            self.flush()
+        elif doc_id not in self._had_doc and msg.get("clock"):
+            # the peer has a document we never held: request it with an
+            # empty clock (docs we deliberately removed are NOT re-requested
+            # — Connection's `doc_id not in our_clock` guard)
+            self._peers[peer_id].send_msg({"docId": doc_id, "clock": {}})
+        return self._doc_set.get_doc(doc_id)

@@ -1,0 +1,216 @@
+"""SyncHub: N peers served from one DocSet with ONE batched clock
+comparison per local change (the vectorized getMissingChanges of SURVEY §5).
+Wire compatibility: hub peers interoperate with plain Connections."""
+
+from unittest import mock
+
+import automerge_tpu as am
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.sync import ClockMatrix, Connection, DocSet, SyncHub
+
+
+class Pipe:
+    """In-process bidirectional message pipe with explicit pumping."""
+
+    def __init__(self):
+        self.a_to_b: list = []
+        self.b_to_a: list = []
+
+    def pump(self, b_receive, a_receive) -> int:
+        n = 0
+        while self.a_to_b or self.b_to_a:
+            while self.a_to_b:
+                b_receive(self.a_to_b.pop(0))
+                n += 1
+            while self.b_to_a:
+                a_receive(self.b_to_a.pop(0))
+                n += 1
+        return n
+
+
+def test_clock_matrix_pending_is_batched():
+    m = ClockMatrix()
+    for d in range(3):
+        m.update_ours(f"doc{d}", {"alice": 2, "bob": 1})
+    for p in range(4):
+        for d in range(3):
+            m.update_theirs(f"peer{p}", f"doc{d}", {"alice": 2, "bob": 1})
+    assert m.pending() == []
+    m.update_ours("doc1", {"alice": 3})
+    assert sorted(m.pending()) == [(f"peer{p}", "doc1") for p in range(4)]
+    m.update_theirs("peer2", "doc1", {"alice": 3})
+    assert ("peer2", "doc1") not in m.pending()
+
+
+def test_hub_broadcasts_one_change_to_all_peers():
+    ds = DocSet()
+    hub = SyncHub(ds)
+    outboxes = {p: [] for p in ("p1", "p2", "p3")}
+    handles = {p: hub.add_peer(p, outboxes[p].append) for p in outboxes}
+    hub.open()
+
+    doc = am.change(am.init("alice"), lambda d: d.__setitem__("x", 1))
+    ds.set_doc("doc1", doc)
+    # unknown peers first get an advertisement, never speculative changes
+    for p, box in outboxes.items():
+        assert [m for m in box if m.get("changes")] == []
+        assert any(m["docId"] == "doc1" for m in box), (p, box)
+    # each peer reveals its (empty) clock; the hub then sends the changes
+    for p, h in handles.items():
+        h.receive_msg({"docId": "doc1", "clock": {}})
+    for p, box in outboxes.items():
+        with_changes = [m for m in box if m.get("changes")]
+        assert len(with_changes) == 1, (p, box)
+        assert with_changes[0]["docId"] == "doc1"
+
+    # a subsequent local change now broadcasts changes directly
+    ds.set_doc("doc1", am.change(ds.get_doc("doc1"),
+                                 lambda d: d.__setitem__("y", 2)))
+    for p, box in outboxes.items():
+        assert len([m for m in box if m.get("changes")]) == 2, (p, box)
+
+
+def test_hub_uses_one_batched_comparison_per_change():
+    ds = DocSet()
+    hub = SyncHub(ds)
+    for p in range(5):
+        hub.add_peer(f"p{p}", lambda m: None)
+    hub.open()
+    with mock.patch.object(ClockMatrix, "pending",
+                           wraps=hub._matrix.pending) as spy:
+        doc = am.change(am.init("alice"), lambda d: d.__setitem__("x", 1))
+        ds.set_doc("doc1", doc)
+        # one local change -> ONE batched pending() call serves all 5 peers
+        assert spy.call_count == 1
+
+
+def test_hub_interoperates_with_plain_connection():
+    # hub side: two docs
+    ds_hub = DocSet()
+    hub = SyncHub(ds_hub)
+    # peer side: a reference-parity Connection
+    ds_peer = DocSet()
+    pipe = Pipe()
+    peer_handle = hub.add_peer("peer", pipe.a_to_b.append)
+    conn = Connection(ds_peer, pipe.b_to_a.append)
+    hub.open()
+    conn.open()
+
+    d1 = am.change(am.init("alice"), lambda d: d.__setitem__("x", 1))
+    ds_hub.set_doc("doc1", d1)
+    pipe.pump(conn.receive_msg, peer_handle.receive_msg)
+    assert am.to_json(ds_peer.get_doc("doc1")) == {"x": 1}
+
+    # and back: peer edits, hub side converges
+    d2 = am.change(ds_peer.get_doc("doc1"),
+                   lambda d: d.__setitem__("y", 2))
+    ds_peer.set_doc("doc1", d2)
+    pipe.pump(conn.receive_msg, peer_handle.receive_msg)
+    assert am.to_json(ds_hub.get_doc("doc1")) == {"x": 1, "y": 2}
+
+
+def test_hub_to_hub_multi_doc_convergence():
+    ds_a, ds_b = DocSet(), DocSet()
+    hub_a, hub_b = SyncHub(ds_a), SyncHub(ds_b)
+    pipe = Pipe()
+    pa = hub_a.add_peer("b", pipe.a_to_b.append)
+    pb = hub_b.add_peer("a", pipe.b_to_a.append)
+    hub_a.open()
+    hub_b.open()
+
+    for i in range(3):
+        doc = am.change(am.init(f"actor{i}"),
+                        lambda d, i=i: d.__setitem__("n", i))
+        ds_a.set_doc(f"doc{i}", doc)
+    pipe.pump(pb.receive_msg, pa.receive_msg)
+    for i in range(3):
+        assert am.to_json(ds_b.get_doc(f"doc{i}")) == {"n": i}
+
+    # concurrent edits on both sides, one pump converges everything
+    ds_a.set_doc("doc0", am.change(ds_a.get_doc("doc0"),
+                                   lambda d: d.__setitem__("a", 1)))
+    ds_b.set_doc("doc1", am.change(ds_b.get_doc("doc1"),
+                                   lambda d: d.__setitem__("b", 2)))
+    pipe.pump(pb.receive_msg, pa.receive_msg)
+    assert am.to_json(ds_a.get_doc("doc1")) == am.to_json(ds_b.get_doc("doc1"))
+    assert am.to_json(ds_a.get_doc("doc0")) == am.to_json(ds_b.get_doc("doc0"))
+
+
+def test_no_speculative_changes_for_unrevealed_doc():
+    """A peer that revealed a clock for doc A must still only get an
+    advertisement for a new doc B (Connection's unknown-peer behavior)."""
+    ds = DocSet()
+    hub = SyncHub(ds)
+    box = []
+    h = hub.add_peer("p", box.append)
+    hub.open()
+    ds.set_doc("A", am.change(am.init("alice"), lambda d: d.__setitem__("a", 1)))
+    h.receive_msg({"docId": "A", "clock": {}})
+    assert [m["docId"] for m in box if m.get("changes")] == ["A"]
+    box.clear()
+    ds.set_doc("B", am.change(am.init("bob"), lambda d: d.__setitem__("b", 2)))
+    assert [m for m in box if m.get("changes")] == [], box
+    assert any(m["docId"] == "B" and "changes" not in m for m in box)
+
+
+def test_readded_peer_syncs_fresh():
+    ds = DocSet()
+    hub = SyncHub(ds)
+    box = []
+    h = hub.add_peer("q", box.append)
+    hub.open()
+    ds.set_doc("D", am.change(am.init("alice"), lambda d: d.__setitem__("x", 1)))
+    h.receive_msg({"docId": "D", "clock": {}})
+    assert any(m.get("changes") for m in box)
+    hub.remove_peer("q")
+    box2 = []
+    h2 = hub.add_peer("q", box2.append)
+    h2.receive_msg({"docId": "D", "clock": {}})
+    assert any(m.get("changes") for m in box2), box2
+
+
+def test_removed_doc_neither_crashes_nor_resurrects():
+    ds = DocSet()
+    hub = SyncHub(ds)
+    box = []
+    h = hub.add_peer("p", box.append)
+    hub.open()
+    ds.set_doc("D", am.change(am.init("alice"), lambda d: d.__setitem__("x", 1)))
+    h.receive_msg({"docId": "D", "clock": {}})
+    ds.remove_doc("D")
+    box.clear()
+    # unrelated doc change must not crash on the removed doc
+    ds.set_doc("E", am.change(am.init("bob"), lambda d: d.__setitem__("y", 2)))
+    assert any(m["docId"] == "E" for m in box)
+    # a peer advertising the removed doc must not trigger a re-request
+    box.clear()
+    h.receive_msg({"docId": "D", "clock": {"alice": 1}})
+    assert [m for m in box if m["docId"] == "D"] == [], box
+
+
+def test_covered_clock_pair_leaves_pending():
+    """A pair whose raw clock is behind but transitively covered is
+    recorded as caught-up after one flush (no perpetual re-diffing)."""
+    ds = DocSet()
+    hub = SyncHub(ds)
+    h = hub.add_peer("p", lambda m: None)
+    hub.open()
+    a = am.change(am.init("alice"), lambda d: d.__setitem__("x", 1))
+    b = am.merge(am.init("bob"), a)
+    b = am.change(b, lambda d: d.__setitem__("y", 2))
+    ds.set_doc("D", b)
+    # peer reveals only bob's seq: transitively covers alice's change
+    h.receive_msg({"docId": "D", "clock": {"bob": 1}})
+    assert ("p", "D") not in hub._matrix.pending()
+
+
+def test_missing_changes_fast_cover_path():
+    """A peer whose clock covers the doc gets [] without a closure walk."""
+    from automerge_tpu.backend import device as db
+    d = am.change(am.init("alice"), lambda doc: doc.__setitem__("x", 1))
+    d = am.change(d, lambda doc: doc.__setitem__("y", 2))
+    state = Frontend.get_backend_state(d)
+    assert db.get_missing_changes(state, dict(state.clock)) == []
+    missing = db.get_missing_changes(state, {"alice": 1})
+    assert len(missing) == 1 and missing[0]["seq"] == 2
+    assert len(db.get_missing_changes(state, {})) == 2
