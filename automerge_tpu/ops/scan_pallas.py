@@ -84,7 +84,7 @@ def _fused_kernel(n_ref, chain_ref, has_ref, rank_ref, head_ref, cv_ref,
         carry[2] = 0   # visibility running total
 
     n_elems = n_ref[0]
-    base = i * TILE
+    base = n_ref[1] + i * TILE   # n_ref[1]: the caller's global slot offset
     chain = chain_ref[:]
     has = has_ref[:]
 
@@ -115,7 +115,8 @@ def _fused_kernel(n_ref, chain_ref, has_ref, rank_ref, head_ref, cv_ref,
 
 
 @partial(jax.jit, static_argnames=("interpret",))
-def fused_segment_scans(chain, has_value, n_elems, *, interpret: bool = False):
+def fused_segment_scans(chain, has_value, n_elems, base=0, *,
+                        interpret: bool = False):
     """-> (rank_incl, seg_head, cumvis), all int32[C], inclusive scans.
 
     rank_incl[i] = number of segment starts at slots <= i (the condensed-tree
@@ -124,6 +125,10 @@ def fused_segment_scans(chain, has_value, n_elems, *, interpret: bool = False):
     skip-list-index replacement). Any capacity works; inputs pad internally
     to a tile multiple (engine buckets are 2^k or 3*2^(k-1), not all tile
     multiples) and the outputs are sliced back.
+
+    `base` is the caller's global slot offset: a shard of a larger table
+    passes its start so head/is_elem masking use GLOBAL slot numbers (the
+    sharded form exchanges carries across shards — `sharded_fused_scans`).
     """
     C0 = chain.shape[0]
     C = ((C0 + TILE - 1) // TILE) * TILE
@@ -155,7 +160,48 @@ def fused_segment_scans(chain, has_value, n_elems, *, interpret: bool = False):
         out_shape=[jax.ShapeDtypeStruct(shape2d, jnp.int32)] * 3,
         scratch_shapes=[pltpu.SMEM((3,), jnp.int32)],
         interpret=interpret,
-    )(jnp.asarray([n_elems], jnp.int32),
+    )(jnp.stack([jnp.asarray(n_elems, jnp.int32),
+                 jnp.asarray(base, jnp.int32)]),
       chain.reshape(shape2d), has_value.reshape(shape2d))
     rank, head, cumvis = (o.reshape(C)[:C0] for o in out)
     return rank, head, cumvis
+
+
+def sharded_fused_scans(mesh, chain, has_value, n_elems, *, axis: str = "elem",
+                        interpret: bool = False):
+    """`fused_segment_scans` over an element-sharded table: each device
+    scans its shard locally (SMEM carries within the shard), then the three
+    per-shard totals exchange over ICI — one tiny all_gather — and offset
+    the local results. This is the sharded long-sequence form promised in
+    ops/scan.py: the per-block carry becomes an explicit collective instead
+    of XLA gathering the whole table for an unpartitionable scan.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    C = chain.shape[0]
+    n_shards = mesh.shape[axis]
+    if C % n_shards:
+        raise ValueError(f"capacity {C} must divide over {n_shards} shards")
+
+    def local(chain_s, has_s, n_elems_s):
+        idx = jax.lax.axis_index(axis)
+        base = idx * (C // n_shards)
+        rank, head, cumvis = fused_segment_scans(
+            chain_s, has_s, n_elems_s[0], base, interpret=interpret)
+        totals = jnp.stack([rank[-1], head[-1], cumvis[-1]])
+        # the carry exchange: every shard learns every prior shard's totals
+        all_tot = jax.lax.all_gather(totals, axis)        # (n_shards, 3)
+        pre = jnp.where(jnp.arange(n_shards)[:, None] < idx, all_tot, 0)
+        rank_pre = jnp.sum(pre[:, 0])
+        vis_pre = jnp.sum(pre[:, 2])
+        head_pre = jnp.max(jnp.where(
+            jnp.arange(n_shards) < idx, all_tot[:, 1], 0))
+        return (rank + rank_pre, jnp.maximum(head, head_pre),
+                cumvis + vis_pre)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(axis), P(axis), P()),
+                   out_specs=(P(axis), P(axis), P(axis)),
+                   check_vma=False)  # pallas_call outputs carry no vma info
+    return fn(chain, has_value, jnp.asarray([n_elems], jnp.int32))

@@ -52,11 +52,13 @@ class ClockMatrix:
         self._peers = _Interner()
         self._ours = np.zeros((0, 0), np.int64)
         self._theirs = np.zeros((0, 0, 0), np.int64)
+        self._active = np.zeros((0, 0), bool)   # (peer, doc) servable pairs
 
     def _sync_shapes(self):
         d, a, p = len(self._docs), len(self._actors), len(self._peers)
         self._ours = _grow(self._ours, (d, a))
         self._theirs = _grow(self._theirs, (p, d, a))
+        self._active = _grow(self._active, (p, d))
 
     def update_ours(self, doc_id: str, clock: dict):
         di = self._docs(doc_id)
@@ -96,19 +98,31 @@ class ClockMatrix:
         return {self._actors.items[i]: int(s)
                 for i, s in enumerate(row) if s > 0}
 
+    def set_active(self, peer_id: str, doc_id: str, flag: bool = True):
+        """Mark a (peer, doc) pair servable: only active pairs can appear
+        in `pending()`. Keeps unrevealed/removed pairs out of the
+        comparison entirely (otherwise they would be re-flagged forever)."""
+        pi = self._peers(peer_id)
+        di = self._docs(doc_id)
+        self._sync_shapes()
+        self._active[pi, di] = flag
+
     def reset_peer(self, peer_id: str):
-        """Forget a peer's believed clocks (it may reconnect fresh later;
-        update_theirs is monotone max, so zeroing is the only way back)."""
+        """Forget a peer's believed clocks and deactivate its pairs (it may
+        reconnect fresh later; update_theirs is monotone max, so zeroing is
+        the only way back)."""
         pi = self._peers.idx.get(peer_id)
         if pi is not None and pi < self._theirs.shape[0]:
             self._theirs[pi] = 0
+        if pi is not None and pi < self._active.shape[0]:
+            self._active[pi] = False
 
     def pending(self) -> list:
-        """All (peer_id, doc_id) pairs where the peer is missing changes:
-        ONE vectorized comparison over every peer, doc, and actor."""
+        """All ACTIVE (peer_id, doc_id) pairs where the peer is missing
+        changes: ONE vectorized comparison over every peer, doc, actor."""
         self._sync_shapes()
         if not self._theirs.size:
             return []
-        needy = (self._theirs < self._ours[None]).any(axis=2)
+        needy = (self._theirs < self._ours[None]).any(axis=2) & self._active
         return [(self._peers.items[p], self._docs.items[d])
                 for p, d in zip(*np.nonzero(needy))]

@@ -91,11 +91,7 @@ class SyncHub:
         self._peers[peer_id].send_msg({"docId": doc_id, "clock": clock})
 
     def doc_changed(self, doc_id: str, doc):
-        state = Frontend.get_backend_state(doc)
-        if state is None:
-            raise TypeError(
-                "This object cannot be used for network sync. Are you "
-                "trying to sync a snapshot from the history?")
+        state = self._state(doc_id)
         if not less_or_equal(self._matrix.our_clock(doc_id), state.clock):
             raise ValueError("Cannot pass an old state object to a connection")
         self._had_doc.add(doc_id)
@@ -140,6 +136,7 @@ class SyncHub:
         if msg.get("clock") is not None:
             # an empty clock still registers the peer for this doc
             self._revealed.add((peer_id, doc_id))
+            self._matrix.set_active(peer_id, doc_id)
             self._matrix.update_theirs(peer_id, doc_id, msg["clock"])
         if msg.get("changes"):
             return self._doc_set.apply_changes(doc_id, msg["changes"])

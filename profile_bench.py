@@ -1,8 +1,10 @@
 """Stage + per-kernel profiling of the headline bench (not part of the suite).
 
-Two modes:
-  python profile_bench.py          # wall timers per stage
-  python profile_bench.py --trace  # jax.profiler device trace -> top ops
+Modes:
+  python profile_bench.py           # wall timers per stage
+  python profile_bench.py --trace   # jax.profiler device trace -> top ops
+  python profile_bench.py --pallas  # A/B: XLA scan chain vs Pallas fused
+                                    # kernel at bench shapes (real chip)
 
 NOTE (docs/PROFILE_r3.md): on this runtime `block_until_ready` is lazy —
 only a data fetch (np.asarray) reliably flushes and waits, so stage wall
@@ -85,7 +87,63 @@ def device_trace(batch):
             print(f"{dur/1e3:10.2f} ms  {name[:90]}")
 
 
+def pallas_ab():
+    """XLA stacked-cumsum scans (production path) vs the Pallas fused
+    kernel, at headline-bench shapes, via the device profiler (wall block
+    timings are unreliable on this runtime — docs/PROFILE_r3.md)."""
+    import glob
+    import gzip
+    import json as _json
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from automerge_tpu.ops.scan_pallas import fused_segment_scans
+
+    C = 6_291_456
+    n_elems = 6_000_000
+    rng = np.random.default_rng(0)
+    chain = jnp.asarray(rng.random(C) > (30_000 / C))
+    has = jnp.asarray(np.ones(C, bool))
+
+    @jax.jit
+    def xla_scans(chain, has):
+        idx = jnp.arange(C, dtype=jnp.int32)
+        is_elem = (idx >= 1) & (idx <= n_elems)
+        seg_start = is_elem & ~chain
+        vis = has & is_elem
+        two = jnp.cumsum(jnp.stack([seg_start.astype(jnp.int32),
+                                    vis.astype(jnp.int32)]), axis=1)
+        head = jax.lax.cummax(jnp.where(seg_start, idx, 0))
+        return two[0], head, two[1]
+
+    for name, fn in (("xla_scan_chain", lambda: xla_scans(chain, has)),
+                     ("pallas_fused", lambda: fused_segment_scans(
+                         chain, has, n_elems))):
+        np.asarray(fn()[0])  # compile + drain
+        os.system("rm -rf /tmp/jxtrace_ab")
+        jax.profiler.start_trace("/tmp/jxtrace_ab")
+        out = fn()
+        np.asarray(out[0])   # force flush+exec
+        jax.profiler.stop_trace()
+        total = 0
+        for f in glob.glob("/tmp/jxtrace_ab/**/*.trace.json.gz",
+                           recursive=True):
+            with gzip.open(f, "rt") as fh:
+                data = _json.load(fh)
+            pids = {e["pid"]: e["args"].get("name", "")
+                    for e in data.get("traceEvents", [])
+                    if e.get("ph") == "M" and e.get("name") == "process_name"}
+            total += sum(e["dur"] for e in data.get("traceEvents", [])
+                         if e.get("ph") == "X"
+                         and "TPU" in pids.get(e.get("pid"), ""))
+        print(f"{name}: device total {total / 1e3:.2f} ms")
+
+
 if __name__ == "__main__":
+    if "--pallas" in sys.argv:
+        pallas_ab()
+        sys.exit(0)
     batch = merge_batch("bench-text", N_ACTORS, OPS_PER_CHANGE, BASE_LEN)
     run_once(batch)  # warm compiles
     if "--trace" in sys.argv:

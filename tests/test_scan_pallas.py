@@ -42,3 +42,31 @@ def test_empty_doc():
     rank, head, cumvis = fused_segment_scans(
         jnp.zeros(C, bool), jnp.zeros(C, bool), 0, interpret=True)
     assert int(rank[-1]) == 0 and int(head[-1]) == 0 and int(cumvis[-1]) == 0
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_sharded_carries_match_unsharded(seed):
+    """The sharded form: per-shard Pallas scans + one all_gather carry
+    exchange over the elem mesh axis == the single-device scans. This is
+    the long-sequence building block (per-block carries as explicit
+    collectives instead of XLA gathering the whole table)."""
+    import jax
+    from automerge_tpu.ops.scan_pallas import sharded_fused_scans
+    from automerge_tpu.parallel import make_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    mesh = make_mesh(doc_axis=1)
+    n_dev = mesh.shape["elem"]
+    rng = np.random.default_rng(seed)
+    C = TILE * n_dev            # one tile per shard
+    n_elems = int(rng.integers(C // 2, C - 1))
+    chain = rng.random(C) < 0.7
+    chain[0] = False
+    has = rng.random(C) < 0.8
+    rank_s, head_s, cv_s = sharded_fused_scans(
+        mesh, jnp.asarray(chain), jnp.asarray(has), n_elems, interpret=True)
+    assert len(rank_s.sharding.device_set) == n_dev
+    r_rank, r_head, r_cumvis = reference(chain, has, n_elems)
+    np.testing.assert_array_equal(np.asarray(rank_s), r_rank)
+    np.testing.assert_array_equal(np.asarray(head_s), r_head)
+    np.testing.assert_array_equal(np.asarray(cv_s), r_cumvis)

@@ -34,6 +34,7 @@ def test_clock_matrix_pending_is_batched():
         m.update_ours(f"doc{d}", {"alice": 2, "bob": 1})
     for p in range(4):
         for d in range(3):
+            m.set_active(f"peer{p}", f"doc{d}")
             m.update_theirs(f"peer{p}", f"doc{d}", {"alice": 2, "bob": 1})
     assert m.pending() == []
     m.update_ours("doc1", {"alice": 3})
@@ -186,6 +187,22 @@ def test_removed_doc_neither_crashes_nor_resurrects():
     box.clear()
     h.receive_msg({"docId": "D", "clock": {"alice": 1}})
     assert [m for m in box if m["docId"] == "D"] == [], box
+
+
+def test_unrevealed_and_removed_pairs_never_enter_pending():
+    """pending() must not re-flag pairs flush() can never serve."""
+    ds = DocSet()
+    hub = SyncHub(ds)
+    h = hub.add_peer("p", lambda m: None)
+    hub.open()
+    ds.set_doc("A", am.change(am.init("alice"), lambda d: d.__setitem__("a", 1)))
+    ds.set_doc("B", am.change(am.init("bob"), lambda d: d.__setitem__("b", 2)))
+    h.receive_msg({"docId": "A", "clock": {}})
+    # B was never revealed by the peer: only caught-up A pairs exist
+    assert hub._matrix.pending() == []
+    hub.remove_peer("p")
+    ds.set_doc("A", am.change(ds.get_doc("A"), lambda d: d.__setitem__("a2", 3)))
+    assert hub._matrix.pending() == []
 
 
 def test_covered_clock_pair_leaves_pending():
