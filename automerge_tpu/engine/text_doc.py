@@ -86,6 +86,12 @@ class DeviceTextDoc(CausalDeviceDoc):
     use_condensed = True  # chain-condensed linearization (set False to force
     # the element-wise kernel; parity tests exercise both)
 
+    eager_materialize = False  # fuse the dense merge round and the codes
+    # materialization into ONE device program (merge_and_materialize_dense):
+    # halves launch/flush overhead for merge->read cycles (the headline
+    # bench's shape); costs a wasted materialization when many rounds land
+    # between reads, hence opt-in per instance
+
     _TABLE_KEYS = ("parent", "ctr", "actor", "value", "has_value",
                    "win_actor", "win_seq", "win_counter", "chain")
 
@@ -382,10 +388,24 @@ class DeviceTextDoc(CausalDeviceDoc):
         dev = self._ensure_dev()
         tables = tuple(dev[k] for k in self._TABLE_KEYS)
 
+        fused_mat = None
         if plan.n_runs:
             if plan.dense:
-                tables = expand_runs_dense_packed(
-                    *tables, plan.desc, plan.blob, out_cap=out_cap)
+                if (self.eager_materialize and self.use_condensed
+                        and plan.n_res == 0):
+                    from ..ops.ingest import merge_and_materialize_dense
+                    S, L, as_u8 = self._mat_params(
+                        seg_bound=self._seg_bound + plan.seg_inc,
+                        n_elems=plan.n_elems_after, cap=out_cap,
+                        ascii_=self.all_ascii and not plan.ascii_clear)
+                    out = merge_and_materialize_dense(
+                        *tables, plan.desc, plan.blob, out_cap=out_cap,
+                        S=S, as_u8=as_u8, L=L)
+                    tables = out[:9]
+                    fused_mat = (out[9], out[10], S)
+                else:
+                    tables = expand_runs_dense_packed(
+                        *tables, plan.desc, plan.blob, out_cap=out_cap)
             else:
                 tables = expand_runs_packed(
                     *tables, plan.desc, plan.blob, out_cap=out_cap)
@@ -422,6 +442,10 @@ class DeviceTextDoc(CausalDeviceDoc):
         # every inserted run/element can split at most one existing segment
         self._seg_bound += plan.seg_inc
         self._invalidate()
+        if fused_mat is not None:
+            # the fused program already materialized codes for this state
+            self._mat = (fused_mat[0], fused_mat[1])
+            self._mat_S = fused_mat[2]
 
         if slow_info_np is not None and slow_info_np[0].any():
             res_kind, res_vals, res_rank, res_seq = plan.res_host
@@ -446,21 +470,32 @@ class DeviceTextDoc(CausalDeviceDoc):
         defensively."""
         if self._mat is not None and (len(self._mat) == 3 or not with_pos):
             return self._mat
-        from ..ops.ingest import bucket
-        S = bucket(self._seg_bound + 2, 64)
+        S = self._mat_params()[0]
         self._mat = self._run_materialize(with_pos, S)
         self._mat_S = S
         self._scal = None
         return self._mat
 
+    def _mat_params(self, seg_bound=None, n_elems=None, cap=None,
+                    ascii_=None):
+        """(S, L, as_u8) kernel sizing, shared by the lazy materialize and
+        the fused eager path (which sizes for post-round state)."""
+        from ..ops.ingest import bucket
+        seg_bound = self._seg_bound if seg_bound is None else seg_bound
+        n_elems = self.n_elems if n_elems is None else n_elems
+        cap = self._cap if cap is None else cap
+        ascii_ = self.all_ascii if ascii_ is None else ascii_
+        # the kernel slices the columns to the live-window bucket L:
+        # capacity can exceed the live prefix by up to 50% and every pass
+        # scales with operand length
+        return (bucket(seg_bound + 2, 64), min(bucket(n_elems + 2), cap),
+                ascii_)
+
     def _run_materialize(self, with_pos: bool, S: int):
-        from ..ops.ingest import bucket, materialize_codes, materialize_text
+        from ..ops.ingest import materialize_codes, materialize_text
         dev = self._ensure_dev()
         fn = materialize_text if with_pos else materialize_codes
-        # the kernel slices the columns to the live-window bucket: capacity
-        # can exceed the live prefix by up to 50% and every pass scales
-        # with operand length
-        L = min(bucket(self.n_elems + 2), self._cap)
+        _, L, as_u8 = self._mat_params()
         # use the staged device mirror of n_elems when current (avoids a
         # commit-path host->device scalar upload)
         if self._n_elems_dev and self._n_elems_dev[0] == self.n_elems:
@@ -469,7 +504,7 @@ class DeviceTextDoc(CausalDeviceDoc):
             n = np.int32(self.n_elems)
         return fn(dev["parent"], dev["ctr"], dev["actor"], dev["value"],
                   dev["has_value"], dev["chain"], n,
-                  S=S, as_u8=self.all_ascii, L=L)
+                  S=S, as_u8=as_u8, L=L)
 
     def _scalars(self) -> np.ndarray:
         """Fetch [n_vis, n_segs] of the cached materialization (the one

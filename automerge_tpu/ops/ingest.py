@@ -409,6 +409,32 @@ def apply_map_round(
         op_value, op_win_actor, op_win_seq, conflict_slots, out_cap)
 
 
+@partial(jax.jit, static_argnames=("out_cap", "S", "as_u8", "L"))
+def merge_and_materialize_dense(
+    parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
+    chain, desc, blob, *, out_cap: int, S: int, as_u8: bool, L: int,
+):
+    """The common-case merge round END TO END in one device program:
+    `expand_runs_dense_packed` (with fused chain breaks) followed by the
+    codes-only materialization. One launch instead of two — launch/flush
+    overhead is a measurable slice of the commit path on remote-attached
+    chips, and XLA can overlap the phases' elementwise work.
+
+    Returns the 9 updated tables + (codes, scalars). n_elems for the
+    materialization comes from the descriptor META row (base_slot +
+    n_run_elems - 1), so the call uploads nothing."""
+    tables = expand_runs_dense_packed(
+        parent, ctr, actor, value, has_value, win_actor, win_seq,
+        win_counter, chain, desc, blob, out_cap=out_cap)
+    n_elems = (desc[DESC_META, META_BASE_SLOT]
+               + desc[DESC_META, META_N_ELEMS] - 1)
+    cols = _slice_live((tables[0], tables[1], tables[2], tables[3],
+                        tables[4], tables[8]), L)
+    codes, scalars = _materialize_core(*cols, n_elems, S, with_pos=False,
+                                       as_u8=as_u8)
+    return tables + (codes, scalars)
+
+
 @jax.jit
 def remap_ranks(win_actor, remap):
     """Re-rank the winner-actor column after an interning order change."""

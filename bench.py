@@ -110,6 +110,7 @@ def run_once(batch):
     materialization + the one scalar-fetch sync (timed). Correctness of the
     materialized text is asserted untimed."""
     doc = DeviceTextDoc("bench-text")
+    doc.eager_materialize = True   # merge + materialize as ONE program
     doc.apply_batch(base_batch("bench-text", BASE_LEN))
     doc.text()
     t0 = time.perf_counter()

@@ -115,6 +115,31 @@ def test_prepare_rejects_invalid_batch_without_damage():
     doc.apply_batch(build_batch(CONCURRENT))
 
 
+def test_eager_materialize_matches_lazy():
+    """The fused merge+materialize program (eager_materialize) must produce
+    the same text, elem ids, and subsequent-edit behavior as the lazy
+    two-program path."""
+    lazy = seed_doc()
+    eager = seed_doc()
+    eager.eager_materialize = True
+    batch_a = [typing_change("alice", 1, {"base": 1}, "AAAA", 100, "base:5")]
+    batch_b = [typing_change("bob", 1, {"base": 1, "alice": 1}, "BB", 200,
+                             "alice:101")]
+    for b in (batch_a, batch_b):
+        lazy.apply_changes(list(b))
+        eager.apply_changes(list(b))
+        assert eager.text() == lazy.text()
+    assert eager.elem_ids() == lazy.elem_ids()
+    # the two-phase path takes the fused branch too
+    lazy2 = seed_doc()
+    eager2 = seed_doc()
+    eager2.eager_materialize = True
+    for doc in (lazy2, eager2):
+        prepared = doc.prepare_batch(build_batch(batch_a))
+        doc.commit_prepared(prepared)
+    assert eager2.text() == lazy2.text()
+
+
 def test_duplicate_delivery_through_prepare():
     """Re-preparing an already-applied batch admits nothing (idempotent)."""
     doc = seed_doc()
