@@ -23,7 +23,9 @@ from .frontend import (  # noqa: F401
     Counter, Frontend, Table, Text, can_redo, can_undo, get_actor_id,
     get_conflicts, get_object_by_id, get_object_id, set_actor_id,
 )
-from .sync import Connection, DocSet, WatchableDoc  # noqa: F401
+from .sync import (  # noqa: F401
+    ClockMatrix, Connection, DocSet, SyncHub, WatchableDoc,
+)
 
 __version__ = "0.1.0"
 

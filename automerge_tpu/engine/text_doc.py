@@ -107,6 +107,7 @@ class DeviceTextDoc(CausalDeviceDoc):
         self._seg_bound = 2                   # upper bound for S sizing
         self._mat = None                      # materialization cache (device)
         self._mat_S = 0                       # S the cached kernel ran with
+        self._mat_keep = False                # fused cache survives one wipe
         self._scal = None                     # fetched [n_vis, n_segs]
         self._n_elems_dev = None              # (count, device scalar) mirror
         self._pos_cache = None
@@ -134,10 +135,17 @@ class DeviceTextDoc(CausalDeviceDoc):
 
     def _invalidate(self):
         self._host = None
-        self._mat = None
         self._scal = None
         self._pos_cache = None
         self._gen += 1
+        if self._mat_keep:
+            # a just-seeded fused merge+materialize result survives exactly
+            # one invalidation: the batch driver's trailing _invalidate()
+            # (engine/base.py apply_batch / commit_prepared) runs AFTER the
+            # round that produced it, with no intervening mutation
+            self._mat_keep = False
+        else:
+            self._mat = None
 
     def _mirrors(self) -> dict:
         """Host numpy mirrors of the element tables (one packed fetch)."""
@@ -385,6 +393,7 @@ class DeviceTextDoc(CausalDeviceDoc):
 
         out_cap = plan.out_cap
         self.index = plan.index_after
+        self._mat_keep = False  # a new round stales any prior fused cache
         dev = self._ensure_dev()
         tables = tuple(dev[k] for k in self._TABLE_KEYS)
 
@@ -443,9 +452,12 @@ class DeviceTextDoc(CausalDeviceDoc):
         self._seg_bound += plan.seg_inc
         self._invalidate()
         if fused_mat is not None:
-            # the fused program already materialized codes for this state
+            # the fused program already materialized codes for this state;
+            # _mat_keep lets it survive the batch driver's trailing
+            # invalidation (no mutation happens in between)
             self._mat = (fused_mat[0], fused_mat[1])
             self._mat_S = fused_mat[2]
+            self._mat_keep = True
 
         if slow_info_np is not None and slow_info_np[0].any():
             res_kind, res_vals, res_rank, res_seq = plan.res_host

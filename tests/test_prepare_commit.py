@@ -130,13 +130,22 @@ def test_eager_materialize_matches_lazy():
         eager.apply_changes(list(b))
         assert eager.text() == lazy.text()
     assert eager.elem_ids() == lazy.elem_ids()
-    # the two-phase path takes the fused branch too
+    # the two-phase path takes the fused branch too, AND the fused cache
+    # must survive the batch driver's trailing invalidation so text()
+    # dispatches no second materialization (the point of the feature)
     lazy2 = seed_doc()
     eager2 = seed_doc()
     eager2.eager_materialize = True
     for doc in (lazy2, eager2):
         prepared = doc.prepare_batch(build_batch(batch_a))
         doc.commit_prepared(prepared)
+    assert eager2._mat is not None, "fused cache wiped by batch driver"
+    assert eager2.text() == lazy2.text()
+    # ...but a later mutating round must stale it
+    eager2.apply_changes(
+        [typing_change("carol", 1, {"base": 1}, "C", 300, "base:1")])
+    lazy2.apply_changes(
+        [typing_change("carol", 1, {"base": 1}, "C", 300, "base:1")])
     assert eager2.text() == lazy2.text()
 
 
