@@ -14,11 +14,15 @@ Scope and strategy — device-first with graduation:
   owning object's registers (map keys or list elements), mirroring the
   reference's uniform link handling (/root/reference/backend/op_set.js:196-258).
   Paths resolve host-side by walking winning link values from the root.
-- **Only undo/redo (and unknown op shapes) graduate.** Undo needs the
-  oracle's inverse-op synthesis, so such a request replays the delivery log
-  into the oracle backend (``facade.py``) and hands the lineage over.
-  Semantics are identical either way; graduation is a performance cliff,
-  not a behavior change — and it is SURFACED: each graduation logs via
+- **Undo/redo run on the device tier too**: inverse ops are captured
+  host-side at local-change apply time (from the mirrors/conflict map —
+  the reference captures inside applyAssign, op_set.js:201-213), and
+  undo/redo requests re-apply them through the normal batch path.
+- **Only unknown op shapes graduate.** A delivery containing ops outside
+  the device grammar replays the delivery log into the oracle backend
+  (``facade.py``) and hands the lineage over. Semantics are identical
+  either way; graduation is a performance cliff, not a behavior change —
+  and it is SURFACED: each graduation logs via
   ``logging.getLogger("automerge_tpu.backend.device")`` and increments the
   module-level ``GRADUATION_STATS`` counters so users can tell which tier
   served them.
@@ -55,9 +59,9 @@ _MAKE_KIND = {"makeMap": "map", "makeTable": "table",
               "makeText": "text", "makeList": "list"}
 _MAKES = tuple(_MAKE_KIND)
 
-#: How often (and why) lineages left the device tier. Keys: reason strings
-#: ("undo_redo", "out_of_scope"). Reset-able by tests; documented in
-#: docs/INTERNALS.md (graduation contract).
+#: How often (and why) lineages left the device tier. Key: reason string
+#: ("out_of_scope"). Reset-able by tests; documented in docs/INTERNALS.md
+#: (graduation contract).
 GRADUATION_STATS: dict = {}
 
 
@@ -189,8 +193,9 @@ class _DeviceCore:
         self.queue: list = []
         self.clock: dict = {}
         self.deps: dict = {}
-        self.undo_pos = 0                    # undoable local changes (device
-        # mode never pops it; actual undo graduates to the oracle)
+        self.undo_pos = 0
+        self.undo_stack: list = []           # op-lists (inverse ops)
+        self.redo_stack: list = []
         self.objects: dict = {}              # obj_id -> _TextObj | _MapObj
         self.obj_order: list = []            # creation order
         self.root = _MapObj(ROOT_ID, "map")
@@ -245,7 +250,9 @@ class _DeviceCore:
 
     def apply(self, changes, undoable: bool) -> list:
         """Admit + distribute + diff one delivery. Returns patch diffs."""
-        self.queue.extend(_clean(c) for c in changes)
+        changes = [_clean(c) for c in changes]
+        local = changes[0] if (undoable and changes) else None
+        self.queue.extend(changes)
         applied: list = []
         creations: dict = {}                 # (actor, seq) -> clock before
         while True:
@@ -261,10 +268,112 @@ class _DeviceCore:
             self.queue = rest
             if not progress:
                 break
-        if undoable:
+        if local is not None and local in applied:
+            # inverse-op capture BEFORE the change mutates field state (the
+            # reference captures inside applyAssign, op_set.js:201-213)
+            inverse: list = []
+            for op in local.get("ops", ()):
+                action = op.get("action")
+                if action == "inc":
+                    inverse.append({"action": "inc", "obj": op["obj"],
+                                    "key": op["key"], "value": -op["value"]})
+                elif action in ("set", "del", "link"):
+                    prior = self._field_ops(op["obj"], op["key"])
+                    inverse.extend(prior or [{"action": "del",
+                                              "obj": op["obj"],
+                                              "key": op["key"]}])
+            self.undo_stack = self.undo_stack[: self.undo_pos] + [inverse]
             self.undo_pos += 1
+            self.redo_stack = []   # a fresh change invalidates pending redos
         touched, created = self._distribute(applied, creations)
         return self._emit_diffs(touched, created)
+
+    # -- undo/redo (mirror of backend/index.js:258-316 + op_set undo) ---
+
+    def _field_ops(self, obj_id: str, key: str) -> list:
+        """Current surviving ops at (obj, key) as re-appliable op dicts
+        (winner first, conflicts after — the oracle's rec.keys order),
+        read from the host mirrors/conflict map. Empty if the field is
+        absent or the object unknown."""
+        if obj_id == ROOT_ID:
+            wrapper = self.root
+        else:
+            wrapper = self.objects.get(obj_id)
+            if wrapper is None:
+                return []
+        doc = wrapper.doc
+        if isinstance(wrapper, _TextObj):
+            from ..engine.host_index import pack_keys
+            from .._common import parse_elem_id
+            try:
+                actor, ctr = parse_elem_id(key)
+            except Exception:
+                return []
+            rank = doc._actor_rank.get(actor)
+            if rank is None:
+                return []
+            slots, found = doc.index.lookup(pack_keys(
+                np.asarray([rank], np.int64), np.asarray([ctr], np.int64)))
+            if not found[0]:
+                return []
+            slot = int(slots[0])
+            h = doc._mirrors()
+            decode = self._decode_text
+        else:
+            slot = doc._key_slot.get(key)
+            if slot is None:
+                return []
+            h = doc._mirrors()
+            decode = lambda w, v: self._decode_map(doc, v)  # noqa: E731
+
+        def as_op(raw: int) -> dict:
+            d = decode(wrapper, int(raw))
+            op = {"action": "link" if d.get("link") else "set",
+                  "obj": obj_id, "key": key, "value": d["value"]}
+            if d.get("datatype"):
+                op["datatype"] = d["datatype"]
+            return op
+
+        ops = []
+        if h["has_value"][slot]:
+            ops.append(as_op(int(h["value"][slot])))
+        for extra in doc.conflicts.get(slot, []):
+            ops.append(as_op(int(extra["value"])))
+        return ops
+
+    def do_undo(self, request: dict) -> list:
+        if self.undo_pos < 1:
+            raise ValueError("Cannot undo: there is nothing to be undone")
+        undo_ops = self.undo_stack[self.undo_pos - 1]
+        change = {"actor": request["actor"], "seq": request["seq"],
+                  "deps": request.get("deps", {}),
+                  "message": request.get("message"), "ops": undo_ops}
+        redo_ops = []
+        for op in undo_ops:
+            if op["action"] not in ("set", "del", "link", "inc"):
+                raise ValueError(
+                    f"Unexpected operation type in undo history: {op}")
+            if op["action"] == "inc":
+                redo_ops.append({"action": "inc", "obj": op["obj"],
+                                 "key": op["key"], "value": -op["value"]})
+            else:
+                field = self._field_ops(op["obj"], op["key"])
+                redo_ops.extend(field or [{"action": "del", "obj": op["obj"],
+                                           "key": op["key"]}])
+        self.undo_pos -= 1
+        self.redo_stack = self.redo_stack + [redo_ops]
+        return self.apply([change], False)
+
+    def do_redo(self, request: dict) -> list:
+        if not self.redo_stack:
+            raise ValueError("Cannot redo: the last change was not an undo")
+        redo_ops = self.redo_stack[-1]
+        change = {"actor": request["actor"], "seq": request["seq"],
+                  "deps": request.get("deps", {}),
+                  "message": request.get("message"), "ops": redo_ops}
+        self.undo_pos += 1
+        self.redo_stack = self.redo_stack[:-1]
+        return self.apply([change], False)
 
     def _seed_all_deps(self) -> dict:
         return {(a, i + 1): e["allDeps"]
@@ -580,6 +689,10 @@ class _DeviceCore:
         for cmd in self.commands[:version]:
             if cmd[0] == "apply":
                 clone.apply(cmd[1], cmd[2])
+            elif cmd[0] == "undo":
+                clone.do_undo(cmd[1])
+            elif cmd[0] == "redo":
+                clone.do_redo(cmd[1])
             else:  # "local"
                 clone.apply([cmd[1]], cmd[1].get("undoable", True) is not False)
             clone.commands.append(cmd)
@@ -589,8 +702,8 @@ class _DeviceCore:
         """Rebuild in place after a failed mutation (facade._restore)."""
         clean = self.fork(version)
         for slot in ("states", "history", "queue", "clock", "deps",
-                     "undo_pos", "objects", "obj_order", "root", "commands",
-                     "_cv", "actor_rank"):
+                     "undo_pos", "undo_stack", "redo_stack", "objects",
+                     "obj_order", "root", "commands", "_cv", "actor_rank"):
             setattr(self, slot, getattr(clean, slot))
 
     def graduate(self, version: int) -> _OracleState:
@@ -599,7 +712,13 @@ class _DeviceCore:
         for cmd in self.commands[:version]:
             if cmd[0] == "apply":
                 state, _ = _oracle.apply_changes(state, cmd[1])
-            else:
+            elif cmd[0] == "undo":
+                # dispatch on the tag: requests recorded through the public
+                # undo()/redo() seam need not carry a requestType
+                state, _ = _oracle.undo(state, cmd[1])
+            elif cmd[0] == "redo":
+                state, _ = _oracle.redo(state, cmd[1])
+            else:  # "local"
                 state, _ = _oracle.apply_local_change(state, cmd[1])
         return state
 
@@ -617,7 +736,7 @@ class DeviceBackendState:
         self.clock = dict(core.clock)
         self.deps = dict(core.deps)
         self.can_undo = core.undo_pos > 0
-        self.can_redo = False                # redo stack lives oracle-side
+        self.can_redo = len(core.redo_stack) > 0
         self.queue = tuple(core.queue)
         self.history_len = len(core.history)
 
@@ -702,12 +821,10 @@ def apply_local_change(state, change: dict):
         undoable = change.get("undoable", True) is not False
         new_state, patch = _device_apply(state, [change], undoable,
                                          ("local", change))
-    elif request_type in ("undo", "redo"):
-        # undo/redo synthesis needs the oracle's inverse-op capture: graduate
-        # (straight from the shared append-only log — no device fork needed)
-        _graduate_signal("undo_redo", request_type)
-        oracle_state = state._core.graduate(state._version)
-        new_state, patch = _oracle.apply_local_change(oracle_state, change)
+    elif request_type == "undo":
+        new_state, patch = undo(state, change)
+    elif request_type == "redo":
+        new_state, patch = redo(state, change)
     else:
         raise ValueError(f"Unknown requestType: {request_type}")
     patch["actor"] = change["actor"]
@@ -804,18 +921,29 @@ def merge(local, remote):
     return apply_changes(local, changes)
 
 
+def _device_undo_redo(state, request, tag: str):
+    core = state.writable_core()
+    try:
+        diffs = core.do_undo(request) if tag == "undo" \
+            else core.do_redo(request)
+    except Exception:
+        core.restore(state._version)
+        raise
+    core.commands.append((tag, request))
+    new_state = DeviceBackendState(core, len(core.commands))
+    return new_state, _make_patch(new_state, diffs)
+
+
 def undo(state, request):
     if isinstance(state, _OracleState):
         return _oracle.undo(state, request)
-    _graduate_signal("undo_redo", "undo")
-    return _oracle.undo(state._core.graduate(state._version), request)
+    return _device_undo_redo(state, request, "undo")
 
 
 def redo(state, request):
     if isinstance(state, _OracleState):
         return _oracle.redo(state, request)
-    _graduate_signal("undo_redo", "redo")
-    return _oracle.redo(state._core.graduate(state._version), request)
+    return _device_undo_redo(state, request, "redo")
 
 
 class DeviceBackend:

@@ -107,7 +107,7 @@ class DeviceTextDoc(CausalDeviceDoc):
         self._seg_bound = 2                   # upper bound for S sizing
         self._mat = None                      # materialization cache (device)
         self._mat_S = 0                       # S the cached kernel ran with
-        self._mat_keep = False                # fused cache survives one wipe
+        self._mat_keep_gen = None             # gen at fused-cache seed time
         self._scal = None                     # fetched [n_vis, n_segs]
         self._n_elems_dev = None              # (count, device scalar) mirror
         self._pos_cache = None
@@ -137,15 +137,17 @@ class DeviceTextDoc(CausalDeviceDoc):
         self._host = None
         self._scal = None
         self._pos_cache = None
-        self._gen += 1
-        if self._mat_keep:
+        if self._mat_keep_gen == self._gen:
             # a just-seeded fused merge+materialize result survives exactly
             # one invalidation: the batch driver's trailing _invalidate()
             # (engine/base.py apply_batch / commit_prepared) runs AFTER the
-            # round that produced it, with no intervening mutation
-            self._mat_keep = False
+            # round that produced it. The seed-generation stamp guarantees
+            # NOTHING intervened (any other mutation — including the
+            # failure paths' bare _gen bumps — moves _gen first).
+            self._mat_keep_gen = None
         else:
             self._mat = None
+        self._gen += 1
 
     def _mirrors(self) -> dict:
         """Host numpy mirrors of the element tables (one packed fetch)."""
@@ -393,7 +395,7 @@ class DeviceTextDoc(CausalDeviceDoc):
 
         out_cap = plan.out_cap
         self.index = plan.index_after
-        self._mat_keep = False  # a new round stales any prior fused cache
+        self._mat_keep_gen = None  # a new round stales any prior fused cache
         dev = self._ensure_dev()
         tables = tuple(dev[k] for k in self._TABLE_KEYS)
 
@@ -453,11 +455,11 @@ class DeviceTextDoc(CausalDeviceDoc):
         self._invalidate()
         if fused_mat is not None:
             # the fused program already materialized codes for this state;
-            # _mat_keep lets it survive the batch driver's trailing
-            # invalidation (no mutation happens in between)
+            # the seed-generation stamp lets it survive the batch driver's
+            # trailing invalidation (no mutation happens in between)
             self._mat = (fused_mat[0], fused_mat[1])
             self._mat_S = fused_mat[2]
-            self._mat_keep = True
+            self._mat_keep_gen = self._gen
 
         if slow_info_np is not None and slow_info_np[0].any():
             res_kind, res_vals, res_rank, res_seq = plan.res_host

@@ -78,15 +78,33 @@ class TestTextFlowsStayOnDevice:
         assert str(d["t"]) == "abcd"
         assert _am.to_json(d)["m"] == {"k": 2}
 
-    def test_undo_graduates_with_signal(self):
+    def test_undo_redo_stay_on_device(self):
         device_backend.GRADUATION_STATS.clear()
         d = init_with(device_backend.DeviceBackend, "alice")
         d = _am.change(d, lambda doc: doc.__setitem__("x", 1))
-        assert isinstance(Frontend.get_backend_state(d), DeviceBackendState)
+        d = _am.change(d, lambda doc: doc.__setitem__("x", 2))
         d = _am.undo(d)
-        assert isinstance(Frontend.get_backend_state(d), OracleState)
+        assert isinstance(Frontend.get_backend_state(d), DeviceBackendState)
+        assert _am.to_json(d) == {"x": 1}
+        d = _am.redo(d)
+        assert _am.to_json(d) == {"x": 2}
+        d = _am.undo(_am.undo(d))
         assert _am.to_json(d) == {}
-        assert device_backend.GRADUATION_STATS.get("undo_redo") == 1
+        assert isinstance(Frontend.get_backend_state(d), DeviceBackendState)
+        assert device_backend.GRADUATION_STATS == {}
+
+    def test_out_of_scope_graduates_with_signal(self):
+        device_backend.GRADUATION_STATS.clear()
+        d = init_with(device_backend.DeviceBackend, "alice")
+        d = _am.change(d, lambda doc: doc.__setitem__("x", 1))
+        state = Frontend.get_backend_state(d)
+        weird = [{"actor": "zz", "seq": 1, "deps": {},
+                  "ops": [{"action": "frobnicate", "obj": "?", "key": "k"}]}]
+        try:
+            device_backend.apply_changes(state, weird)
+        except Exception:
+            pass  # the oracle may reject it; the signal is what we test
+        assert device_backend.GRADUATION_STATS.get("out_of_scope") == 1
 
 
 def scenario_typing(be):
@@ -406,14 +424,14 @@ class TestSaveLoadHistory:
         assert any(x["action"] == "insert" for x in diffs)
 
 
-class TestUndoGraduation:
+class TestUndoOnDevice:
     def test_undo_after_device_changes(self):
         d = init_with(device_backend.DeviceBackend, "u")
         d = _am.change(d, lambda doc: doc.__setitem__("a", 1))
         d = _am.change(d, lambda doc: doc.__setitem__("a", 2))
         assert Frontend.can_undo(d)
         d = _am.undo(d)
-        assert isinstance(Frontend.get_backend_state(d), OracleState)
+        assert isinstance(Frontend.get_backend_state(d), DeviceBackendState)
         assert _am.to_json(d) == {"a": 1}
         d = _am.redo(d)
         assert _am.to_json(d) == {"a": 2}
