@@ -269,19 +269,41 @@ class _DeviceCore:
             if not progress:
                 break
         if local is not None and local in applied:
-            # inverse-op capture BEFORE the change mutates field state (the
-            # reference captures inside applyAssign, op_set.js:201-213)
+            # inverse-op capture: the reference captures inside applyAssign
+            # (op_set.js:201-213), i.e. each op sees the previous ops of the
+            # SAME change already applied. Simulate that with an as-applied
+            # overlay: a local change causally covers the whole current
+            # state, so after a set/link the register is exactly [that op],
+            # after a del it is empty, and an inc folds into covered
+            # counter values. Pre-state reads come from _field_ops.
             inverse: list = []
+            seen: dict = {}    # (obj, key) -> simulated register op list
             for op in local.get("ops", ()):
                 action = op.get("action")
+                if action not in ("set", "del", "link", "inc"):
+                    continue
+                k = (op["obj"], op["key"])
+                cur = seen.get(k)
+                if cur is None:
+                    cur = self._field_ops(op["obj"], op["key"])
                 if action == "inc":
                     inverse.append({"action": "inc", "obj": op["obj"],
                                     "key": op["key"], "value": -op["value"]})
-                elif action in ("set", "del", "link"):
-                    prior = self._field_ops(op["obj"], op["key"])
-                    inverse.extend(prior or [{"action": "del",
-                                              "obj": op["obj"],
-                                              "key": op["key"]}])
+                    seen[k] = [
+                        {**o, "value": o["value"] + op["value"]}
+                        if o.get("datatype") == "counter" else o
+                        for o in cur]
+                    continue
+                inverse.extend(cur or [{"action": "del", "obj": op["obj"],
+                                        "key": op["key"]}])
+                if action == "del":
+                    seen[k] = []
+                else:
+                    rec = {"action": action, "obj": op["obj"],
+                           "key": op["key"], "value": op["value"]}
+                    if op.get("datatype"):
+                        rec["datatype"] = op["datatype"]
+                    seen[k] = [rec]
             self.undo_stack = self.undo_stack[: self.undo_pos] + [inverse]
             self.undo_pos += 1
             self.redo_stack = []   # a fresh change invalidates pending redos

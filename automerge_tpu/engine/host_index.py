@@ -10,7 +10,8 @@ the host, where the op columns originate anyway. Two facts make this cheap:
   ((actor, ctr0) .. +len -> slot0 .. +len), not individual elements;
 - lookups are numpy ``searchsorted`` over the packed range starts — C-speed
   binary search, no device round trip, no int64 emulation on the TPU (int64
-  sorts/searches are 10-30x slower than int32 on v5e, measured).
+  sorts/searches run emulated and severalfold slower than int32 on v5e;
+  design assumption, docs/MEASUREMENTS.md).
 
 Keys pack as (actor_rank << 32 | ctr); counters stay < 2^31 so keys within a
 range are consecutive integers and slot arithmetic is a subtraction.

@@ -5,7 +5,8 @@ The reference applies ops one at a time (`applyOps`/`applyInsert`/
 order-statistic skip list for elemId<->index queries. Here one causally-ready
 *round* of changes — often millions of ops — updates the device tables in at
 most two jitted XLA programs, all int32/int8/bool (the TPU emulates int64;
-int64 sorts and searches measured 10-30x slower on v5e):
+int64 sorts/searches run emulated, severalfold slower - design
+assumption, docs/MEASUREMENTS.md):
 
 - **expand_runs**: the bulk path. Typing runs (ins+set chains with
   consecutive counters) arrive as ~20-byte descriptors plus a value blob;

@@ -9,8 +9,9 @@ batches over whole documents.
 `visible_index` runs on the XLA path (cumsum fuses well). `scan_pallas.py`
 holds the fused Pallas variant: one kernel computes the segment-rank,
 segment-head, and visibility scans in a single HBM pass with SMEM carries
-(measured at parity with XLA's fused scans on v5e — both are bandwidth
-bound — and kept as the building block for the sharded long-sequence case,
+(designed for bandwidth parity with XLA's fused scans; the on-chip A/B
+lives in profile_bench.py --pallas, see docs/MEASUREMENTS.md - and kept
+as the building block for the sharded long-sequence case,
 where the per-block carries become explicit ICI exchanges).
 """
 

@@ -16,7 +16,6 @@ from automerge_tpu import backend as oracle_backend
 from automerge_tpu import frontend as Frontend
 from automerge_tpu.backend import device as device_backend
 from automerge_tpu.backend.device import DeviceBackendState
-from automerge_tpu.backend.facade import BackendState as OracleState
 
 
 def init_with(backend, actor):
@@ -394,6 +393,78 @@ class TestRandomizedParity:
                 i, j = r.sample(range(n_actors), 2)
                 docs[i] = _am.merge(docs[i], docs[j])
                 prints.append(doc_fingerprint(docs[i]))
+            return prints
+
+        assert run(device_backend.DeviceBackend) == run(oracle_backend.Backend)
+
+
+def test_undo_same_key_twice_in_one_change_parity():
+    """Oracle capture is interleaved with application: the second assign of
+    a key in ONE change must see the first applied (device regression)."""
+    def run(be):
+        prints = []
+        d = init_with(be, "sk")
+
+        def double_set(doc):
+            doc["x"] = 1
+            doc["x"] = 2
+        d = _am.change(d, double_set)
+        d = _am.undo(d)
+        prints.append(doc_fingerprint(d))
+
+        def del_then_set(doc):
+            doc["y"] = 5
+        d = _am.change(d, del_then_set)
+
+        def mixed(doc):
+            del doc["y"]
+            doc["y"] = 7
+        d = _am.change(d, mixed)
+        d = _am.undo(d)
+        prints.append(doc_fingerprint(d))
+        d = _am.redo(d)
+        prints.append(doc_fingerprint(d))
+
+        def inc_then_set(doc):
+            doc["c"] = Frontend.Counter(10)
+        d = _am.change(d, inc_then_set)
+
+        def inc_set(doc):
+            doc["c"].increment(5)
+        d = _am.change(d, inc_set)
+        d = _am.undo(d)
+        prints.append(doc_fingerprint(d))
+        return prints
+
+    assert run(device_backend.DeviceBackend) == run(oracle_backend.Backend)
+
+
+class TestRandomizedUndoParity:
+    """Random edit/undo/redo interleavings: device vs oracle fingerprints
+    after every step (the device inverse-op capture vs the oracle's)."""
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_random_undo_history(self, seed):
+        def run(be):
+            d = init_with(be, "solo")
+            d = _am.change(d, lambda doc: doc.update({"a": 0, "b": "x"}))
+            r = random.Random(seed + 31)
+            prints = []
+            for _ in range(12):
+                op = r.random()
+                if op < 0.45:
+                    key = r.choice(["a", "b", "c"])
+                    val = r.randrange(100)
+                    d = _am.change(d, lambda doc, k=key, v=val:
+                                   doc.__setitem__(k, v))
+                elif op < 0.6 and "c" in _am.to_json(d):
+                    d = _am.change(d, lambda doc: doc.__delitem__("c"))
+                elif op < 0.8 and Frontend.can_undo(d):
+                    d = _am.undo(d)
+                elif Frontend.can_redo(d):
+                    d = _am.redo(d)
+                prints.append((doc_fingerprint(d), Frontend.can_undo(d),
+                               Frontend.can_redo(d)))
             return prints
 
         assert run(device_backend.DeviceBackend) == run(oracle_backend.Backend)
