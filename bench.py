@@ -129,6 +129,12 @@ def run_once(batch):
 
 
 def main():
+    from benchmarks.common import preflight_device
+    if not preflight_device():
+        print("bench.py: no reachable jax device (TPU tunnel down?) — "
+              "refusing to hang; see docs/PROFILE_r3.md for the last "
+              "measured numbers", file=sys.stderr)
+        return 3
     batch = merge_batch("bench-text", N_ACTORS, OPS_PER_CHANGE, BASE_LEN)
     n_ops = batch.n_ops
     run_once(batch)                 # warm-up: pays jit compiles at full shapes

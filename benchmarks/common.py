@@ -8,9 +8,30 @@ the 100M ops/s target) and as 0.0/absent otherwise.
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 RESULTS: list = []  # every emit() of the run, for the per-round record file
+
+
+def preflight_device(timeout_s: int = 150) -> bool:
+    """True iff jax can actually reach a device. When the remote TPU
+    tunnel is down, the axon plugin hangs backend init indefinitely —
+    probe in a subprocess so benchmark entry points fail FAST with a
+    clear message instead of eating the caller's whole time budget.
+    AMTPU_SKIP_PREFLIGHT=1 skips the probe (a parent already probed;
+    each probe pays a full backend init, seconds on a remote tunnel)."""
+    if os.environ.get("AMTPU_SKIP_PREFLIGHT") == "1":
+        return True
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return out.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def setup_jax_cache():

@@ -219,6 +219,11 @@ def config6_conflict_heavy(n_actors: int = 200, n_targets: int = 500):
 
 
 def main():
+    from benchmarks.common import preflight_device
+    if not preflight_device():
+        print("run_all: no reachable jax device (TPU tunnel down?) — "
+              "refusing to hang", file=sys.stderr)
+        sys.exit(3)
     quick = "--quick" in sys.argv
     record_round = None
     if "--record" in sys.argv:
@@ -234,9 +239,13 @@ def main():
         import os
         import subprocess
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "AMTPU_SKIP_PREFLIGHT": "1"}  # probed already
         out = subprocess.run(
             [sys.executable, os.path.join(root, "bench.py")],
-            capture_output=True, text=True, check=True, cwd=root)
+            capture_output=True, text=True, cwd=root, env=env)
+        if out.returncode != 0:
+            sys.stderr.write(out.stderr)
+            sys.exit(out.returncode)
         line = out.stdout.strip().splitlines()[-1]
         rec = _json.loads(line)
         from benchmarks.common import RESULTS
