@@ -402,16 +402,22 @@ class _DeviceCore:
                 for a, lst in self.states.items() for i, e in enumerate(lst)}
 
     def _distribute(self, applied, creations):
-        """Feed applied changes to the per-object device docs."""
+        """Feed applied changes to the per-object device docs.
+
+        Per-change windows (with empty sub-changes carrying causal
+        bookkeeping) are built ONLY for objects the delivery touches or
+        creates; every other object's causal state advances in bulk — one
+        dict update per doc instead of per (doc x change) Python work
+        (the nested Trellis shape has many objects, few touched)."""
         if not applied:
             return set(), []
-        feeds = {oid: [] for oid in self.objects}
-        root_feed = []
+        routed: list = []            # (change, by_obj, root_ops) per change
+        created_at: dict = {}        # obj -> index of its creating change
+        # (insertion-ordered: doubles as the created-object list)
         touched: set = set()
-        created: list = []
-        for ch in applied:
+        for idx, ch in enumerate(applied):
             by_obj: dict = {}
-            root_ops = []
+            root_ops: list = []
             for op in ch["ops"]:
                 action = op["action"]
                 obj = op["obj"]
@@ -429,8 +435,7 @@ class _DeviceCore:
                     wrapper.doc._all_deps = self._seed_all_deps()
                     self.objects[obj] = wrapper
                     self.obj_order.append(obj)
-                    feeds[obj] = []
-                    created.append(obj)
+                    created_at[obj] = idx
                 elif obj == ROOT_ID:
                     root_ops.append(op)
                 else:
@@ -447,35 +452,44 @@ class _DeviceCore:
                     if action == "ins":
                         self.objects[obj].max_elem = max(
                             self.objects[obj].max_elem, op["elem"])
-            for oid, sub in feeds.items():
-                ops = by_obj.get(oid, [])
-                sub.append(_sub_change(ch, ops))
-                if ops:
-                    touched.add(oid)
-            root_feed.append(_sub_change(ch, root_ops))
+            routed.append((ch, by_obj, root_ops))
+            touched |= by_obj.keys()
             if root_ops:
                 touched.add(ROOT_ID)
-        self._feed(self.root.doc, root_feed,
-                   active=ROOT_ID in touched)
-        for oid, sub in feeds.items():
-            self._feed(self.objects[oid].doc, sub,
-                       active=oid in touched or oid in created)
-        return touched, created
 
-    def _feed(self, doc, sub_changes, active: bool):
-        """Deliver a change window to one device doc. Docs the window never
-        touches skip device work entirely: their causal state (clock +
-        allDeps, needed for future covering checks) advances directly from
-        the backend's already-computed entries."""
-        if active:
-            doc.apply_changes(sub_changes)
-            return
-        for ch in sub_changes:
+        if ROOT_ID in touched:
+            self.root.doc.apply_changes(
+                [_sub_change(ch, root_ops) for ch, _, root_ops in routed])
+        window_ids = (touched | set(created_at)) - {ROOT_ID}
+        for oid in self.obj_order:
+            if oid not in window_ids:
+                continue
+            start = created_at.get(oid, 0)
+            self.objects[oid].doc.apply_changes(
+                [_sub_change(ch, by_obj.get(oid, []))
+                 for ch, by_obj, _ in routed[start:]])
+
+        # bulk causal advance for everything the delivery never touched:
+        # clock entries + shared (read-only) allDeps rows, needed for
+        # future covering checks
+        entries = {}
+        clock_delta: dict = {}
+        for ch in applied:
             actor, seq = ch["actor"], ch["seq"]
-            if seq > doc.clock.get(actor, 0):
-                doc.clock[actor] = seq
-            doc._all_deps[(actor, seq)] = \
-                self.states[actor][seq - 1]["allDeps"]
+            entries[(actor, seq)] = self.states[actor][seq - 1]["allDeps"]
+            if seq > clock_delta.get(actor, 0):
+                clock_delta[actor] = seq
+        quiet = [self.objects[oid].doc for oid in self.obj_order
+                 if oid not in window_ids]
+        if ROOT_ID not in touched:
+            quiet.append(self.root.doc)
+        for doc in quiet:
+            doc._all_deps.update(entries)
+            clock = doc.clock
+            for a, s in clock_delta.items():
+                if s > clock.get(a, 0):
+                    clock[a] = s
+        return touched, list(created_at)
 
     # -- diff emission (net diffs, vectorized) --------------------------
 
