@@ -46,12 +46,13 @@ class DuplicateElemId(ValueError):
 class ElemRangeIndex:
     """Sorted, coalesced (key range -> slot range) map."""
 
-    __slots__ = ("starts", "lens", "slots")
+    __slots__ = ("starts", "lens", "slots", "_slot_view")
 
     def __init__(self):
         self.starts = np.empty(0, np.int64)   # packed first key of each range
         self.lens = np.empty(0, np.int64)
         self.slots = np.empty(0, np.int64)    # device slot of the first key
+        self._slot_view = None                # lazy slot-sorted view
 
     @property
     def n_ranges(self) -> int:
@@ -104,6 +105,28 @@ class ElemRangeIndex:
         slot = np.where(found, self.slots[safe] + (keys - self.starts[safe]), 0)
         return slot, found
 
+    def slot_to_key(self, slots: np.ndarray):
+        """Reverse lookup: device slots -> (actor_rank, ctr) of the element
+        occupying each slot. Every live slot >= 1 is covered (each was
+        registered when its insert was planned); raises on a slot outside
+        every range. The slot-sorted view is cached — instances are
+        immutable after `merge` except for `remap_actors`, which drops it."""
+        view = self._slot_view
+        if view is None:
+            order = np.argsort(self.slots, kind="stable")
+            view = (self.slots[order], self.lens[order], self.starts[order])
+            self._slot_view = view
+        s_slots, s_lens, s_starts = view
+        slots = np.asarray(slots, np.int64)
+        pos = np.searchsorted(s_slots, slots, side="right") - 1
+        safe = np.clip(pos, 0, None)
+        ok = (pos >= 0) & (slots < s_slots[safe] + s_lens[safe])
+        if not ok.all():
+            raise KeyError(
+                f"slot {int(slots[np.flatnonzero(~ok)[0]])} not in index")
+        key = s_starts[safe] + (slots - s_slots[safe])
+        return key >> 32, key & 0xFFFFFFFF
+
     def remap_actors(self, remap: np.ndarray):
         """Re-rank the actor halves of the keys after interning inserted a
         new actor id below existing ones (rank order == lex order)."""
@@ -116,3 +139,4 @@ class ElemRangeIndex:
         self.starts = self.starts[order]
         self.lens = self.lens[order]
         self.slots = self.slots[order]
+        self._slot_view = None

@@ -39,12 +39,17 @@ from typing import Any, Optional
 
 import numpy as np
 
+import logging
+
 from .._common import HEAD_PARENT, KIND_SET, make_elem_id
 from .base import CausalDeviceDoc
 from .columnar import TextChangeBatch
 from .runs import detect_runs
 from .host_index import (DuplicateElemId, ElemRangeIndex, pack_keys,
                          unpack_key)
+from .segments import SegmentMirror
+
+logger = logging.getLogger("automerge_tpu.engine")
 
 
 @dataclass
@@ -66,12 +71,15 @@ class _RoundExec:
     res_host: Optional[tuple]  # (kind, val64, actor_rank, seq) per residual
     seg_inc: int
     n_elems_dev: Any = None   # staged device mirror of n_elems_after
+    mirror_after: Optional[SegmentMirror] = None  # host segment structure
+    seg_plan: Any = None      # staged (4, S) segplan matrix (fused path)
+    seg_S: int = 0            # S bucket the segplan was packed for
 
     @property
     def staged(self) -> list:
         """The round's device buffers (for transfer-completion barriers)."""
         return [x for x in (self.desc, self.blob, self.res, self.touch,
-                            self.n_elems_dev)
+                            self.n_elems_dev, self.seg_plan)
                 if x is not None]
 
 
@@ -103,6 +111,9 @@ class DeviceTextDoc(CausalDeviceDoc):
         self.all_ascii = True                 # every value ever set is 7-bit
         self.n_elems = 0                      # live element count (excl. head)
         self.index = ElemRangeIndex()         # elemId -> slot (host)
+        # host mirror of the chain/segment structure; None = degraded (the
+        # self-contained device kernels take over — see _scalars self-heal)
+        self.seg_mirror = SegmentMirror.empty()
         self._cap = bucket(max(capacity, 16))
         self._seg_bound = 2                   # upper bound for S sizing
         self._mat = None                      # materialization cache (device)
@@ -165,10 +176,14 @@ class DeviceTextDoc(CausalDeviceDoc):
             np.int32(self.n_elems))
         dev.update(actor=actor_n, win_actor=wa_n)
         self.index.remap_actors(remap.astype(np.int64))
+        if self.seg_mirror is not None:
+            # safe in place: _apply_remap invalidates, so plans derived from
+            # the pre-remap mirror can no longer commit
+            self.seg_mirror.remap_actors(remap.astype(np.int64))
 
     def _plan_shadow(self):
         """Planning shadow state threaded through multi-round preparation."""
-        return (self.n_elems, self.index, self._cap)
+        return (self.n_elems, self.index, self._cap, self.seg_mirror)
 
     def _ingest(self, b: TextChangeBatch, mask):
         """One causally-ready round of one batch: host resolution + at most
@@ -191,7 +206,7 @@ class DeviceTextDoc(CausalDeviceDoc):
                                   RES_NEW_SLOT, RES_SLOT, RES_VALUE,
                                   RES_WIN_ACTOR, RES_WIN_SEQ, bucket)
 
-        base_elems, base_index, base_cap = shadow
+        base_elems, base_index, base_cap, base_mirror = shadow
         kind = np.ascontiguousarray(b.op_kind[mask])
         n_ops = len(kind)
         if n_ops == 0:
@@ -349,41 +364,79 @@ class DeviceTextDoc(CausalDeviceDoc):
         elif n_runs == 0:
             return None, shadow
 
+        # inserted chain-heads of the round — run heads + residual inserts,
+        # with parent slot and Lamport key. ONE source of truth for both the
+        # device chain-break inputs (the touch matrix / fused dense breaks)
+        # and the host segment mirror, so the two can never desynchronize.
+        ins_slot, ins_par, ins_ctr, ins_act = [], [], [], []
+        if n_runs:
+            ins_slot.append(plan.head_slot)
+            ins_par.append(run_parent_slot)
+            ins_ctr.append(tc[hpos].astype(np.int64))
+            ins_act.append(batch_rank[ta[hpos]])
+        if n_res_ins:
+            ri = rpos[res_is_ins]
+            ins_slot.append(plan.res_new_slot[res_is_ins])
+            ins_par.append(res_parent_slot[res_is_ins])
+            ins_ctr.append(tc[ri].astype(np.int64))
+            ins_act.append(batch_rank[ta[ri]])
+
         # chain bits of elements that lost Lamport-max-child status to this
         # round's inserts (R-sized; keeps materialize census-free). The
         # dense path's breaks are fused into expand_runs_dense_packed, so
         # only mixed rounds stage a touch matrix.
         touch_dev = None
-        if not dense:
-            touch_p, touch_c, touch_a = [], [], []
-            if n_runs:
-                touch_p.append(run_parent_slot)
-                touch_c.append(tc[hpos].astype(np.int64))
-                touch_a.append(batch_rank[ta[hpos]])
-            if n_res_ins:
-                ri = rpos[res_is_ins]
-                touch_p.append(res_parent_slot[res_is_ins])
-                touch_c.append(tc[ri].astype(np.int64))
-                touch_a.append(batch_rank[ta[ri]])
-            if touch_p:
-                arr_p = np.concatenate(touch_p)
-                T = bucket(len(arr_p), 64)
-                touch = np.zeros((3, T), np.int32)
-                touch[1:] = -1
-                touch[0, : len(arr_p)] = arr_p
-                touch[1, : len(arr_p)] = np.concatenate(touch_c)
-                touch[2, : len(arr_p)] = np.concatenate(touch_a)
-                touch_dev = jnp.asarray(touch)
+        if not dense and ins_par:
+            arr_p = np.concatenate(ins_par)
+            T = bucket(len(arr_p), 64)
+            touch = np.zeros((3, T), np.int32)
+            touch[1:] = -1
+            touch[0, : len(arr_p)] = arr_p
+            touch[1, : len(arr_p)] = np.concatenate(ins_ctr)
+            touch[2, : len(arr_p)] = np.concatenate(ins_act)
+            touch_dev = jnp.asarray(touch)
+
+        # --- host segment mirror: the round's structural effect (new heads
+        # + chain breaks) is fully known here; thread it through the shadow
+        # and, when the fused planned materialization will run, stage the
+        # packed segplan so the device skips the structural S-stage
+        # entirely (engine/segments.py) ---
+        n_elems_after = base_elems + n_ins
+        mirror_after = None
+        if base_mirror is not None and n_ins == 0:
+            mirror_after = base_mirror  # no structural change (del/set/inc)
+        elif base_mirror is not None:
+            try:
+                mirror_after = base_mirror.apply_round(
+                    np.concatenate(ins_slot), np.concatenate(ins_par),
+                    np.concatenate(ins_ctr), np.concatenate(ins_act),
+                    n_elems_after, merged_index.slot_to_key)
+            except Exception:
+                logger.warning(
+                    "segment-mirror planning failed for %s; falling back to "
+                    "the self-contained materialize kernel", self.obj_id,
+                    exc_info=True)
+                mirror_after = None
+
+        seg_plan_dev = None
+        seg_S = 0
+        if (mirror_after is not None and dense and n_res == 0
+                and self.eager_materialize and self.use_condensed):
+            seg_S = bucket(mirror_after.n_segs + 2, 64)
+            seg_plan_dev = jnp.asarray(
+                mirror_after.plan(seg_S, n_elems_after))
 
         exec_plan = _RoundExec(
-            index_after=merged_index, n_elems_after=base_elems + n_ins,
+            index_after=merged_index, n_elems_after=n_elems_after,
             out_cap=out_cap, dense=dense, n_runs=n_runs,
             n_res=n_res, desc=desc_dev,
             blob=blob_dev, res=res_dev, touch=touch_dev,
             ascii_clear=ascii_clear, res_host=res_host,
             seg_inc=3 * (n_runs + n_res_ins) + 2,
-            n_elems_dev=jnp.asarray(np.int32(base_elems + n_ins)))
-        return exec_plan, (base_elems + n_ins, merged_index, out_cap)
+            n_elems_dev=jnp.asarray(np.int32(n_elems_after)),
+            mirror_after=mirror_after, seg_plan=seg_plan_dev, seg_S=seg_S)
+        return exec_plan, (n_elems_after, merged_index, out_cap,
+                           mirror_after)
 
     def _execute_plan(self, b: TextChangeBatch, plan: "_RoundExec"):
         """Commit a planned round: index/count bookkeeping + device
@@ -395,6 +448,7 @@ class DeviceTextDoc(CausalDeviceDoc):
 
         out_cap = plan.out_cap
         self.index = plan.index_after
+        self.seg_mirror = plan.mirror_after
         self._mat_keep_gen = None  # a new round stales any prior fused cache
         dev = self._ensure_dev()
         tables = tuple(dev[k] for k in self._TABLE_KEYS)
@@ -402,7 +456,22 @@ class DeviceTextDoc(CausalDeviceDoc):
         fused_mat = None
         if plan.n_runs:
             if plan.dense:
-                if (self.eager_materialize and self.use_condensed
+                if (plan.seg_plan is not None and self.eager_materialize
+                        and self.use_condensed and plan.n_res == 0):
+                    # fused merge + HOST-PLANNED materialization: no device
+                    # sort, no pointer doubling (engine/segments.py)
+                    from ..ops.ingest import merge_and_materialize_dense_planned
+                    S = plan.seg_S
+                    _, L, as_u8 = self._mat_params(
+                        seg_bound=S, n_elems=plan.n_elems_after,
+                        cap=out_cap,
+                        ascii_=self.all_ascii and not plan.ascii_clear)
+                    out = merge_and_materialize_dense_planned(
+                        *tables, plan.desc, plan.blob, plan.seg_plan,
+                        out_cap=out_cap, S=S, as_u8=as_u8, L=L)
+                    tables = out[:9]
+                    fused_mat = (out[9], out[10], S)
+                elif (self.eager_materialize and self.use_condensed
                         and plan.n_res == 0):
                     from ..ops.ingest import merge_and_materialize_dense
                     S, L, as_u8 = self._mat_params(
@@ -450,8 +519,12 @@ class DeviceTextDoc(CausalDeviceDoc):
         self._n_elems_dev = (plan.n_elems_after, plan.n_elems_dev)
         if plan.ascii_clear:
             self.all_ascii = False
-        # every inserted run/element can split at most one existing segment
-        self._seg_bound += plan.seg_inc
+        # every inserted run/element can split at most one existing segment;
+        # with a live mirror the exact count is known
+        if plan.mirror_after is not None:
+            self._seg_bound = max(plan.mirror_after.n_segs, 1)
+        else:
+            self._seg_bound += plan.seg_inc
         self._invalidate()
         if fused_mat is not None:
             # the fused program already materialized codes for this state;
@@ -506,9 +579,12 @@ class DeviceTextDoc(CausalDeviceDoc):
                 ascii_)
 
     def _run_materialize(self, with_pos: bool, S: int):
-        from ..ops.ingest import materialize_codes, materialize_text
+        import jax.numpy as jnp
+        from ..ops.ingest import (materialize_codes,
+                                  materialize_codes_planned,
+                                  materialize_text,
+                                  materialize_text_planned)
         dev = self._ensure_dev()
-        fn = materialize_text if with_pos else materialize_codes
         _, L, as_u8 = self._mat_params()
         # use the staged device mirror of n_elems when current (avoids a
         # commit-path host->device scalar upload)
@@ -516,6 +592,16 @@ class DeviceTextDoc(CausalDeviceDoc):
             n = self._n_elems_dev[1]
         else:
             n = np.int32(self.n_elems)
+        if (self.seg_mirror is not None
+                and self.seg_mirror.n_segs + 2 <= S):
+            # host-planned structure: device skips the structural S-stage
+            # (verified against the chain bits at the _scalars sync)
+            segplan = jnp.asarray(self.seg_mirror.plan(S, self.n_elems))
+            fn = (materialize_text_planned if with_pos
+                  else materialize_codes_planned)
+            return fn(dev["value"], dev["has_value"], dev["chain"], n,
+                      segplan, S=S, as_u8=as_u8, L=L)
+        fn = materialize_text if with_pos else materialize_codes
         return fn(dev["parent"], dev["ctr"], dev["actor"], dev["value"],
                   dev["has_value"], dev["chain"], n,
                   S=S, as_u8=as_u8, L=L)
@@ -531,6 +617,27 @@ class DeviceTextDoc(CausalDeviceDoc):
             while True:
                 scalars = np.asarray(self._mat[-1])
                 n_segs = int(scalars[1])
+                if len(scalars) == 4:
+                    # planned materialization: verify the host mirror against
+                    # the device-derived chain-bit count + head checksum;
+                    # self-heal through the self-contained kernel on mismatch
+                    ok = (int(scalars[2]) == n_segs
+                          and self.seg_mirror is not None
+                          and int(scalars[3])
+                          == self.seg_mirror.head_checksum())
+                    if not ok:
+                        logger.warning(
+                            "segment mirror diverged from device chain bits "
+                            "for %s (plan n_segs=%d device n_segs=%d); "
+                            "dropping mirror and re-materializing",
+                            self.obj_id, n_segs, int(scalars[2]))
+                        self.seg_mirror = None
+                        self._seg_bound = max(int(scalars[2]), 1)
+                        S = bucket(int(scalars[2]) + 2, 64)
+                        self._mat = self._run_materialize(
+                            len(self._mat) == 3, S)
+                        self._mat_S = S
+                        continue
                 if n_segs + 2 <= self._mat_S:
                     break
                 # bound was stale (defensive; should be unreachable)

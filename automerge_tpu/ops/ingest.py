@@ -601,6 +601,127 @@ def _materialize_core(parent, ctr, actor, value, has_value, chain, n_elems,
     return codes, scalars
 
 
+def _materialize_core_planned(value, has_value, chain, n_elems, segplan,
+                              S, with_pos, as_u8):
+    """Materialization with HOST-PLANNED segment structure.
+
+    `segplan` is the (4, S) int32 matrix from
+    engine/segments.SegmentMirror.plan(): [head slots, position->segment
+    permutation, segment starts, meta(n_segs)]. The host already knows the
+    chain/segment structure it staged (every head is a planned run head,
+    residual insert, or chain break), so the structural S-stage of
+    `_materialize_core` — the 4-key sort, the pointer-doubling
+    linearization, and the head searchsorted — disappears from the device
+    program. What remains is inherently data-dependent: the visibility
+    prefix sum, the S->slot expansion sum, and the codes scatter.
+
+    Trust but verify: the kernel re-derives the segment count and an
+    int32-wrapping head-slot checksum from the REAL chain bits and returns
+    them in the scalars; the engine compares them against the plan at its
+    scalar sync and self-heals through the self-contained kernel on
+    mismatch (engine/text_doc.DeviceTextDoc._scalars)."""
+    C = value.shape[0]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    is_elem = (idx >= 1) & (idx <= n_elems)
+    vis = has_value & is_elem
+    cumvis = jnp.cumsum(vis.astype(jnp.int32))
+    n_vis = cumvis[C - 1]
+
+    heads_raw = segplan[0]
+    heads = jnp.clip(heads_raw, 0, C - 1)
+    perm = segplan[1]
+    n_segs = segplan[3, 0]
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    live_seg = (sidx >= 1) & (sidx <= n_segs)
+
+    next_head = jnp.where((sidx + 1 <= n_segs) & (sidx + 1 < S),
+                          heads_raw[jnp.clip(sidx + 1, 0, S - 1)],
+                          n_elems + 1)
+    head_pre = cumvis[heads] - vis[heads].astype(jnp.int32)
+    last = jnp.clip(next_head - 1, 0, C - 1)
+    seg_vis = jnp.where(live_seg, cumvis[last] - head_pre, 0)
+
+    sv_perm = seg_vis[perm]
+    base_perm = jnp.cumsum(sv_perm) - sv_perm          # exclusive, by pos
+    rank_base = jnp.zeros(S, jnp.int32).at[perm].set(base_perm)
+    seg_base = rank_base - head_pre
+
+    def expand_S(table):
+        prev = jnp.concatenate([jnp.zeros(1, table.dtype), table[:-1]])
+        d = jnp.where(sidx == 1, table, table - prev)
+        tgt = jnp.where(live_seg, heads, C)
+        return jnp.zeros(C, table.dtype).at[tgt].set(d, mode="drop")
+
+    if with_pos:
+        starts = segplan[2]
+        d3 = jnp.stack([expand_S(seg_base), expand_S(starts),
+                        expand_S(heads)])
+        exp = jnp.cumsum(d3, axis=1)
+        sb_exp, starts_exp, seg_head_exp = exp[0], exp[1], exp[2]
+    else:
+        sb_exp = jnp.cumsum(expand_S(seg_base))
+        starts_exp = seg_head_exp = None
+    vis_rank = sb_exp + cumvis - vis.astype(jnp.int32)
+
+    if as_u8:
+        codes = jnp.zeros(C, jnp.uint8).at[
+            jnp.where(vis, vis_rank, C)].set(
+            value.astype(jnp.uint8), mode="drop")
+    else:
+        codes = jnp.full(C, -1, value.dtype).at[
+            jnp.where(vis, vis_rank, C)].set(value, mode="drop")
+
+    # plan-consistency scalars from the real chain bits (cheap reduces)
+    seg_start = is_elem & ~chain
+    n_segs_dev = jnp.sum(seg_start.astype(jnp.int32))
+    head_sum_dev = jnp.sum(jnp.where(seg_start, idx, 0))
+    scalars = jnp.stack([n_vis, n_segs, n_segs_dev, head_sum_dev])
+
+    if with_pos:
+        pos = jnp.where(is_elem, starts_exp + (idx - seg_head_exp),
+                        jnp.where(idx == 0, -1, C + 1))
+        return pos, codes, scalars
+    return codes, scalars
+
+
+@partial(jax.jit, static_argnames=("S", "as_u8", "L"))
+def materialize_text_planned(value, has_value, chain, n_elems, segplan,
+                             *, S: int, as_u8: bool = False, L: int = None):
+    """`materialize_text` with host-planned segment structure (see
+    `_materialize_core_planned`)."""
+    value, has_value, chain = _slice_live((value, has_value, chain), L)
+    return _materialize_core_planned(value, has_value, chain, n_elems,
+                                     segplan, S, with_pos=True, as_u8=as_u8)
+
+
+@partial(jax.jit, static_argnames=("S", "as_u8", "L"))
+def materialize_codes_planned(value, has_value, chain, n_elems, segplan,
+                              *, S: int, as_u8: bool = False, L: int = None):
+    """`materialize_codes` with host-planned segment structure."""
+    value, has_value, chain = _slice_live((value, has_value, chain), L)
+    return _materialize_core_planned(value, has_value, chain, n_elems,
+                                     segplan, S, with_pos=False, as_u8=as_u8)
+
+
+@partial(jax.jit, static_argnames=("out_cap", "S", "as_u8", "L"))
+def merge_and_materialize_dense_planned(
+    parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
+    chain, desc, blob, segplan, *, out_cap: int, S: int, as_u8: bool, L: int,
+):
+    """`merge_and_materialize_dense` with the materialization's segment
+    structure staged from the host plan: the whole common-case merge round
+    is ONE device program containing no sort and no pointer doubling."""
+    tables = expand_runs_dense_packed(
+        parent, ctr, actor, value, has_value, win_actor, win_seq,
+        win_counter, chain, desc, blob, out_cap=out_cap)
+    n_elems = (desc[DESC_META, META_BASE_SLOT]
+               + desc[DESC_META, META_N_ELEMS] - 1)
+    cols = _slice_live((tables[3], tables[4], tables[8]), L)
+    codes, scalars = _materialize_core_planned(
+        *cols, n_elems, segplan, S, with_pos=False, as_u8=as_u8)
+    return tables + (codes, scalars)
+
+
 def _slice_live(cols, L):
     """Restrict the element columns to the live-window bucket `L` (static):
     table capacity can exceed the live prefix by up to 50%, and every pass

@@ -1,0 +1,231 @@
+"""Host segment mirror + planned materialization (engine/segments.py).
+
+The mirror claims to know the device chain/segment structure without asking
+the device; the planned kernels claim to materialize identically to the
+self-contained ones. Both claims are checked here: structural equality
+against the real chain bits, text/elemId parity against the oracle and the
+unplanned kernels on randomized histories, the fused planned path, and the
+self-heal on a corrupted mirror.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import Text
+from automerge_tpu.engine import DeviceTextDoc, TextChangeBatch
+from automerge_tpu.engine.segments import SegmentMirror, _linearize_np
+
+from test_engine_parity import text_changes_of
+from test_prepare_commit import typing_change
+
+
+def mirror_vs_device(doc: DeviceTextDoc):
+    """Assert the host mirror equals the device chain-bit structure."""
+    assert doc.seg_mirror is not None, "mirror degraded unexpectedly"
+    chain = np.asarray(doc._ensure_dev()["chain"])
+    n = doc.n_elems
+    dev_heads = 1 + np.flatnonzero(~chain[1: n + 1])
+    np.testing.assert_array_equal(doc.seg_mirror.heads[1:], dev_heads)
+    # head Lamport keys must match the device element tables
+    h = doc._mirrors()
+    np.testing.assert_array_equal(doc.seg_mirror.hctr[1:],
+                                  h["ctr"][dev_heads])
+    np.testing.assert_array_equal(doc.seg_mirror.hactor[1:],
+                                  h["actor"][dev_heads])
+    np.testing.assert_array_equal(doc.seg_mirror.par[1:],
+                                  h["parent"][dev_heads])
+
+
+def engine_pair(changes, obj_id):
+    """The same history through a mirrored doc and a mirror-disabled doc."""
+    planned = DeviceTextDoc(obj_id)
+    planned.apply_changes(changes)
+    plain = DeviceTextDoc(obj_id)
+    plain.seg_mirror = None   # force the self-contained kernels
+    plain.apply_changes(changes)
+    return planned, plain
+
+
+def test_empty_mirror_plan():
+    m = SegmentMirror.empty()
+    seg = m.plan(64, 0)
+    assert seg.shape == (4, 64)
+    assert seg[3, 0] == 0
+
+
+def test_linearize_np_single_chain():
+    # head + one 5-element segment
+    starts = _linearize_np(np.array([0, 0]), np.array([0, 0]),
+                           np.array([0, 1]), np.array([0, 0]),
+                           np.array([0, 5]))
+    assert starts.tolist() == [0, 0]
+
+
+def test_slot_to_key_roundtrip():
+    doc = DeviceTextDoc("t")
+    doc.apply_changes([typing_change("base", 1, {}, "hello", 1, "_head")])
+    actor, ctr = doc.index.slot_to_key(np.arange(1, 6))
+    assert ctr.tolist() == [1, 2, 3, 4, 5]
+    assert (actor == actor[0]).all()
+    with pytest.raises(KeyError):
+        doc.index.slot_to_key(np.array([99]))
+
+
+def test_mirror_tracks_typing_and_concurrent_inserts():
+    doc = DeviceTextDoc("t")
+    doc.apply_changes([typing_change("base", 1, {}, "hello world", 1,
+                                     "_head")])
+    mirror_vs_device(doc)
+    assert doc.seg_mirror.n_segs == 1
+    # two concurrent runs at the same insertion point split the base chain
+    doc.apply_changes([
+        typing_change("alice", 1, {"base": 1}, "AAA", 100, "base:5"),
+        typing_change("bob", 1, {"base": 1}, "BB", 100, "base:5"),
+    ])
+    mirror_vs_device(doc)
+    # base:5 has concurrent children -> base:6 must have become a head
+    assert doc.seg_mirror.n_segs >= 3
+
+
+def test_mirror_tracks_residual_round():
+    doc = DeviceTextDoc("t")
+    doc.apply_changes([typing_change("base", 1, {}, "abcdef", 1, "_head")])
+    doc.apply_changes([{
+        "actor": "zed", "seq": 1, "deps": {"base": 1}, "ops": [
+            {"action": "del", "obj": "t", "key": "base:2"},
+            {"action": "set", "obj": "t", "key": "base:3", "value": "X"},
+            {"action": "ins", "obj": "t", "key": "base:4", "elem": 1},
+            {"action": "set", "obj": "t", "key": "zed:1", "value": "Z"},
+        ]}])
+    mirror_vs_device(doc)
+    # del hides base:2, set rewrites base:3; zed:1 (ctr 1) sorts after
+    # base:5's chain (ctr 5) among base:4's children -> Z lands after "ef"
+    assert doc.text() == "aXdefZ"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_histories_planned_equals_plain_and_oracle(seed):
+    rng = random.Random(9100 + seed)
+    n_actors = rng.randint(2, 4)
+    base = am.change(am.init("base"),
+                     lambda d: d.__setitem__("t", Text("seed")))
+    docs = [am.apply_changes(am.init(f"actor-{i}"), am.get_all_changes(base))
+            for i in range(n_actors)]
+    for _ in range(5):
+        for i in range(n_actors):
+            def edit(d):
+                t = d["t"]
+                for _ in range(rng.randrange(1, 5)):
+                    r = rng.random()
+                    if r < 0.55 or len(t) == 0:
+                        t.insert_at(rng.randint(0, len(t)),
+                                    rng.choice("abcxyz"))
+                    elif r < 0.8:
+                        t.delete_at(rng.randrange(len(t)))
+                    else:
+                        t.set(rng.randrange(len(t)), rng.choice("ABC"))
+            if rng.random() < 0.85:
+                docs[i] = am.change(docs[i], edit)
+        i, j = rng.sample(range(n_actors), 2)
+        docs[i] = am.merge(docs[i], docs[j])
+    merged = docs[0]
+    for d in docs[1:]:
+        merged = am.merge(merged, d)
+
+    changes, obj_id = text_changes_of(merged)
+    planned, plain = engine_pair(changes, obj_id)
+    mirror_vs_device(planned)
+    oracle = [e["value"] for e in merged["t"].elems]
+    assert planned.values() == plain.values() == oracle
+    assert planned.elem_ids() == plain.elem_ids()
+    assert planned.text() == plain.text()
+
+
+def test_out_of_order_and_actor_remap_keep_mirror():
+    """Actor interning reorders ranks mid-history (a lexicographically
+    earlier actor arrives late); the mirror must remap with the tables."""
+    doc = DeviceTextDoc("t")
+    doc.apply_changes([typing_change("mmm", 1, {}, "mm", 1, "_head")])
+    mirror_vs_device(doc)
+    # 'aaa' sorts before 'mmm': triggers a rank remap
+    doc.apply_changes([typing_change("aaa", 1, {"mmm": 1}, "ZZ", 50,
+                                     "mmm:1")])
+    mirror_vs_device(doc)
+    # out-of-order: seq 3 queues, then 2 arrives
+    doc.apply_changes([typing_change("aaa", 3, {}, "c", 70, "aaa:60")])
+    assert len(doc.queue) == 1
+    doc.apply_changes([typing_change("aaa", 2, {}, "b", 60, "aaa:51")])
+    assert doc.queue == []
+    mirror_vs_device(doc)
+
+
+def test_fused_planned_path_and_scalars():
+    """Dense batch + eager_materialize: the planned fused program runs
+    (4-entry scalars) and verifies clean."""
+    doc = DeviceTextDoc("t")
+    doc.eager_materialize = True
+    doc.apply_changes([typing_change("base", 1, {}, "hello world", 1,
+                                     "_head")])
+    doc.apply_batch(TextChangeBatch.from_changes([
+        typing_change("alice", 1, {"base": 1}, "AAA", 100, "base:5"),
+        typing_change("bob", 1, {"base": 1}, "BB", 100, "base:5"),
+    ], "t"))
+    scal = doc._scalars()
+    assert len(scal) == 4          # planned kernel served the read
+    assert int(scal[1]) == int(scal[2]) == doc.seg_mirror.n_segs
+    plain = DeviceTextDoc("t")
+    plain.seg_mirror = None
+    plain.apply_changes([
+        typing_change("base", 1, {}, "hello world", 1, "_head"),
+        typing_change("alice", 1, {"base": 1}, "AAA", 100, "base:5"),
+        typing_change("bob", 1, {"base": 1}, "BB", 100, "base:5")])
+    assert doc.text() == plain.text()
+    mirror_vs_device(doc)
+
+
+def test_prepare_commit_planned_matches_apply():
+    direct = DeviceTextDoc("t")
+    direct.apply_changes([typing_change("base", 1, {}, "hello world", 1,
+                                        "_head")])
+    batch = TextChangeBatch.from_changes([
+        typing_change("alice", 1, {"base": 1}, "AAA", 100, "base:5"),
+        typing_change("bob", 1, {"base": 1}, "BB", 100, "base:5"),
+    ], "t")
+    two = DeviceTextDoc("t")
+    two.eager_materialize = True
+    two.apply_changes([typing_change("base", 1, {}, "hello world", 1,
+                                     "_head")])
+    prepared = two.prepare_batch(batch)
+    two.commit_prepared(prepared)
+    direct.apply_batch(batch)
+    assert two.text() == direct.text()
+    mirror_vs_device(two)
+
+
+def test_corrupted_mirror_self_heals():
+    doc = DeviceTextDoc("t")
+    doc.apply_changes([typing_change("base", 1, {}, "hello world", 1,
+                                     "_head")])
+    doc.apply_changes([typing_change("alice", 1, {"base": 1}, "AA", 100,
+                                     "base:5")])
+    good = doc.text()
+    # corrupt: claim a bogus extra segment head
+    m = doc.seg_mirror
+    doc.seg_mirror = SegmentMirror(
+        np.append(m.heads, 3), np.append(m.par, 2),
+        np.append(m.hctr, 99), np.append(m.hactor, 0))
+    doc.seg_mirror.heads.sort()
+    doc._invalidate()
+    assert doc.text() == good      # healed through the unplanned kernel
+    assert doc.seg_mirror is None  # and the bad mirror is gone
+
+
+def test_mirror_none_fallback_matches():
+    changes = [typing_change("base", 1, {}, "abcd", 1, "_head"),
+               typing_change("eve", 1, {"base": 1}, "EE", 10, "base:2")]
+    planned, plain = engine_pair(changes, "t")
+    assert planned.text() == plain.text()
+    assert plain.seg_mirror is None
