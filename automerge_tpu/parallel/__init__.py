@@ -1,1 +1,2 @@
-from .mesh import batched_merge_step, make_mesh, sharded_merge_step  # noqa: F401
+from .mesh import (batched_merge_step, make_mesh,  # noqa: F401
+                   sharded_merge_step, sharded_planned_materialize)

@@ -5,6 +5,8 @@ Modes:
   python profile_bench.py --trace   # jax.profiler device trace -> top ops
   python profile_bench.py --pallas  # A/B: XLA scan chain vs Pallas fused
                                     # kernel at bench shapes (real chip)
+  python profile_bench.py --planned # A/B: self-contained vs host-planned
+                                    # merge+materialize at bench shapes
 
 NOTE (docs/PROFILE_r3.md): on this runtime `block_until_ready` is lazy —
 only a data fetch (np.asarray) reliably flushes and waits, so stage wall
@@ -140,11 +142,45 @@ def pallas_ab():
         print(f"{name}: device total {total / 1e3:.2f} ms")
 
 
+def planned_ab(batch):
+    """Timed-region A/B at bench shapes: host-planned segment linearization
+    (the default; engine/segments.py) vs the self-contained kernels (mirror
+    disabled). Both run the same prepare/commit/sync protocol as bench.py."""
+    def run(no_mirror: bool):
+        times = []
+        for rep in range(3):
+            doc = DeviceTextDoc("bench-text")
+            doc.eager_materialize = True
+            if no_mirror:
+                doc.seg_mirror = None
+            doc.apply_batch(base_batch("bench-text", BASE_LEN))
+            doc.text()
+            prepared = doc.prepare_batch(batch)
+            t0 = t()
+            doc.commit_prepared(prepared)
+            doc._materialize(with_pos=False)
+            scal = doc._scalars()
+            times.append(t() - t0)
+            assert int(scal[0]) == BASE_LEN + N_ACTORS * (OPS_PER_CHANGE // 2)
+            if not no_mirror:
+                assert len(scal) == 4, "planned kernel did not engage"
+        return min(times)
+
+    for name, nm in (("self-contained", True), ("host-planned", False)):
+        dt = run(nm)
+        n_ops = batch.n_ops
+        print(f"{name}: timed region {dt*1e3:8.1f} ms "
+              f"({n_ops/dt/1e6:.1f}M ops/s)")
+
+
 if __name__ == "__main__":
     if "--pallas" in sys.argv:
         pallas_ab()
         sys.exit(0)
     batch = merge_batch("bench-text", N_ACTORS, OPS_PER_CHANGE, BASE_LEN)
+    if "--planned" in sys.argv:
+        planned_ab(batch)
+        sys.exit(0)
     run_once(batch)  # warm compiles
     if "--trace" in sys.argv:
         device_trace(batch)

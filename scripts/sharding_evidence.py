@@ -25,20 +25,51 @@ COLLECTIVES = ("all-gather", "all-reduce", "all-to-all", "collective-permute",
                "reduce-scatter")
 
 
+def count_collectives(fn, args) -> dict:
+    """Compile and count collective ops in the HLO (zero-count keys dropped)."""
+    hlo = fn.lower(*args).compile().as_text()
+    counts = {c: len(re.findall(rf"\b{c}\b", hlo)) for c in COLLECTIVES}
+    return {c: n for c, n in counts.items() if n}
+
+
 def audit(mesh, n_docs, cap):
     shard = NamedSharding(mesh, P("doc", "elem"))
     fn = jax.jit(jax.vmap(merge_step), in_shardings=(shard,) * 6,
                  out_shardings=(shard, shard, NamedSharding(mesh, P("doc"))))
     tables = [jax.device_put(np.asarray(t), shard)
               for t in example_doc_tables(n_docs, cap, seed=3)]
-    compiled = fn.lower(*tables).compile()
-    hlo = compiled.as_text()
-    counts = {c: len(re.findall(rf"\b{c}\b", hlo)) for c in COLLECTIVES}
-    counts = {c: n for c, n in counts.items() if n}
+    counts = count_collectives(fn, tables)
+    hlo = fn.lower(*tables).compile().as_text()
     # largest replicated intermediate: scan for full-shape ops vs sharded
     full_shape = f"s32[{n_docs},{cap}]"
     n_full = hlo.count(full_shape + "{")  # layout-annotated full tensors
     return counts, n_full, tables, fn
+
+
+def audit_materialize(mesh_elem, cap, S):
+    """Collective audit of the codes-only materialization, one document
+    sharded along `elem`: self-contained kernel (device sort + pointer
+    doubling) vs host-planned kernel (segplan staged, no sort)."""
+    from automerge_tpu.ops.ingest import (materialize_codes,
+                                          materialize_codes_planned)
+    elem = NamedSharding(mesh_elem, P("elem"))
+    rep = NamedSharding(mesh_elem, P())
+    z32 = jax.device_put(np.zeros(cap, np.int32), elem)
+    zb = jax.device_put(np.zeros(cap, bool), elem)
+    n = jax.device_put(np.int32(cap - 2), rep)
+    segplan = jax.device_put(np.zeros((4, S), np.int32), rep)
+
+    plain = jax.jit(
+        lambda p, c, a, v, h, ch, n: materialize_codes(
+            p, c, a, v, h, ch, n, S=S),
+        in_shardings=(elem,) * 6 + (rep,), out_shardings=(elem, rep))
+    planned = jax.jit(
+        lambda v, h, ch, n, sp: materialize_codes_planned(
+            v, h, ch, n, sp, S=S),
+        in_shardings=(elem, elem, elem, rep, rep),
+        out_shardings=(elem, rep))
+    return (count_collectives(plain, (z32, z32, z32, z32, zb, zb, n)),
+            count_collectives(planned, (z32, zb, zb, n, segplan)))
 
 
 def scaling(cap_per_dev=2048, n_docs=8):
@@ -75,6 +106,9 @@ def main():
     counts_elem, full_elem, _, _ = audit(mesh_elem, n_docs=1, cap=8192)
     mesh_doc = make_mesh(doc_axis=n)
     counts_doc, _, _, _ = audit(mesh_doc, n_docs=n * 2, cap=1024)
+    counts_plain_mat, counts_planned_mat = audit_materialize(
+        mesh_elem, cap=8192, S=256)
+    mesh_elem_shape = tuple(mesh_elem.shape.items())
     rows = scaling()
 
     doc = f"""# Sharding evidence — round 3 ({n} virtual CPU devices)
@@ -109,12 +143,30 @@ document spanning every shard many times over).
 XLA's SPMD partitioner resolves the linearization's `sort` by gathering
 the sort operand across the elem axis (visible as all-gather/all-to-all
 above) — the standard behavior for unpartitionable ops. So elem-axis
-sharding today buys **memory capacity** (a document larger than one
-device's HBM) and parallel elementwise/scan phases, while the sort phase
-serializes through collectives. The designed fix is the Pallas
-fused-segment-scan building block (ops/scan_pallas.py): block-local scans
-with explicit carry exchange, avoiding the gather — wiring it into the
-sharded path is future work and is tracked in docs/PROFILE_r3.md.
+sharding of the self-contained kernel buys **memory capacity** (a document
+larger than one device's HBM) and parallel elementwise/scan phases, while
+the sort phase serializes through collectives.
+
+## Host-planned materialization removes the sort from the sharded program
+
+The planned kernel (engine/segments.py + ops/ingest.py:
+_materialize_core_planned) receives the segment structure from the host,
+so the elem-sharded compiled program has **no sort to partition at all**
+— what remains is prefix-sum carries and the codes scatter. Collective
+audit of the codes-only materialization, 1 doc x 8192 elements sharded
+over {mesh_elem_shape} (S=256):
+
+| kernel | collectives in compiled module |
+|---|---|
+| self-contained (`materialize_codes`) | {counts_plain_mat} |
+| host-planned (`materialize_codes_planned`) | {counts_planned_mat} |
+
+Parity of the sharded planned path against the single-device engine —
+including a document spanning every shard — is pinned by
+tests/test_parallel.py::test_sharded_planned_materialize_matches_engine.
+The Pallas fused-segment-scan building block (ops/scan_pallas.py:
+block-local scans with explicit carries) remains the alternative for the
+self-contained path and the sharded-carry design.
 
 ## 1-vs-{n} virtual-device scaling (same per-device work, CPU: indicative
 of distribution, not TPU rates)
