@@ -229,3 +229,51 @@ def test_mirror_none_fallback_matches():
     planned, plain = engine_pair(changes, "t")
     assert planned.text() == plain.text()
     assert plain.seg_mirror is None
+
+
+def test_same_change_cross_run_attach_in_window_break():
+    """One change types two runs where the second attaches INSIDE the first
+    (the reference allows ops to reference elemIds minted earlier in the
+    same change): the break target q = parent+1 lies in the round's own
+    slot window, exercising the mirror's in-window reverse lookup."""
+    doc = DeviceTextDoc("t")
+    ops = []
+    # run 1: "abcde" (w:1..5)
+    for i in range(1, 6):
+        key = "_head" if i == 1 else f"w:{i-1}"
+        ops.append({"action": "ins", "obj": "t", "key": key, "elem": i})
+        ops.append({"action": "set", "obj": "t", "key": f"w:{i}",
+                    "value": "abcde"[i-1]})
+    # run 2: "XY" attached after w:2 — q = slot of w:3, same window
+    for j, ch in enumerate("XY"):
+        c = 10 + j
+        key = "w:2" if j == 0 else f"w:{c-1}"
+        ops.append({"action": "ins", "obj": "t", "key": key, "elem": c})
+        ops.append({"action": "set", "obj": "t", "key": f"w:{c}",
+                    "value": ch})
+    change = {"actor": "w", "seq": 1, "deps": {}, "ops": ops}
+    planned, plain = engine_pair([change], "t")
+    mirror_vs_device(planned)
+    # ctr 10 > ctr 3 at w:2's next slot -> chain broke; XY precedes cde
+    assert planned.text() == plain.text() == "abXYcde"
+
+
+def test_multi_round_prepare_keeps_mirror():
+    """seq-2 changes depending on seq-1 in the SAME prepared batch: the
+    mirror threads through the planning shadow across rounds."""
+    concurrent = [
+        typing_change("alice", 1, {"base": 1}, "AA", 100, "base:2"),
+        typing_change("alice", 2, {"base": 1}, "BB", 200, "alice:101"),
+        typing_change("bob", 1, {"base": 1}, "Z", 300, "base:2"),
+    ]
+    doc = DeviceTextDoc("t")
+    doc.apply_changes([typing_change("base", 1, {}, "hello", 1, "_head")])
+    prepared = doc.prepare_batch(TextChangeBatch.from_changes(concurrent, "t"))
+    doc.commit_prepared(prepared)
+    mirror_vs_device(doc)
+    direct = DeviceTextDoc("t")
+    direct.seg_mirror = None
+    direct.apply_changes([typing_change("base", 1, {}, "hello", 1, "_head")])
+    direct.apply_batch(TextChangeBatch.from_changes(concurrent, "t"))
+    assert doc.text() == direct.text()
+    assert doc.elem_ids() == direct.elem_ids()
