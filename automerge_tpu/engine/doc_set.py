@@ -25,12 +25,13 @@ from .columnar import TextChangeBatch
 from .host_index import (DuplicateElemId, ElemRangeIndex, pack_keys,
                          unpack_key)
 from .runs import detect_runs
-from .text_doc import DeviceTextDoc
+from .segments import SegmentMirror
+from .text_doc import DeviceTextDoc, logger
 
 
 class _DocMeta:
     __slots__ = ("clock", "actor_table", "actor_rank", "index", "n_elems",
-                 "seg_bound", "all_ascii", "all_deps")
+                 "seg_bound", "all_ascii", "all_deps", "mirror")
 
     def __init__(self):
         self.clock: dict = {}
@@ -41,6 +42,7 @@ class _DocMeta:
         self.seg_bound = 2
         self.all_ascii = True
         self.all_deps: dict = {}   # (actor, seq) -> transitive deps clock
+        self.mirror = SegmentMirror.empty()  # host segment structure
 
 
 class DeviceTextDocSet:
@@ -141,6 +143,8 @@ class DeviceTextDocSet:
         doc._all_deps = dict(meta.all_deps)
         doc._seg_bound = meta.seg_bound
         doc.all_ascii = meta.all_ascii
+        doc.seg_mirror = meta.mirror   # None degrades to the self-contained
+        # kernels; otherwise the mirror carries over with the table slices
         self._overlay[d] = doc
         return doc
 
@@ -175,6 +179,7 @@ class DeviceTextDocSet:
         for p in fast:
             meta = self._meta[p["d"]]
             meta.index = p["staged_index"]
+            meta.mirror = p["staged_mirror"]
             meta.clock.update(p["staged_clock"])
             meta.all_deps.update(p["staged_all_deps"])
             meta.all_ascii = meta.all_ascii and p["staged_ascii"]
@@ -255,7 +260,10 @@ class DeviceTextDocSet:
         for p in fast:
             meta = self._meta[p["d"]]
             meta.n_elems += p["n_pairs"]
-            meta.seg_bound += 3 * p["n_runs"] + 2
+            if meta.mirror is not None:
+                meta.seg_bound = max(meta.mirror.n_segs, 1)
+            else:
+                meta.seg_bound += 3 * p["n_runs"] + 2
         return self
 
     def _plan_fast(self, d: int, b: TextChangeBatch):
@@ -344,8 +352,24 @@ class DeviceTextDocSet:
             staged_all_deps[(actor, seq)] = closure
             combined[(actor, seq)] = closure
 
+        # host segment mirror (same round inputs as the vmapped chain
+        # breaks below); failure degrades THIS doc to the self-contained
+        # materialize kernel, never the round itself
+        staged_mirror = None
+        if meta.mirror is not None:
+            try:
+                staged_mirror = meta.mirror.apply_round(
+                    plan.head_slot, parent_slot,
+                    tc[hpos].astype(np.int64), batch_rank[ta[hpos]],
+                    meta.n_elems + plan.n_pairs, staged_index.slot_to_key)
+            except Exception:
+                logger.warning(
+                    "segment-mirror planning failed for %s (doc-set row %d)",
+                    self.obj_ids[d], d, exc_info=True)
+
         return {
             "d": d, "n_runs": plan.n_runs, "n_pairs": plan.n_pairs,
+            "staged_mirror": staged_mirror,
             "head_slot": plan.head_slot, "parent_slot": parent_slot,
             "ctr0": tc[hpos], "actor": batch_rank[ta[hpos]],
             "win_actor": row_rank[b.op_change[hpos]],
@@ -363,10 +387,33 @@ class DeviceTextDocSet:
 
     # ------------------------------------------------------------------
 
+    def _rebuild_row_mirror(self, d: int):
+        """Heal path: reconstruct row d's segment mirror from its fetched
+        chain/parent rows (None if that fails too)."""
+        dev = self._ensure_dev()
+        meta = self._meta[d]
+        try:
+            meta.mirror = SegmentMirror.rebuild(
+                np.asarray(dev["chain"][d]), np.asarray(dev["parent"][d]),
+                meta.n_elems, meta.index.slot_to_key)
+        except Exception:
+            logger.warning("mirror rebuild failed for doc-set row %d", d,
+                           exc_info=True)
+            meta.mirror = None
+
     def texts(self) -> dict:
-        """Materialize every document: one vmapped program + one fetch."""
+        """Materialize every document: one vmapped program + one fetch.
+
+        When every stacked document has a live segment mirror, the vmapped
+        HOST-PLANNED kernel runs (no per-doc sort or pointer doubling on
+        device); per-doc plan consistency is verified against the chain
+        bits. A divergent or missing mirror is REBUILT from the real chain
+        bits (the affected call serves through the self-contained kernel;
+        the next call is planned again) and only drops to None if the
+        rebuild itself fails."""
         import jax
-        from ..ops.ingest import bucket, materialize_codes
+        from ..ops.ingest import (bucket, materialize_codes,
+                                  materialize_codes_planned)
 
         out = {}
         stacked_idx = [d for d in range(self.n_docs)
@@ -375,9 +422,30 @@ class DeviceTextDocSet:
             if self._codes_cache is None:
                 dev = self._ensure_dev()
                 all_ascii = all(self._meta[d].all_ascii for d in stacked_idx)
-                S = bucket(max(self._meta[d].seg_bound
-                               for d in stacked_idx) + 2, 64)
                 n_el = np.asarray([m.n_elems for m in self._meta], np.int32)
+                for d in stacked_idx:
+                    # a row whose plan-time mirror update failed rebuilds
+                    # here from its chain bits, so one bad round degrades
+                    # one call, not the doc-set forever
+                    if self._meta[d].mirror is None:
+                        self._rebuild_row_mirror(d)
+                planned = all(self._meta[d].mirror is not None
+                              for d in stacked_idx)
+
+                def run_planned(S):
+                    # overlay (graduated) rows ride along with an empty plan;
+                    # their stacked tables are stale and their output ignored
+                    stacked = set(stacked_idx)
+                    empty = SegmentMirror.empty()
+                    plans = np.stack([
+                        self._meta[d].mirror.plan(S, self._meta[d].n_elems)
+                        if d in stacked else empty.plan(S, 0)
+                        for d in range(self.n_docs)])
+                    return jax.vmap(
+                        lambda v, h, c, n, sp: materialize_codes_planned(
+                            v, h, c, n, sp, S=S, as_u8=all_ascii))(
+                        dev["value"], dev["has_value"], dev["chain"],
+                        self._put(n_el, "doc"), self._put(plans, "doc"))
 
                 def run(S):
                     return jax.vmap(
@@ -387,12 +455,35 @@ class DeviceTextDocSet:
                         dev["value"], dev["has_value"], dev["chain"],
                         self._put(n_el, "doc"))
 
-                codes, scalars = run(S)
-                scalars_np = np.asarray(scalars)     # (D, 2): n_vis, n_segs
-                if (scalars_np[:, 1] + 2 > S).any():
-                    S = bucket(int(scalars_np[:, 1].max()) + 2, 64)
+                if planned:
+                    S = bucket(max(self._meta[d].mirror.n_segs
+                                   for d in stacked_idx) + 2, 64)
+                    codes, scalars = run_planned(S)
+                    scalars_np = np.asarray(scalars)  # (D, 4)
+                    bad = [d for d in stacked_idx
+                           if int(scalars_np[d, 1]) != int(scalars_np[d, 2])
+                           or int(scalars_np[d, 3])
+                           != self._meta[d].mirror.head_checksum()]
+                    if bad:
+                        # rebuild diverged mirrors from the real chain bits
+                        # (a small per-row fetch; None only if that fails),
+                        # then serve THIS call via the self-contained kernel
+                        logger.warning(
+                            "segment mirror diverged for doc-set rows %s; "
+                            "rebuilding and re-materializing", bad)
+                        for d in bad:
+                            self._rebuild_row_mirror(d)
+                            self._meta[d].seg_bound = int(scalars_np[d, 2])
+                        planned = False
+                if not planned:
+                    S = bucket(max(self._meta[d].seg_bound
+                                   for d in stacked_idx) + 2, 64)
                     codes, scalars = run(S)
-                    scalars_np = np.asarray(scalars)
+                    scalars_np = np.asarray(scalars)  # (D, 2): n_vis, n_segs
+                    if (scalars_np[:, 1] + 2 > S).any():
+                        S = bucket(int(scalars_np[:, 1].max()) + 2, 64)
+                        codes, scalars = run(S)
+                        scalars_np = np.asarray(scalars)
                 for d in stacked_idx:
                     self._meta[d].seg_bound = int(scalars_np[d, 1])
                 self._codes_cache = (np.asarray(codes), scalars_np[:, 0],

@@ -27,9 +27,11 @@ headline-bench scale, docs/PROFILE_r3.md) from the merge critical path.
 
 The mirror replaces recomputation, not trust: the planned kernel re-derives
 the segment count and a head-slot checksum from the real chain bits and the
-engine verifies them at its existing scalar sync, dropping the mirror and
-re-materializing with the self-contained kernel on any mismatch
-(`DeviceTextDoc._scalars`).
+engine verifies them at its existing scalar sync. On any mismatch the
+mirror is REBUILT from the real chain bits (`SegmentMirror.rebuild`) and
+the affected read re-materializes through the self-contained kernel; only
+a failed rebuild degrades the document to the self-contained path for good
+(`DeviceTextDoc._scalars`, `DeviceTextDocSet.texts`).
 
 Reference semantics being mirrored: RGA sibling order, descending Lamport
 per insertion point (/root/reference/backend/op_set.js:440-489); the chain
@@ -114,6 +116,23 @@ class SegmentMirror:
     def empty(cls) -> "SegmentMirror":
         z = np.zeros(1, np.int64)
         return cls(z, z.copy(), z.copy(), z.copy())
+
+    @classmethod
+    def rebuild(cls, chain: np.ndarray, parent: np.ndarray, n_elems: int,
+                rev) -> "SegmentMirror":
+        """Reconstruct the mirror from fetched device columns — the heal
+        path after a divergence: heads are the chain-clear live slots,
+        parents come from the parent column, and the heads' Lamport keys
+        resolve through the range index (`rev(slots) -> (actor, ctr)`)."""
+        heads = 1 + np.flatnonzero(~chain[1: n_elems + 1]).astype(np.int64)
+        par = parent[heads].astype(np.int64)
+        if len(heads):
+            hactor, hctr = rev(heads)
+        else:
+            hactor = hctr = np.empty(0, np.int64)
+        z = np.zeros(1, np.int64)
+        return cls(np.concatenate([z, heads]), np.concatenate([z, par]),
+                   np.concatenate([z, hctr]), np.concatenate([z, hactor]))
 
     @property
     def n_segs(self) -> int:

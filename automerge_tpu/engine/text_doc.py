@@ -614,13 +614,16 @@ class DeviceTextDoc(CausalDeviceDoc):
             from ..ops.ingest import bucket
             if self._mat is None:
                 self._materialize(with_pos=False)
+            heals = 0
             while True:
                 scalars = np.asarray(self._mat[-1])
                 n_segs = int(scalars[1])
                 if len(scalars) == 4:
                     # planned materialization: verify the host mirror against
                     # the device-derived chain-bit count + head checksum;
-                    # self-heal through the self-contained kernel on mismatch
+                    # on mismatch rebuild the mirror from the real chain
+                    # bits (one attempt), else degrade to the
+                    # self-contained kernel
                     ok = (int(scalars[2]) == n_segs
                           and self.seg_mirror is not None
                           and int(scalars[3])
@@ -629,9 +632,14 @@ class DeviceTextDoc(CausalDeviceDoc):
                         logger.warning(
                             "segment mirror diverged from device chain bits "
                             "for %s (plan n_segs=%d device n_segs=%d); "
-                            "dropping mirror and re-materializing",
+                            "rebuilding mirror and re-materializing",
                             self.obj_id, n_segs, int(scalars[2]))
-                        self.seg_mirror = None
+                        heals += 1
+                        # one rebuild attempt: a rebuilt mirror matches the
+                        # chain bits by construction, so a second mismatch
+                        # means something deeper is wrong — degrade
+                        self.seg_mirror = (self._rebuild_mirror()
+                                           if heals == 1 else None)
                         self._seg_bound = max(int(scalars[2]), 1)
                         S = bucket(int(scalars[2]) + 2, 64)
                         self._mat = self._run_materialize(
@@ -647,6 +655,19 @@ class DeviceTextDoc(CausalDeviceDoc):
             self._seg_bound = n_segs  # tighten for the next materialize
             self._scal = scalars
         return self._scal
+
+    def _rebuild_mirror(self) -> Optional[SegmentMirror]:
+        """Heal path: reconstruct the segment mirror from the real device
+        chain/parent columns (one small fetch; None if that fails too)."""
+        try:
+            dev = self._ensure_dev()
+            return SegmentMirror.rebuild(
+                np.asarray(dev["chain"]), np.asarray(dev["parent"]),
+                self.n_elems, self.index.slot_to_key)
+        except Exception:
+            logger.warning("segment mirror rebuild failed for %s",
+                           self.obj_id, exc_info=True)
+            return None
 
     def _positions(self) -> np.ndarray:
         if self._pos_cache is None:

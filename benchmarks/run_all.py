@@ -138,9 +138,17 @@ def config4_trellis(n_actors: int = 1000, quick: bool = False):
     base = am.change(am.init("base"), lambda d: d.update(
         {"cards": [{"title": f"card{i}", "tasks": [f"t{j}" for j in range(3)]}
                    for i in range(10)]}))
+    # peer-change GENERATION runs on the oracle tier: the emitted change
+    # JSON is backend-independent, and building n_actors peers on the
+    # device tier would pay thousands of (tunnel) device round trips in
+    # untimed setup. The timed merge below still runs the device tier.
+    from automerge_tpu.backend import facade as oracle_backend
+    base_changes = am.get_all_changes(base)
     changes_per_actor = []
     for a in range(n_actors):
-        peer = am.merge(am.init(f"actor-{a:05d}"), base)
+        peer = am.apply_changes(
+            am.init({"actorId": f"actor-{a:05d}",
+                     "backend": oracle_backend.Backend}), base_changes)
         k = a % 10
         if a % 3 == 0:
             peer2 = am.change(peer, lambda d, k=k: d["cards"][k]["tasks"]

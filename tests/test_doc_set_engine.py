@@ -184,3 +184,66 @@ def test_random_docsets_match_single(seed):
     texts = ds.texts()
     for o in ids:
         assert texts[o] == singles[o].text(), o
+
+
+def test_docset_mirrors_track_chain_bits():
+    """Per-doc segment mirrors (planned vmapped materialization) must equal
+    the stacked chain-bit structure, and texts() must flag planned runs."""
+    from automerge_tpu.engine import TextChangeBatch
+    ids = ["m0", "m1"]
+    ds = DeviceTextDocSet(ids)
+    for rnd, start in ((1, 1), (2, 100)):
+        batches = {}
+        for o in ids:
+            changes = [typing_change(f"w{a}", rnd, "abcd", start_ctr=start,
+                                     obj=o, after=(None if rnd == 1
+                                                   else "w0:2"),
+                                     deps={} if rnd == 1 else
+                                     {f"w{i}": 1 for i in range(2)})
+                       for a in range(2)]
+            batches[o] = TextChangeBatch.from_changes(changes, o)
+        ds.apply_batches(batches)
+    texts = ds.texts()
+    chain = np.asarray(ds._ensure_dev()["chain"])
+    for d, o in enumerate(ids):
+        meta = ds._meta[d]
+        assert meta.mirror is not None
+        dev_heads = 1 + np.flatnonzero(~chain[d, 1: meta.n_elems + 1])
+        np.testing.assert_array_equal(meta.mirror.heads[1:], dev_heads)
+        single = DeviceTextDoc(o)
+        for rnd, start in ((1, 1), (2, 100)):
+            single.apply_changes([
+                typing_change(f"w{a}", rnd, "abcd", start_ctr=start, obj=o,
+                              after=(None if rnd == 1 else "w0:2"),
+                              deps={} if rnd == 1 else
+                              {f"w{i}": 1 for i in range(2)})
+                for a in range(2)])
+        assert texts[o] == single.text()
+
+
+def test_docset_corrupted_mirror_self_heals():
+    from automerge_tpu.engine import TextChangeBatch
+    from automerge_tpu.engine.segments import SegmentMirror
+    ds = DeviceTextDocSet(["h0", "h1"])
+    batches = {o: TextChangeBatch.from_changes(
+        [typing_change("w0", 1, "hello", obj=o)], o) for o in ds.obj_ids}
+    ds.apply_batches(batches)
+    good = ds.texts()
+    # corrupt doc 1's mirror: bogus extra head
+    m = ds._meta[1].mirror
+    ds._meta[1].mirror = SegmentMirror(
+        np.append(m.heads, 3), np.append(m.par, 2),
+        np.append(m.hctr, 99), np.append(m.hactor, 0))
+    ds._meta[1].mirror.heads.sort()
+    ds._codes_cache = None
+    assert ds.texts() == good           # healed via self-contained kernel
+    # the heal rebuilds row 1's mirror from its chain bits
+    chain = np.asarray(ds._ensure_dev()["chain"])
+    for d in range(2):
+        meta = ds._meta[d]
+        assert meta.mirror is not None
+        dev_heads = 1 + np.flatnonzero(~chain[d, 1: meta.n_elems + 1])
+        np.testing.assert_array_equal(meta.mirror.heads[1:], dev_heads)
+    # and the planned path serves the NEXT call again
+    ds._codes_cache = None
+    assert ds.texts() == good
