@@ -1,0 +1,25 @@
+#!/bin/bash
+# One-shot TPU chip session: runs every measurement this round still needs,
+# in priority order, appending to scripts/chip_session.log. Safe to re-run;
+# each step has its own timeout so a wedged tunnel can't eat the session.
+set -u
+cd "$(dirname "$0")/.."
+LOG=scripts/chip_session.log
+echo "=== chip session $(date -u +%FT%TZ) ===" >> "$LOG"
+
+run() {
+  local name="$1"; shift
+  echo "--- $name ($(date -u +%T)) ---" >> "$LOG"
+  timeout "$1" "${@:2}" >> "$LOG" 2>&1
+  echo "--- $name rc=$? ---" >> "$LOG"
+}
+
+run "probe"            120 python -c "import jax; print(jax.devices())"
+grep -q "rc=0" <(tail -1 "$LOG") || { echo "tunnel down, aborting" >> "$LOG"; exit 3; }
+
+run "bench"            900 python bench.py
+run "planned_ab"       900 python profile_bench.py --planned
+run "trace"            600 python profile_bench.py --trace
+run "pallas_ab"        900 python profile_bench.py --pallas
+run "configs_record"  2400 python -m benchmarks.run_all --record 3
+echo "=== chip session done $(date -u +%T) ===" >> "$LOG"
