@@ -16,6 +16,7 @@ run() {
 
 run "probe"            120 python -c "import jax; print(jax.devices())"
 grep -q "rc=0" <(tail -1 "$LOG") || { echo "tunnel down, aborting" >> "$LOG"; exit 3; }
+export AMTPU_SKIP_PREFLIGHT=1   # this session IS the parent probe
 
 run "bench"            900 python bench.py
 run "planned_ab"       900 python profile_bench.py --planned
