@@ -56,10 +56,20 @@ def timed_once(fn) -> float:
     return time.perf_counter() - t0
 
 
+def _platform() -> str:
+    """The platform every config in this process actually ran on — recorded
+    in each result row so a CPU-fallback record can never masquerade as a
+    chip measurement."""
+    import jax
+    return jax.devices()[0].platform
+
+
 def emit(metric: str, value: float, unit: str, vs_baseline: float = 0.0,
          **extra):
+    # platform is stamped LAST so no extra kwarg can override provenance
     rec = {"metric": metric, "value": round(value, 2), "unit": unit,
-           "vs_baseline": round(vs_baseline, 4), **extra}
+           "vs_baseline": round(vs_baseline, 4), **extra,
+           "platform": _platform()}
     RESULTS.append(rec)
     print(json.dumps(rec), flush=True)
 

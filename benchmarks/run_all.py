@@ -256,8 +256,11 @@ def main():
             sys.exit(out.returncode)
         line = out.stdout.strip().splitlines()[-1]
         rec = _json.loads(line)
-        from benchmarks.common import RESULTS
-        RESULTS.append({**rec, "metric": "cfg5_" + rec["metric"]})
+        from benchmarks.common import RESULTS, _platform
+        # stamp provenance on the folded-in headline row too (bench.py
+        # emits raw JSON; the subprocess shares this process's platform)
+        RESULTS.append({**rec, "metric": "cfg5_" + rec["metric"],
+                        "platform": _platform()})
         print(_json.dumps(RESULTS[-1]), flush=True)
         write_record(os.path.join(
             root, f"BENCH_CONFIGS_r{record_round:02d}.json"))
