@@ -18,6 +18,8 @@ run "probe"            120 python -c "import jax; print(jax.devices())"
 grep -q "rc=0" <(tail -1 "$LOG") || { echo "tunnel down, aborting" >> "$LOG"; exit 3; }
 export AMTPU_SKIP_PREFLIGHT=1   # this session IS the parent probe
 
+AUTOMERGE_TPU_TESTS_ON_TPU=1 \
+  run "tpu_smoke"      900 python -m pytest tests/test_segments.py tests/test_engine_parity.py -q
 run "bench"            900 python bench.py
 run "planned_ab"       900 python profile_bench.py --planned
 run "trace"            600 python profile_bench.py --trace
