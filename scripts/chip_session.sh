@@ -20,6 +20,7 @@ export AMTPU_SKIP_PREFLIGHT=1   # this session IS the parent probe
 
 AUTOMERGE_TPU_TESTS_ON_TPU=1 \
   run "tpu_smoke"      900 python -m pytest tests/test_segments.py tests/test_engine_parity.py -q
+grep -q "rc=0" <(tail -1 "$LOG") || { echo "on-chip smoke FAILED, not recording benchmarks" >> "$LOG"; exit 4; }
 run "bench"            900 python bench.py
 run "planned_ab"       900 python profile_bench.py --planned
 run "trace"            600 python profile_bench.py --trace

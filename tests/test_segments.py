@@ -278,3 +278,33 @@ def test_multi_round_prepare_keeps_mirror():
     direct.apply_batch(TextChangeBatch.from_changes(concurrent, "t"))
     assert doc.text() == direct.text()
     assert doc.elem_ids() == direct.elem_ids()
+
+
+def test_max_segmentation_structure():
+    """Adversarial shape: single-char inserts with non-consecutive counters
+    (no run condensation) — nearly every element its own segment. Stresses
+    S sizing, the position permutation, and mirror structural equality."""
+    rng = random.Random(80_001)
+    elems = ["_head"]
+    changes = []
+    actors = [f"w{i}" for i in range(3)]
+    seqs = {a: 0 for a in actors}
+    ctr = 1
+    for step in range(90):
+        a = rng.choice(actors)
+        seqs[a] += 1
+        parent = rng.choice(elems)
+        changes.append({
+            "actor": a, "seq": seqs[a],
+            "deps": {b: s for b, s in seqs.items() if b != a and s},
+            "ops": [{"action": "ins", "obj": "t", "key": parent,
+                     "elem": ctr},
+                    {"action": "set", "obj": "t", "key": f"{a}:{ctr}",
+                     "value": chr(97 + step % 26)}]})
+        elems.append(f"{a}:{ctr}")
+        ctr += 3
+    doc, plain = engine_pair(changes, "t")
+    assert doc.text() == plain.text()
+    assert doc.elem_ids() == plain.elem_ids()
+    mirror_vs_device(doc)
+    assert doc.seg_mirror.n_segs == 90   # every insert its own segment
