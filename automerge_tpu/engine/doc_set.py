@@ -442,8 +442,10 @@ class DeviceTextDocSet:
                         if d in stacked else empty.plan(S, 0)
                         for d in range(self.n_docs)])
                     return jax.vmap(
-                        lambda v, h, c, n, sp: materialize_codes_planned(
-                            v, h, c, n, sp, S=S, as_u8=all_ascii))(
+                        lambda p, t, a, v, h, c, n, sp:
+                        materialize_codes_planned(
+                            p, t, a, v, h, c, n, sp, S=S, as_u8=all_ascii))(
+                        dev["parent"], dev["ctr"], dev["actor"],
                         dev["value"], dev["has_value"], dev["chain"],
                         self._put(n_el, "doc"), self._put(plans, "doc"))
 
@@ -459,11 +461,13 @@ class DeviceTextDocSet:
                     S = bucket(max(self._meta[d].mirror.n_segs
                                    for d in stacked_idx) + 2, 64)
                     codes, scalars = run_planned(S)
-                    scalars_np = np.asarray(scalars)  # (D, 4)
+                    scalars_np = np.asarray(scalars)  # (D, 5)
                     bad = [d for d in stacked_idx
                            if int(scalars_np[d, 1]) != int(scalars_np[d, 2])
                            or int(scalars_np[d, 3])
-                           != self._meta[d].mirror.head_checksum()]
+                           != self._meta[d].mirror.head_checksum()
+                           or int(scalars_np[d, 4])
+                           != self._meta[d].mirror.aux_checksum()]
                     if bad:
                         # rebuild diverged mirrors from the real chain bits
                         # (a small per-row fetch; None only if that fails),
@@ -473,7 +477,8 @@ class DeviceTextDocSet:
                             "rebuilding and re-materializing", bad)
                         for d in bad:
                             self._rebuild_row_mirror(d)
-                            self._meta[d].seg_bound = int(scalars_np[d, 2])
+                            self._meta[d].seg_bound = max(
+                                int(scalars_np[d, 2]), 1)
                         planned = False
                 if not planned:
                     S = bucket(max(self._meta[d].seg_bound

@@ -25,9 +25,11 @@ magnitude below element counts (millions), so the numpy stage is sub-ms and
 rides the *untimed* prepare phase; it removes the S-stage (~20 ms at
 headline-bench scale, docs/PROFILE_r3.md) from the merge critical path.
 
-The mirror replaces recomputation, not trust: the planned kernel re-derives
-the segment count and a head-slot checksum from the real chain bits and the
-engine verifies them at its existing scalar sync. On any mismatch the
+The mirror replaces recomputation, not trust: the planned kernel re-derives,
+from the real chain bits, the segment count plus two nonlinearly-mixed
+hashes — one over the head slots, one over the heads' (parent, ctr, actor)
+columns, i.e. every input that determines the linearization order — and the
+engine verifies all three at its existing scalar sync. On any mismatch the
 mirror is REBUILT from the real chain bits (`SegmentMirror.rebuild`) and
 the affected read re-materializes through the self-contained kernel; only
 a failed rebuild degrades the document to the self-contained path for good
@@ -139,9 +141,27 @@ class SegmentMirror:
         return len(self.heads) - 1
 
     def head_checksum(self) -> int:
-        """int32-wrapping sum of live head slots — matches the device-side
-        checksum the planned kernel derives from the chain bits."""
-        return int(self.heads[1:].astype(np.int32).sum(dtype=np.int32))
+        """Wrapping sum of a NONLINEAR 32-bit mix of each live head slot —
+        the host twin of the device-side reduce the planned kernel derives
+        from the chain bits (ops/ingest._mix32). The nonlinearity matters:
+        a plain (or multiplicative — still linear) sum passes head-set
+        swaps like {3,5} vs {2,6}; the mixed sum does not."""
+        from ..ops.ingest import mix32_np
+        h = mix32_np(self.heads[1:])
+        return int(np.int32(np.uint32(h.sum(dtype=np.uint32))))
+
+    def aux_checksum(self) -> int:
+        """Wrapping mixed sum over each head's (parent slot, ctr, actor) —
+        the columns that fully determine the linearization order, which the
+        count + head hash alone never verify. Host twin of the device
+        reduce over the parent/ctr/actor columns at seg-start slots
+        (ops/ingest.HASH_K2..K4 + _mix32)."""
+        from ..ops.ingest import HASH_K2, HASH_K3, HASH_K4, mix32_np
+        key = (self.par[1:].astype(np.uint32) * HASH_K2
+               + self.hctr[1:].astype(np.uint32) * HASH_K3
+               + self.hactor[1:].astype(np.uint32) * HASH_K4)
+        h = mix32_np(key + self.heads[1:].astype(np.uint32))
+        return int(np.int32(np.uint32(h.sum(dtype=np.uint32))))
 
     def remap_actors(self, remap: np.ndarray) -> None:
         self.hactor = remap.astype(np.int64)[self.hactor]

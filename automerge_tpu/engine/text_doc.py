@@ -422,9 +422,21 @@ class DeviceTextDoc(CausalDeviceDoc):
         seg_S = 0
         if (mirror_after is not None and dense and n_res == 0
                 and self.eager_materialize and self.use_condensed):
-            seg_S = bucket(mirror_after.n_segs + 2, 64)
-            seg_plan_dev = jnp.asarray(
-                mirror_after.plan(seg_S, n_elems_after))
+            # same graceful degradation as apply_round above: a corrupted
+            # mirror must not abort the whole prepare — the round can still
+            # commit via the self-contained kernel
+            try:
+                seg_S = bucket(mirror_after.n_segs + 2, 64)
+                seg_plan_dev = jnp.asarray(
+                    mirror_after.plan(seg_S, n_elems_after))
+            except Exception:
+                logger.warning(
+                    "segplan packing failed for %s; falling back to the "
+                    "self-contained materialize kernel", self.obj_id,
+                    exc_info=True)
+                mirror_after = None
+                seg_plan_dev = None
+                seg_S = 0
 
         exec_plan = _RoundExec(
             index_after=merged_index, n_elems_after=n_elems_after,
@@ -599,7 +611,8 @@ class DeviceTextDoc(CausalDeviceDoc):
             segplan = jnp.asarray(self.seg_mirror.plan(S, self.n_elems))
             fn = (materialize_text_planned if with_pos
                   else materialize_codes_planned)
-            return fn(dev["value"], dev["has_value"], dev["chain"], n,
+            return fn(dev["parent"], dev["ctr"], dev["actor"],
+                      dev["value"], dev["has_value"], dev["chain"], n,
                       segplan, S=S, as_u8=as_u8, L=L)
         fn = materialize_text if with_pos else materialize_codes
         return fn(dev["parent"], dev["ctr"], dev["actor"], dev["value"],
@@ -618,16 +631,19 @@ class DeviceTextDoc(CausalDeviceDoc):
             while True:
                 scalars = np.asarray(self._mat[-1])
                 n_segs = int(scalars[1])
-                if len(scalars) == 4:
+                if len(scalars) == 5:
                     # planned materialization: verify the host mirror against
-                    # the device-derived chain-bit count + head checksum;
-                    # on mismatch rebuild the mirror from the real chain
-                    # bits (one attempt), else degrade to the
-                    # self-contained kernel
+                    # the device-derived chain-bit count + head-slot hash +
+                    # (parent, ctr, actor) hash — together these pin the
+                    # full linearization inputs; on mismatch rebuild the
+                    # mirror from the real chain bits (one attempt), else
+                    # degrade to the self-contained kernel
                     ok = (int(scalars[2]) == n_segs
                           and self.seg_mirror is not None
                           and int(scalars[3])
-                          == self.seg_mirror.head_checksum())
+                          == self.seg_mirror.head_checksum()
+                          and int(scalars[4])
+                          == self.seg_mirror.aux_checksum())
                     if not ok:
                         logger.warning(
                             "segment mirror diverged from device chain bits "

@@ -35,6 +35,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .._common import KIND_DEL, KIND_INC, KIND_INS, KIND_SET  # noqa: F401
 
@@ -601,8 +602,39 @@ def _materialize_core(parent, ctr, actor, value, has_value, chain, n_elems,
     return codes, scalars
 
 
-def _materialize_core_planned(value, has_value, chain, n_elems, segplan,
-                              S, with_pos, as_u8):
+# Odd 32-bit mixing constants (Knuth golden-ratio / murmur3) for the
+# plan-consistency hashes. The per-element mix must be NONLINEAR before the
+# sum reduce: a purely multiplicative hash is linear, so any divergence that
+# preserves the plain sum (e.g. heads {3,5} vs {2,6}) also preserves
+# sum(K*h). The xorshift stages break that cancellation.
+# engine/segments.SegmentMirror.{head_checksum,aux_checksum} are the numpy
+# twins of `_mix32` — both run the identical uint32-wrapping pipeline.
+HASH_K1 = np.uint32(2654435761)   # 0x9E3779B1
+HASH_K2 = np.uint32(2246822519)   # 0x85EBCA77
+HASH_K3 = np.uint32(3266489917)   # 0xC2B2AE3D
+HASH_K4 = np.uint32(668265263)    # 0x27D4EB2F
+
+
+def _mix32(x):
+    """murmur3-fmix-style nonlinear 32-bit mix (device); uint32 wrapping."""
+    x = x.astype(jnp.uint32) * HASH_K1
+    x = x ^ (x >> 15)
+    x = x * HASH_K2
+    x = x ^ (x >> 13)
+    return x
+
+
+def mix32_np(x: np.ndarray) -> np.ndarray:
+    """Host twin of `_mix32` — identical uint32 pipeline in numpy."""
+    x = x.astype(np.uint32) * HASH_K1
+    x = x ^ (x >> np.uint32(15))
+    x = x * HASH_K2
+    x = x ^ (x >> np.uint32(13))
+    return x
+
+
+def _materialize_core_planned(parent, ctr, actor, value, has_value, chain,
+                              n_elems, segplan, S, with_pos, as_u8):
     """Materialization with HOST-PLANNED segment structure.
 
     `segplan` is the (4, S) int32 matrix from
@@ -615,11 +647,16 @@ def _materialize_core_planned(value, has_value, chain, n_elems, segplan,
     program. What remains is inherently data-dependent: the visibility
     prefix sum, the S->slot expansion sum, and the codes scatter.
 
-    Trust but verify: the kernel re-derives the segment count and an
-    int32-wrapping head-slot checksum from the REAL chain bits and returns
-    them in the scalars; the engine compares them against the plan at its
-    scalar sync and self-heals through the self-contained kernel on
-    mismatch (engine/text_doc.DeviceTextDoc._scalars)."""
+    Trust but verify: the kernel re-derives, from the REAL chain bits, the
+    segment count plus TWO int32-wrapping mixing hashes — one over the head
+    slots themselves, one over the heads' (parent slot, ctr, actor) columns,
+    which fully determine the linearization order — and returns them in the
+    scalars. The engine compares them against the mirror at its scalar sync
+    and self-heals through the self-contained kernel on mismatch
+    (engine/text_doc.DeviceTextDoc._scalars). Multiplicative mixing (Knuth/
+    murmur odd constants) makes a divergence that preserves count AND both
+    hashes implausible — a plain count+sum check would pass head-set swaps
+    like {3,5} vs {2,6}."""
     C = value.shape[0]
     idx = jnp.arange(C, dtype=jnp.int32)
     is_elem = (idx >= 1) & (idx <= n_elems)
@@ -671,11 +708,23 @@ def _materialize_core_planned(value, has_value, chain, n_elems, segplan,
         codes = jnp.full(C, -1, value.dtype).at[
             jnp.where(vis, vis_rank, C)].set(value, mode="drop")
 
-    # plan-consistency scalars from the real chain bits (cheap reduces)
+    # plan-consistency scalars from the real chain bits: cheap reduces with
+    # a NONLINEAR per-element mix (uint32, wraps deterministically), so
+    # divergences cannot cancel in the sum
     seg_start = is_elem & ~chain
     n_segs_dev = jnp.sum(seg_start.astype(jnp.int32))
-    head_sum_dev = jnp.sum(jnp.where(seg_start, idx, 0))
-    scalars = jnp.stack([n_vis, n_segs, n_segs_dev, head_sum_dev])
+    head_hash_dev = jax.lax.bitcast_convert_type(
+        jnp.sum(jnp.where(seg_start, _mix32(idx), jnp.uint32(0))),
+        jnp.int32)
+    aux_key = (parent.astype(jnp.uint32) * HASH_K2
+               + ctr.astype(jnp.uint32) * HASH_K3
+               + actor.astype(jnp.uint32) * HASH_K4)
+    aux_hash_dev = jax.lax.bitcast_convert_type(
+        jnp.sum(jnp.where(seg_start, _mix32(aux_key + idx.astype(jnp.uint32)),
+                          jnp.uint32(0))),
+        jnp.int32)
+    scalars = jnp.stack([n_vis, n_segs, n_segs_dev, head_hash_dev,
+                         aux_hash_dev])
 
     if with_pos:
         pos = jnp.where(is_elem, starts_exp + (idx - seg_head_exp),
@@ -685,22 +734,25 @@ def _materialize_core_planned(value, has_value, chain, n_elems, segplan,
 
 
 @partial(jax.jit, static_argnames=("S", "as_u8", "L"))
-def materialize_text_planned(value, has_value, chain, n_elems, segplan,
+def materialize_text_planned(parent, ctr, actor, value, has_value, chain,
+                             n_elems, segplan,
                              *, S: int, as_u8: bool = False, L: int = None):
     """`materialize_text` with host-planned segment structure (see
-    `_materialize_core_planned`)."""
-    value, has_value, chain = _slice_live((value, has_value, chain), L)
-    return _materialize_core_planned(value, has_value, chain, n_elems,
-                                     segplan, S, with_pos=True, as_u8=as_u8)
+    `_materialize_core_planned`). parent/ctr/actor feed only the
+    plan-consistency hash reduces, not the linearization."""
+    cols = _slice_live((parent, ctr, actor, value, has_value, chain), L)
+    return _materialize_core_planned(*cols, n_elems, segplan, S,
+                                     with_pos=True, as_u8=as_u8)
 
 
 @partial(jax.jit, static_argnames=("S", "as_u8", "L"))
-def materialize_codes_planned(value, has_value, chain, n_elems, segplan,
+def materialize_codes_planned(parent, ctr, actor, value, has_value, chain,
+                              n_elems, segplan,
                               *, S: int, as_u8: bool = False, L: int = None):
     """`materialize_codes` with host-planned segment structure."""
-    value, has_value, chain = _slice_live((value, has_value, chain), L)
-    return _materialize_core_planned(value, has_value, chain, n_elems,
-                                     segplan, S, with_pos=False, as_u8=as_u8)
+    cols = _slice_live((parent, ctr, actor, value, has_value, chain), L)
+    return _materialize_core_planned(*cols, n_elems, segplan, S,
+                                     with_pos=False, as_u8=as_u8)
 
 
 @partial(jax.jit, static_argnames=("out_cap", "S", "as_u8", "L"))
@@ -716,7 +768,8 @@ def merge_and_materialize_dense_planned(
         win_counter, chain, desc, blob, out_cap=out_cap)
     n_elems = (desc[DESC_META, META_BASE_SLOT]
                + desc[DESC_META, META_N_ELEMS] - 1)
-    cols = _slice_live((tables[3], tables[4], tables[8]), L)
+    cols = _slice_live((tables[0], tables[1], tables[2], tables[3],
+                        tables[4], tables[8]), L)
     codes, scalars = _materialize_core_planned(
         *cols, n_elems, segplan, S, with_pos=False, as_u8=as_u8)
     return tables + (codes, scalars)

@@ -98,16 +98,18 @@ def _sharded_planned_fn(mesh: Mesh, S: int, as_u8: bool):
     elem = NamedSharding(mesh, P("elem"))
     rep = NamedSharding(mesh, P())
     fn = jax.jit(
-        lambda value, has, chain, n, segplan: materialize_codes_planned(
-            value, has, chain, n, segplan, S=S, as_u8=as_u8),
-        in_shardings=(elem, elem, elem, rep, rep),
+        lambda parent, ctr, actor, value, has, chain, n, segplan:
+        materialize_codes_planned(
+            parent, ctr, actor, value, has, chain, n, segplan,
+            S=S, as_u8=as_u8),
+        in_shardings=(elem,) * 6 + (rep, rep),
         out_shardings=(elem, rep))
     return elem, rep, fn
 
 
-def sharded_planned_materialize(mesh: Mesh, value, has_value, chain,
-                                n_elems, segplan, *, S: int,
-                                as_u8: bool = False):
+def sharded_planned_materialize(mesh: Mesh, parent, ctr, actor, value,
+                                has_value, chain, n_elems, segplan, *,
+                                S: int, as_u8: bool = False):
     """One huge document's codes-only materialization with the element axis
     sharded over the mesh and the segment structure HOST-PLANNED
     (engine/segments.py): the compiled program contains NO sort and no
@@ -115,14 +117,15 @@ def sharded_planned_materialize(mesh: Mesh, value, has_value, chain,
     codes scatter's permutation traffic — not the sort all-to-alls the
     self-contained kernel needs (docs/SHARDING_r3.md quantifies both). The
     (4, S) segplan is tiny and replicated. Returns sharded codes + the
-    replicated 4-entry scalars ([n_vis, n_segs, n_segs_dev, head_sum])."""
+    replicated 5-entry scalars ([n_vis, n_segs, n_segs_dev, head_hash,
+    aux_hash] — the plan-consistency reduces over parent/ctr/actor ride the
+    sharded columns)."""
     elem, rep, fn = _sharded_planned_fn(mesh, S, as_u8)
-    value = jax.device_put(value, elem)
-    has_value = jax.device_put(has_value, elem)
-    chain = jax.device_put(chain, elem)
+    cols = [jax.device_put(x, elem)
+            for x in (parent, ctr, actor, value, has_value, chain)]
     n_elems = jax.device_put(jnp.int32(n_elems), rep)
     segplan = jax.device_put(segplan, rep)
-    return fn(value, has_value, chain, n_elems, segplan)
+    return fn(*cols, n_elems, segplan)
 
 
 def example_doc_tables(n_docs: int, cap: int, seed: int = 0):

@@ -15,23 +15,40 @@ import time
 RESULTS: list = []  # every emit() of the run, for the per-round record file
 
 
-def preflight_device(timeout_s: int = 150) -> bool:
+def preflight_device(timeout_s: int = 90, total_budget_s: float = 0.0) -> bool:
     """True iff jax can actually reach a device. When the remote TPU
     tunnel is down, the axon plugin hangs backend init indefinitely —
     probe in a subprocess so benchmark entry points fail FAST with a
     clear message instead of eating the caller's whole time budget.
+
+    The tunnel demonstrably flaps (BENCH_r03 was lost to one failed
+    probe at driver-run time), so with ``total_budget_s > 0`` the probe
+    retries with backoff until a probe succeeds or the budget is spent.
     AMTPU_SKIP_PREFLIGHT=1 skips the probe (a parent already probed;
     each probe pays a full backend init, seconds on a remote tunnel)."""
     if os.environ.get("AMTPU_SKIP_PREFLIGHT") == "1":
         return True
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=timeout_s)
-        return out.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    deadline = time.monotonic() + total_budget_s
+    backoff = 10.0
+    while True:
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=timeout_s)
+            if out.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        remaining = deadline - time.monotonic()
+        if remaining <= 1.0:
+            return False
+        wait = min(backoff, remaining)  # use the WHOLE budget: final probe
+        print(f"preflight: no device, retrying in {wait:.0f}s "   # near the
+              f"({remaining:.0f}s budget left)", file=sys.stderr,  # deadline
+              flush=True)
+        time.sleep(wait)
+        backoff = min(backoff * 1.7, 45.0)
 
 
 def setup_jax_cache():

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Probe the TPU tunnel repeatedly for up to ~9.5 min; exit 0 the moment it's up.
+# Writes status lines to /tmp/tpu_probe_status.txt
+for i in $(seq 1 6); do
+  echo "probe $i at $(date +%H:%M:%S)" >> /tmp/tpu_probe_status.txt
+  if timeout 80 python -c "import jax; d=jax.devices(); assert d and d[0].platform=='tpu', d; print('TPU UP:', d)" >> /tmp/tpu_probe_status.txt 2>&1; then
+    echo "TUNNEL_UP at $(date +%H:%M:%S)" >> /tmp/tpu_probe_status.txt
+    exit 0
+  fi
+  sleep 10
+done
+echo "TUNNEL_DOWN after 6 probes at $(date +%H:%M:%S)" >> /tmp/tpu_probe_status.txt
+exit 1

@@ -64,12 +64,13 @@ def audit_materialize(mesh_elem, cap, S):
             p, c, a, v, h, ch, n, S=S),
         in_shardings=(elem,) * 6 + (rep,), out_shardings=(elem, rep))
     planned = jax.jit(
-        lambda v, h, ch, n, sp: materialize_codes_planned(
-            v, h, ch, n, sp, S=S),
-        in_shardings=(elem, elem, elem, rep, rep),
+        lambda p, c, a, v, h, ch, n, sp: materialize_codes_planned(
+            p, c, a, v, h, ch, n, sp, S=S),
+        in_shardings=(elem,) * 6 + (rep, rep),
         out_shardings=(elem, rep))
     return (count_collectives(plain, (z32, z32, z32, z32, zb, zb, n)),
-            count_collectives(planned, (z32, zb, zb, n, segplan)))
+            count_collectives(planned,
+                              (z32, z32, z32, z32, zb, zb, n, segplan)))
 
 
 def scaling(cap_per_dev=2048, n_docs=8):

@@ -153,10 +153,13 @@ def test_sharded_planned_materialize_matches_engine():
     segplan = doc.seg_mirror.plan(S, doc.n_elems)
     dev = doc._ensure_dev()
     codes, scalars = sharded_planned_materialize(
-        mesh, dev["value"], dev["has_value"], dev["chain"],
+        mesh, dev["parent"], dev["ctr"], dev["actor"],
+        dev["value"], dev["has_value"], dev["chain"],
         doc.n_elems, segplan, S=S)
     scal = np.asarray(scalars)
     assert int(scal[1]) == int(scal[2]) == doc.seg_mirror.n_segs
+    assert int(scal[3]) == doc.seg_mirror.head_checksum()
+    assert int(scal[4]) == doc.seg_mirror.aux_checksum()
     n_vis = int(scal[0])
     got = "".join(chr(v) for v in np.asarray(codes)[:n_vis])
     assert got == expected

@@ -174,8 +174,10 @@ def test_fused_planned_path_and_scalars():
         typing_change("bob", 1, {"base": 1}, "BB", 100, "base:5"),
     ], "t"))
     scal = doc._scalars()
-    assert len(scal) == 4          # planned kernel served the read
+    assert len(scal) == 5          # planned kernel served the read
     assert int(scal[1]) == int(scal[2]) == doc.seg_mirror.n_segs
+    assert int(scal[3]) == doc.seg_mirror.head_checksum()
+    assert int(scal[4]) == doc.seg_mirror.aux_checksum()
     plain = DeviceTextDoc("t")
     plain.seg_mirror = None
     plain.apply_changes([
@@ -221,6 +223,50 @@ def test_corrupted_mirror_self_heals():
     doc._invalidate()
     assert doc.text() == good      # healed through the unplanned kernel
     # the heal REBUILDS the mirror from the real chain bits
+    mirror_vs_device(doc)
+
+
+def _two_segment_doc():
+    doc = DeviceTextDoc("t")
+    doc.apply_changes([typing_change("base", 1, {}, "hello world", 1,
+                                     "_head")])
+    doc.apply_changes([typing_change("alice", 1, {"base": 1}, "AA", 100,
+                                     "base:5")])
+    return doc
+
+
+def test_count_and_sum_preserving_head_divergence_detected():
+    """A head-SET divergence that preserves both segment count and the
+    plain head-slot sum (e.g. {6,12} -> {7,11}) — invisible to a count+sum
+    check — must trip the multiplicative head hash and heal."""
+    doc = _two_segment_doc()
+    good = doc.text()
+    m = doc.seg_mirror
+    heads = m.heads.copy()
+    assert len(heads) == 4          # virtual + 3 segments
+    heads[2] += 1                   # shift two heads in opposite
+    heads[3] -= 1                   # directions: count+sum unchanged
+    assert heads[1:].sum() == m.heads[1:].sum()
+    doc.seg_mirror = SegmentMirror(heads, m.par.copy(), m.hctr.copy(),
+                                   m.hactor.copy())
+    doc._invalidate()
+    assert doc.text() == good       # hash mismatch -> heal -> correct text
+    mirror_vs_device(doc)           # and the mirror was rebuilt
+
+
+def test_head_key_divergence_detected():
+    """Heads correct but a head's Lamport key (ctr) wrong — the class the
+    old count+sum check could NEVER catch (it only looked at slots). The
+    (parent, ctr, actor) aux hash must trip and heal."""
+    doc = _two_segment_doc()
+    good = doc.text()
+    m = doc.seg_mirror
+    hctr = m.hctr.copy()
+    hctr[2] += 7                    # corrupt one head's counter
+    doc.seg_mirror = SegmentMirror(m.heads.copy(), m.par.copy(), hctr,
+                                   m.hactor.copy())
+    doc._invalidate()
+    assert doc.text() == good
     mirror_vs_device(doc)
 
 
