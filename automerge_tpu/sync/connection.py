@@ -4,84 +4,75 @@ Counterpart of /root/reference/src/connection.js. Messages are plain JSON
 ``{docId, clock, changes?}`` — byte-compatible with the reference protocol —
 and transport is user-supplied (``send_msg`` callback out, ``receive_msg`` in).
 
-``_their_clock`` is the most recent clock we believe the peer has;
-``_our_clock`` is the most recent clock we have advertised. Everything newer
-than their clock is sent; clock-only messages advertise or request state.
+Unlike the reference — where every Connection re-diffs every doc against its
+peer on each local change (src/connection.js:58-88 driven per connection by
+the DocSet handler) — a Connection here is a thin per-peer face over its
+DocSet's ONE shared `SyncHub`: N connections on a doc-set cost a single
+vectorized clock comparison (`ClockMatrix.pending`) per local change, and
+peers with identical believed clocks share one change extraction
+(`SyncHub.flush`). Wire behavior per peer matches the reference protocol:
+changes flow only after the peer reveals a clock for a doc, advertisements
+otherwise, unknown advertised docs are requested with an empty clock, and
+handing the doc-set a stale snapshot raises (src/connection.js:79-86).
 """
 
 from __future__ import annotations
 
-from ..backend import default as Backend
-from .. import frontend as Frontend
-from .._common import less_or_equal
-
-
-def _clock_union(clock_map: dict, doc_id: str, clock: dict) -> dict:
-    merged = dict(clock_map.get(doc_id, {}))
-    for actor, seq in clock.items():
-        if seq > merged.get(actor, 0):
-            merged[actor] = seq
-    out = dict(clock_map)
-    out[doc_id] = merged
-    return out
+from .hub import shared_hub
 
 
 class Connection:
+    """One peer endpoint on the doc-set's shared hub.
+
+    The public surface mirrors the reference Connection: ``open``/``close``
+    for lifecycle, ``receive_msg`` for inbound messages (returns the updated
+    document, like src/connection.js:91-107); outbound messages go through
+    the ``send_msg`` callback passed to the constructor.
+    """
+
     def __init__(self, doc_set, send_msg):
         self._doc_set = doc_set
         self._send_msg = send_msg
-        self._their_clock: dict = {}
-        self._our_clock: dict = {}
+        self._hub = None
+        self._peer_id = None
+        self._closed = False
+
+    def _ensure_peer(self):
+        if self._hub is None:
+            self._hub = shared_hub(self._doc_set)
+            self._peer_id = self._hub.auto_peer_id()
+            self._hub.add_peer(self._peer_id, self._send_msg)
+        return self._hub
 
     def open(self):
-        for doc_id in self._doc_set.doc_ids:
-            self.doc_changed(doc_id, self._doc_set.get_doc(doc_id))
-        self._doc_set.register_handler(self.doc_changed)
+        """Join the doc-set's hub: advertises every current doc to the peer
+        and subscribes to future local changes. Reopens a closed
+        connection with fresh peer state."""
+        self._closed = False
+        self._ensure_peer()
 
     def close(self):
-        self._doc_set.unregister_handler(self.doc_changed)
-
-    def send_msg(self, doc_id: str, clock: dict, changes=None):
-        msg = {"docId": doc_id, "clock": dict(clock)}
-        self._our_clock = _clock_union(self._our_clock, doc_id, clock)
-        if changes is not None:
-            msg["changes"] = changes
-        self._send_msg(msg)
-
-    def maybe_send_changes(self, doc_id: str):
-        doc = self._doc_set.get_doc(doc_id)
-        state = Frontend.get_backend_state(doc)
-        clock = state.clock
-
-        if doc_id in self._their_clock:
-            changes = Backend.get_missing_changes(state, self._their_clock[doc_id])
-            if changes:
-                self._their_clock = _clock_union(self._their_clock, doc_id, clock)
-                self.send_msg(doc_id, clock, changes)
-                return
-
-        if clock != self._our_clock.get(doc_id, {}):
-            self.send_msg(doc_id, clock)
-
-    def doc_changed(self, doc_id: str, doc):
-        state = Frontend.get_backend_state(doc)
-        if state is None:
-            raise TypeError("This object cannot be used for network sync. "
-                            "Are you trying to sync a snapshot from the history?")
-        if not less_or_equal(self._our_clock.get(doc_id, {}), state.clock):
-            raise ValueError("Cannot pass an old state object to a connection")
-        self.maybe_send_changes(doc_id)
+        """Leave the hub. When the last connection leaves, the hub itself
+        unhooks from the DocSet (so a peer-less doc-set accepts snapshot
+        set_doc again and pays no sync bookkeeping); a later open()
+        rejoins with fresh peer state."""
+        if self._hub is not None:
+            self._hub.remove_peer(self._peer_id)
+            if not self._hub.has_peers():
+                self._hub.close()
+                if getattr(self._doc_set, "_sync_hub", None) is self._hub:
+                    self._doc_set._sync_hub = None
+            self._hub = None
+            self._peer_id = None
+        self._closed = True
 
     def receive_msg(self, msg: dict):
-        doc_id = msg["docId"]
-        if msg.get("clock") is not None:  # an empty clock still registers the peer
-            self._their_clock = _clock_union(self._their_clock, doc_id, msg["clock"])
-        if msg.get("changes"):
-            return self._doc_set.apply_changes(doc_id, msg["changes"])
-
-        if self._doc_set.get_doc(doc_id) is not None:
-            self.maybe_send_changes(doc_id)
-        elif doc_id not in self._our_clock:
-            # The peer has a document we don't: request it with an empty clock.
-            self.send_msg(doc_id, {})
-        return self._doc_set.get_doc(doc_id)
+        if self._closed:
+            # a late in-flight message after close(): absorb inbound
+            # changes, but never rejoin the hub or write to the (likely
+            # torn-down) transport
+            if msg.get("changes"):
+                return self._doc_set.apply_changes(msg["docId"],
+                                                   msg["changes"])
+            return self._doc_set.get_doc(msg["docId"])
+        return self._ensure_peer()._receive(self._peer_id, msg)

@@ -31,6 +31,18 @@ class HubPeer:
         return self._hub._receive(self.peer_id, msg)
 
 
+def shared_hub(doc_set) -> "SyncHub":
+    """The one hub every hub-backed `Connection` on a DocSet shares (cached
+    on the doc-set instance): N connections cost one ClockMatrix and one
+    batched comparison per local change, not N independent diff loops."""
+    hub = getattr(doc_set, "_sync_hub", None)
+    if hub is None:
+        hub = SyncHub(doc_set)
+        doc_set._sync_hub = hub
+        hub.open()
+    return hub
+
+
 class SyncHub:
     def __init__(self, doc_set):
         self._doc_set = doc_set
@@ -39,8 +51,14 @@ class SyncHub:
         self._advertised: dict = {}   # (peer, doc) -> clock last advertised
         self._revealed: set = set()   # (peer, doc) pairs that sent us a clock
         self._had_doc: set = set()    # doc ids this hub ever held locally
+        self._n_auto_ids = 0
 
     # -- lifecycle ------------------------------------------------------
+
+    def auto_peer_id(self) -> str:
+        """A fresh peer id for anonymous (Connection-face) peers."""
+        self._n_auto_ids += 1
+        return f"_conn-{self._n_auto_ids}"
 
     def add_peer(self, peer_id: str, send_msg) -> HubPeer:
         if peer_id in self._peers:
@@ -58,6 +76,9 @@ class SyncHub:
         self._revealed = {pd for pd in self._revealed if pd[0] != peer_id}
         self._advertised = {pd: c for pd, c in self._advertised.items()
                             if pd[0] != peer_id}
+
+    def has_peers(self) -> bool:
+        return bool(self._peers)
 
     def open(self):
         self._doc_set.register_handler(self.doc_changed)
@@ -105,7 +126,12 @@ class SyncHub:
                 self._advertise(peer_id, doc_id)
 
     def flush(self):
-        """One batched comparison; send changes for every flagged pair."""
+        """One batched comparison; send changes for every flagged pair.
+
+        Change extraction is shared: flagged pairs with the same
+        (doc, believed clock) — the common case when one local change
+        fans out to N caught-up peers — run `get_missing_changes` once."""
+        extracted: dict = {}
         for peer_id, doc_id in self._matrix.pending():
             if peer_id not in self._peers:
                 continue
@@ -115,7 +141,12 @@ class SyncHub:
             if state is None:
                 continue  # doc removed locally; clocks remain for history
             their = self._matrix.their_clock(peer_id, doc_id)
-            changes = Backend.get_missing_changes(state, their)
+            key = (doc_id, tuple(sorted(their.items())))
+            if key in extracted:
+                changes = extracted[key]
+            else:
+                changes = extracted[key] = Backend.get_missing_changes(
+                    state, their)
             clock = dict(state.clock)
             if not changes:
                 # the peer's raw clock is behind ours but transitively

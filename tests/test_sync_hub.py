@@ -85,6 +85,44 @@ def test_hub_uses_one_batched_comparison_per_change():
         assert spy.call_count == 1
 
 
+def test_n_connections_share_one_hub_and_one_diff():
+    """Connections are hub-backed: N Connections on one DocSet share ONE
+    ClockMatrix (one batched pending() per local change) and, once the
+    peers' believed clocks agree, ONE get_missing_changes extraction
+    serves all N (the reference's per-Connection loop would diff N times,
+    src/connection.js:58-74)."""
+    from automerge_tpu.sync import connection as conn_mod
+    from automerge_tpu.sync import hub as hub_mod
+
+    ds = DocSet()
+    boxes = [[] for _ in range(3)]
+    conns = [Connection(ds, boxes[i].append) for i in range(3)]
+    for c in conns:
+        c.open()
+    # all three faces share the doc-set's one hub
+    assert len({id(c._hub) for c in conns}) == 1
+    hub = conns[0]._hub
+
+    ds.set_doc("doc", am.change(am.init("alice"),
+                                lambda d: d.__setitem__("x", 1)))
+    for c in conns:   # every peer reveals its (empty) clock
+        c.receive_msg({"docId": "doc", "clock": {}})
+    for box in boxes:
+        assert sum(1 for m in box if m.get("changes")) == 1
+
+    with mock.patch.object(ClockMatrix, "pending",
+                           wraps=hub._matrix.pending) as pend, \
+         mock.patch.object(hub_mod.Backend, "get_missing_changes",
+                           wraps=hub_mod.Backend.get_missing_changes) as gmc:
+        ds.set_doc("doc", am.change(ds.get_doc("doc"),
+                                    lambda d: d.__setitem__("y", 2)))
+        # one local change: ONE batched comparison, ONE shared extraction
+        assert pend.call_count == 1
+        assert gmc.call_count == 1
+    for box in boxes:
+        assert sum(1 for m in box if m.get("changes")) == 2
+
+
 def test_hub_interoperates_with_plain_connection():
     # hub side: two docs
     ds_hub = DocSet()
@@ -231,3 +269,58 @@ def test_missing_changes_fast_cover_path():
     missing = db.get_missing_changes(state, {"alice": 1})
     assert len(missing) == 1 and missing[0]["seq"] == 2
     assert len(db.get_missing_changes(state, {})) == 2
+
+
+def test_connection_close_unhooks_hub_from_docset():
+    """When the last Connection closes, the hub unhooks from the DocSet:
+    no handler remains, snapshot set_doc is legal again, and a reopened
+    connection starts with fresh peer state."""
+    ds = DocSet()
+    d1 = am.change(am.init("alice"), lambda d: d.__setitem__("x", 1))
+    ds.set_doc("doc", d1)
+    c = Connection(ds, lambda m: None)
+    c.open()
+    assert len(ds._handlers) == 1
+    d2 = am.change(d1, lambda d: d.__setitem__("y", 2))
+    ds.set_doc("doc", d2)
+    c.close()
+    assert ds._handlers == []          # hub handler gone
+    assert ds._sync_hub is None
+    # with no connections, putting an older snapshot back is allowed
+    # again (e.g. time-travel UI) — the hub's stale-state guard is gone
+    ds.set_doc("doc", d1)
+    ds.set_doc("doc", d2)
+    c.open()                            # rejoining works, fresh state
+    assert len(ds._handlers) == 1
+    c.close()
+
+
+def test_closed_connection_absorbs_late_messages_without_sending():
+    """A late in-flight message delivered after close() must neither
+    rejoin the hub nor write to the torn-down transport; inbound changes
+    are still absorbed."""
+    ds_a, ds_b = DocSet(), DocSet()
+    out_a, out_b = [], []
+    ca, cb = Connection(ds_a, out_a.append), Connection(ds_b, out_b.append)
+    ds_a.set_doc("doc", am.change(am.init("alice"),
+                                  lambda d: d.__setitem__("x", 1)))
+    ca.open(); cb.open()
+    while out_a or out_b:               # pump to quiescence
+        while out_a:
+            cb.receive_msg(out_a.pop(0))
+        while out_b:
+            ca.receive_msg(out_b.pop(0))
+    assert am.to_json(ds_b.get_doc("doc")) == {"x": 1}
+
+    # a sends one more change-bearing message, then b closes BEFORE it
+    # arrives
+    ds_a.set_doc("doc", am.change(ds_a.get_doc("doc"),
+                                  lambda d: d.__setitem__("y", 2)))
+    late = [m for m in out_a if m.get("changes")]
+    assert late
+    cb.close()
+    n_sent = len(out_b)
+    cb.receive_msg(late[0])             # late delivery after close
+    assert len(out_b) == n_sent         # nothing written to dead transport
+    assert ds_b._sync_hub is None       # did not rejoin
+    assert am.to_json(ds_b.get_doc("doc")) == {"x": 1, "y": 2}  # absorbed
