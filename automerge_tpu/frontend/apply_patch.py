@@ -3,9 +3,15 @@
 Counterpart of /root/reference/frontend/apply_patch.js: structural sharing via
 an `updated` overlay over the previous `cache`, child->parent `inbound` index
 maintenance (single-parent invariant), and parent re-linking up to the root.
-Text diffs are applied element-wise (the reference batches consecutive
-insert/remove splices purely as a JS-array optimization; semantics are
-identical).
+
+Consecutive list/text insert diffs at adjacent indexes — and removes at the
+same index — are applied as ONE slice splice (the reference's optimization,
+apply_patch.js:332-384): a K-insert patch into an N-element document costs
+O(N + K) list work instead of K separate O(N) `list.insert` shifts, which
+turns bulk loads (load/merge of big Text docs) from quadratic to linear.
+A single-element run degenerates to exactly the element-wise operation, so
+there is one code path; ``apply_diffs(..., splice_batch=False)`` keeps the
+element-wise path reachable for the A/B benchmark (benchmarks/run_all.py).
 """
 
 from __future__ import annotations
@@ -208,6 +214,59 @@ def _update_list_object(diff: dict, cache: dict, updated: dict, inbound: dict):
     _update_inbound(object_id, refs_before, refs_after, inbound)
 
 
+def _splice_list_insert(run: list, cache: dict, updated: dict, inbound: dict):
+    """One slice assignment for a run of adjacent-index list inserts."""
+    object_id = run[0]["obj"]
+    if object_id not in updated:
+        updated[object_id] = _clone_list_object(cache.get(object_id), object_id)
+    lst = updated[object_id]
+    idx = run[0]["index"]
+
+    values, confls, eids = [], [], []
+    max_elem = lst._max_elem
+    refs_after = {}
+    for diff in run:
+        value = get_value(diff, cache, updated)
+        conflict = None
+        if diff.get("conflicts"):
+            conflict = {c["actor"]: get_value(c, cache, updated)
+                        for c in diff["conflicts"]}
+        values.append(value)
+        confls.append(conflict)
+        eids.append(diff["elemId"])
+        max_elem = max(max_elem, parse_elem_id(diff["elemId"])[1])
+        for child in (value, *(conflict or {}).values()):
+            if _is_doc_object(child):
+                refs_after[child._object_id] = True
+    lst._max_elem = max_elem
+    list.__setitem__(lst, slice(idx, idx), values)
+    lst._conflicts[idx:idx] = confls
+    lst._elem_ids[idx:idx] = eids
+    _update_inbound(object_id, {}, refs_after, inbound)
+
+
+def _splice_list_remove(run: list, cache: dict, updated: dict, inbound: dict):
+    """One slice deletion for a run of same-index list removes."""
+    object_id = run[0]["obj"]
+    if object_id not in updated:
+        updated[object_id] = _clone_list_object(cache.get(object_id), object_id)
+    lst = updated[object_id]
+    idx, k = run[0]["index"], len(run)
+    if idx < 0 or idx + k > len(lst):
+        # slice deletion would silently clamp; fail loudly like the
+        # element-wise list.__delitem__ does on a malformed diff
+        raise IndexError(
+            f"list remove range [{idx}, {idx + k}) out of bounds "
+            f"for length {len(lst)}")
+    refs_before = {}
+    for i in range(idx, idx + k):
+        refs_before.update(_child_references(lst, i))
+    list.__delitem__(lst, slice(idx, idx + k))
+    del lst._conflicts[idx: idx + k]
+    del lst._elem_ids[idx: idx + k]
+    _update_inbound(object_id, refs_before, {}, inbound)
+
+
 def _parent_list_object(object_id: str, cache: dict, updated: dict):
     if object_id not in updated:
         updated[object_id] = _clone_list_object(cache.get(object_id), object_id)
@@ -225,13 +284,7 @@ def _parent_list_object(object_id: str, cache: dict, updated: dict):
 
 def _update_text_object(diff: dict, cache: dict, updated: dict):
     object_id = diff["obj"]
-    if object_id not in updated:
-        cached = cache.get(object_id)
-        if cached is not None:
-            updated[object_id] = instantiate_text(object_id, list(cached.elems), cached._max_elem)
-        else:
-            updated[object_id] = instantiate_text(object_id, [], 0)
-    text = updated[object_id]
+    text = _text_target(object_id, cache, updated)
 
     action = diff["action"]
     if action == "create":
@@ -253,6 +306,44 @@ def _update_text_object(diff: dict, cache: dict, updated: dict):
         text._max_elem = max(text._max_elem, diff["value"])
     else:
         raise ValueError(f"Unknown action type: {action}")
+
+
+def _splice_text_insert(run: list, cache: dict, updated: dict):
+    """One slice assignment for a run of adjacent-index text inserts."""
+    object_id = run[0]["obj"]
+    text = _text_target(object_id, cache, updated)
+    idx = run[0]["index"]
+    max_elem = text._max_elem
+    elems = []
+    for diff in run:
+        max_elem = max(max_elem, parse_elem_id(diff["elemId"])[1])
+        elems.append({"elemId": diff["elemId"],
+                      "value": get_value(diff, cache, updated),
+                      "conflicts": diff.get("conflicts")})
+    text._max_elem = max_elem
+    text.elems[idx:idx] = elems
+
+
+def _splice_text_remove(run: list, cache: dict, updated: dict):
+    object_id = run[0]["obj"]
+    text = _text_target(object_id, cache, updated)
+    idx, k = run[0]["index"], len(run)
+    if idx < 0 or idx + k > len(text.elems):
+        raise IndexError(
+            f"text remove range [{idx}, {idx + k}) out of bounds "
+            f"for length {len(text.elems)}")
+    del text.elems[idx: idx + k]
+
+
+def _text_target(object_id: str, cache: dict, updated: dict):
+    if object_id not in updated:
+        cached = cache.get(object_id)
+        if cached is not None:
+            updated[object_id] = instantiate_text(
+                object_id, list(cached.elems), cached._max_elem)
+        else:
+            updated[object_id] = instantiate_text(object_id, [], 0)
+    return updated[object_id]
 
 
 def update_parent_objects(cache: dict, updated: dict, inbound: dict):
@@ -278,9 +369,50 @@ def update_parent_objects(cache: dict, updated: dict, inbound: dict):
                 _parent_map_object(object_id, cache, updated)
 
 
-def apply_diffs(diffs: list, cache: dict, updated: dict, inbound: dict):
-    for diff in diffs:
+def _run_end(diffs: list, i: int) -> int:
+    """End (exclusive) of the maximal spliceable run starting at diffs[i]:
+    same object, same action; inserts at adjacent ascending indexes,
+    removes at the same index (how the backend emits a contiguous range —
+    each removal shifts the next element down to the same position)."""
+    first = diffs[i]
+    action, obj, dtype = first["action"], first["obj"], first["type"]
+    j = i + 1
+    while j < len(diffs):
+        d = diffs[j]
+        if d["type"] != dtype or d["obj"] != obj or d["action"] != action:
+            break
+        if action == "insert":
+            if d["index"] != diffs[j - 1]["index"] + 1:
+                break
+        else:  # remove
+            if d["index"] != first["index"]:
+                break
+        j += 1
+    return j
+
+
+def apply_diffs(diffs: list, cache: dict, updated: dict, inbound: dict,
+                *, splice_batch: bool = True):
+    i, n = 0, len(diffs)
+    while i < n:
+        diff = diffs[i]
         diff_type = diff["type"]
+        if (splice_batch and diff_type in ("list", "text")
+                and diff["action"] in ("insert", "remove")):
+            j = _run_end(diffs, i)
+            run = diffs[i:j]
+            if diff_type == "list":
+                if diff["action"] == "insert":
+                    _splice_list_insert(run, cache, updated, inbound)
+                else:
+                    _splice_list_remove(run, cache, updated, inbound)
+            else:
+                if diff["action"] == "insert":
+                    _splice_text_insert(run, cache, updated)
+                else:
+                    _splice_text_remove(run, cache, updated)
+            i = j
+            continue
         if diff_type == "map":
             _update_map_object(diff, cache, updated, inbound)
         elif diff_type == "table":
@@ -291,6 +423,7 @@ def apply_diffs(diffs: list, cache: dict, updated: dict, inbound: dict):
             _update_text_object(diff, cache, updated)
         else:
             raise TypeError(f"Unknown object type: {diff_type}")
+        i += 1
 
 
 def clone_root_object(root: MapDoc) -> MapDoc:

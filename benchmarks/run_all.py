@@ -1,4 +1,5 @@
-"""BASELINE.md benchmark configs 1-5 + a conflict-heavy config 6.
+"""BASELINE.md benchmark configs 1-5 + conflict-heavy (6) and
+frontend-splice (8) configs.
 
 Usage: python -m benchmarks.run_all [--quick] [--record ROUND]
 
@@ -226,6 +227,55 @@ def config6_conflict_heavy(n_actors: int = 200, n_targets: int = 500):
          n_conflicts=len(doc.conflicts))
 
 
+def config8_frontend_splice(n_big: int = 1_000_000, n_base_ab: int = 200_000,
+                            n_ins_ab: int = 20_000):
+    """Frontend patch application: a bulk text-insert patch landing in the
+    MIDDLE of a large existing document (a remote peer's typing run merged
+    into a big doc — the reference's splice-batching case,
+    apply_patch.js:332-384). Element-wise application shifts the whole tail
+    per insert (O(n_ins * n_base)); the splice-batched path is one slice
+    assignment (O(n_base + n_ins)). Tail-append patches are linear either
+    way, so the A/B uses a mid-document run. Host-only (no device).
+    Regression threshold: batched >= 10x element-wise at the A/B size."""
+    import time as _time
+
+    from automerge_tpu.frontend.apply_patch import apply_diffs
+    from automerge_tpu.frontend.types import instantiate_text
+
+    def base_doc(n):
+        elems = [{"elemId": f"b:{i + 1}", "value": "x", "conflicts": None}
+                 for i in range(n)]
+        return instantiate_text("T", elems, n)
+
+    def insert_diffs(n, at):
+        return [{"type": "text", "obj": "T", "action": "insert",
+                 "index": at + i, "elemId": f"a:{i + 1}", "value": "y"}
+                for i in range(n)]
+
+    def apply_once(n_base, n_ins, splice):
+        cache = {"T": base_doc(n_base)}
+        updated = {}
+        diffs = insert_diffs(n_ins, at=1000)
+        t0 = _time.perf_counter()
+        apply_diffs(diffs, cache, updated, {}, splice_batch=splice)
+        dt = _time.perf_counter() - t0
+        assert len(updated["T"].elems) == n_base + n_ins
+        return dt, updated["T"]
+
+    el_s, el_doc = apply_once(n_base_ab, n_ins_ab, splice=False)
+    sp_s, sp_doc = apply_once(n_base_ab, n_ins_ab, splice=True)
+    assert [e["elemId"] for e in el_doc.elems] == \
+        [e["elemId"] for e in sp_doc.elems]          # A/B parity
+    speedup = el_s / sp_s
+    assert speedup >= 10, f"splice batching only {speedup:.1f}x"
+    big_s, _ = apply_once(n_big, n_big, splice=True)
+    emit(f"cfg8_frontend_apply_{n_big // 1000}k_insert_patch",
+         n_big / big_s, "chars/s",
+         elementwise_s_at_20k_into_200k=round(el_s, 4),
+         batched_s_at_20k_into_200k=round(sp_s, 4),
+         speedup=round(speedup, 1))
+
+
 def main():
     from benchmarks.common import preflight_device
     if not preflight_device():
@@ -241,6 +291,7 @@ def main():
     config3_docset(n_docs=100 if quick else 1000)
     config4_trellis(quick=quick)
     config6_conflict_heavy()
+    config8_frontend_splice(n_big=200_000 if quick else 1_000_000)
     if record_round is not None:
         # cfg5 = the headline bench, folded into the record file
         import json as _json

@@ -151,3 +151,128 @@ class TestUndoRedoRequests:
         assert not Frontend.can_undo(doc)  # undo in flight
         with pytest.raises(ValueError, match="one undo in flight"):
             Frontend.undo(doc)
+
+
+class TestSpliceBatchedApply:
+    """The splice-batched diff application (apply_patch.py:_run_end +
+    _splice_*) must be byte-identical to the element-wise path on any diff
+    sequence — runs are an optimization, never a semantics change."""
+
+    @staticmethod
+    def _apply_both(diffs):
+        import copy
+
+        from automerge_tpu.frontend.apply_patch import apply_diffs
+
+        results = []
+        for splice in (False, True):
+            updated, inbound = {}, {}
+            apply_diffs(copy.deepcopy(diffs), {}, updated, inbound,
+                        splice_batch=splice)
+            results.append(updated["X"])
+        return results
+
+    def test_random_text_sequences_match(self):
+        import random
+        for seed in range(6):
+            rng = random.Random(7000 + seed)
+            diffs = [{"type": "text", "obj": "X", "action": "create"}]
+            n, ctr = 0, 0
+            for _ in range(rng.randrange(3, 9)):   # bursts -> natural runs
+                if n and rng.random() < 0.35:      # remove run (same index)
+                    idx = rng.randrange(n)
+                    k = min(rng.randrange(1, 5), n - idx)
+                    diffs += [{"type": "text", "obj": "X",
+                               "action": "remove", "index": idx}
+                              for _ in range(k)]
+                    n -= k
+                else:                               # adjacent insert run
+                    idx = rng.randint(0, n)
+                    for i in range(rng.randrange(1, 6)):
+                        ctr += 1
+                        diffs.append({"type": "text", "obj": "X",
+                                      "action": "insert", "index": idx + i,
+                                      "elemId": f"a:{ctr}",
+                                      "value": chr(97 + ctr % 26)})
+                        n += 1
+                    if rng.random() < 0.3 and n:    # break runs with a set
+                        j = rng.randrange(n)
+                        diffs.append({"type": "text", "obj": "X",
+                                      "action": "set", "index": j,
+                                      "value": "S"})
+            el, sp = self._apply_both(diffs)
+            assert [e["elemId"] for e in el.elems] == \
+                [e["elemId"] for e in sp.elems], f"seed {seed}"
+            assert [e["value"] for e in el.elems] == \
+                [e["value"] for e in sp.elems], f"seed {seed}"
+            assert el._max_elem == sp._max_elem
+
+    def test_random_list_sequences_match(self):
+        import random
+        for seed in range(6):
+            rng = random.Random(8800 + seed)
+            diffs = [{"type": "list", "obj": "X", "action": "create"}]
+            n, ctr = 0, 0
+            for _ in range(rng.randrange(3, 9)):
+                if n and rng.random() < 0.35:
+                    idx = rng.randrange(n)
+                    k = min(rng.randrange(1, 5), n - idx)
+                    diffs += [{"type": "list", "obj": "X",
+                               "action": "remove", "index": idx}
+                              for _ in range(k)]
+                    n -= k
+                else:
+                    idx = rng.randint(0, n)
+                    for i in range(rng.randrange(1, 6)):
+                        ctr += 1
+                        diffs.append({"type": "list", "obj": "X",
+                                      "action": "insert", "index": idx + i,
+                                      "elemId": f"a:{ctr}", "value": ctr})
+                        n += 1
+            el, sp = self._apply_both(diffs)
+            assert list(el) == list(sp), f"seed {seed}"
+            assert el._elem_ids == sp._elem_ids, f"seed {seed}"
+            assert el._conflicts == sp._conflicts, f"seed {seed}"
+            assert el._max_elem == sp._max_elem
+
+    def test_bulk_merge_through_facade_uses_runs(self):
+        """End-to-end: merging a remote typing run into a big doc emits an
+        adjacent-index insert run and the splice path serves it."""
+        import importlib
+        from unittest import mock
+
+        import automerge_tpu as am
+        # frontend/__init__ re-exports a FUNCTION named apply_patch that
+        # shadows the submodule on attribute access; import the module
+        ap_mod = importlib.import_module(
+            "automerge_tpu.frontend.apply_patch")
+
+        base = am.change(am.init("aaaa"),
+                         lambda d: d.__setitem__("t", am.Text("x" * 2000)))
+        peer = am.apply_changes(am.init("bbbb"), am.get_all_changes(base))
+        peer = am.change(peer, lambda d: d["t"].insert_at(50, *("Y" * 300)))
+        with mock.patch.object(
+                ap_mod, "_splice_text_insert",
+                wraps=ap_mod._splice_text_insert) as spy:
+            merged = am.merge(base, peer)
+        assert str(merged["t"])[50:350] == "Y" * 300
+        # the 300-char run arrived as few splices, not 300 single inserts
+        run_sizes = [len(c.args[0]) for c in spy.call_args_list]
+        assert sum(run_sizes) >= 300 and max(run_sizes) >= 100, run_sizes
+
+    def test_out_of_range_remove_raises_both_paths(self):
+        """Malformed remove diffs fail loudly on BOTH paths — the slice
+        splice must not silently clamp where element-wise raises."""
+        import pytest
+
+        from automerge_tpu.frontend.apply_patch import apply_diffs
+
+        for dtype in ("text", "list"):
+            mk = [{"type": dtype, "obj": "X", "action": "create"},
+                  {"type": dtype, "obj": "X", "action": "insert",
+                   "index": 0, "elemId": "a:1", "value": "v"}]
+            for splice in (False, True):
+                bad = mk + [{"type": dtype, "obj": "X",
+                             "action": "remove", "index": 1}]  # past end
+                with pytest.raises(IndexError):
+                    apply_diffs(bad, {}, {}, {}, splice_batch=splice)
