@@ -1,0 +1,160 @@
+"""Graduation is provably unreachable for well-formed documents.
+
+Round-3 widened `_in_scope` (backend/device.py) to accept every well-formed
+op shape — nested maps/lists/tables/text, links, counters, undo/redo — so
+the graduation escape hatch should fire ONLY for malformed deliveries
+(unknown op actions). This file pins that contract:
+
+- a property fuzz drives random arbitrarily-nested histories through the
+  full public API and asserts ``GRADUATION_STATS == {}`` at the end (the
+  device tier served everything);
+- one test documents the single remaining trigger (an op whose action the
+  wire schema does not define) and that behavior is still correct after
+  graduating — a performance cliff, never a behavior change.
+"""
+
+import random
+
+import automerge_tpu as am
+from automerge_tpu import Table, Text
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.backend import device as device_backend
+
+
+def _random_value(rng, depth):
+    r = rng.random()
+    if depth > 2 or r < 0.4:
+        return rng.choice([1, "s", True, None, 3.5])
+    if r < 0.55:
+        return {rng.choice("pq"): _random_value(rng, depth + 1)}
+    if r < 0.7:
+        return [_random_value(rng, depth + 1)
+                for _ in range(rng.randrange(1, 3))]
+    if r < 0.8:
+        return Text(rng.choice(["", "ab", "xyz"]))
+    if r < 0.9:
+        return am.Counter(rng.randrange(5))
+    return Table()
+
+
+def _containers(doc):
+    """Every mutable container reachable from the root, with its path."""
+    out = []
+
+    def walk(obj, depth):
+        if depth > 4:
+            return
+        out.append(obj)
+        if isinstance(obj, dict):
+            children = obj.values()
+        elif isinstance(obj, list):
+            children = list(obj)
+        elif isinstance(obj, Table):
+            children = list(obj.rows)
+        else:
+            return
+        for child in children:
+            if isinstance(child, (dict, list, Table)):
+                walk(child, depth + 1)
+
+    walk(doc, 0)
+    return out
+
+
+def _random_nested_edit(rng, doc, actor):
+    """One change mutating a random container anywhere in the tree."""
+
+    def cb(d):
+        targets = _containers(d)
+        obj = rng.choice(targets)
+        if isinstance(obj, Table):
+            ids = obj.ids
+            if ids and rng.random() < 0.3:
+                obj.remove(rng.choice(ids))
+            else:
+                obj.add({"title": f"{actor}-{rng.randrange(99)}",
+                         "nested": _random_value(rng, 2)})
+        elif isinstance(obj, list):
+            if len(obj) and rng.random() < 0.35:
+                obj.delete_at(rng.randrange(len(obj)))
+            else:
+                obj.insert(rng.randint(0, len(obj)),
+                           _random_value(rng, 1))
+        else:  # map (root or nested)
+            key = rng.choice("abcde")
+            r = rng.random()
+            if key in obj and isinstance(obj[key], am.Counter):
+                # counters cannot be overwritten (reference semantics):
+                # increment or delete only
+                if r < 0.3:
+                    del obj[key]
+                else:
+                    obj[key].increment(rng.randrange(1, 4))
+            elif key in obj and r < 0.25:
+                del obj[key]
+            elif key in obj and isinstance(obj[key], Text) and r < 0.5:
+                t = obj[key]
+                t.insert_at(rng.randint(0, len(t)), rng.choice("mn"))
+            else:
+                obj[key] = _random_value(rng, 0)
+
+    return am.change(doc, cb)
+
+
+def test_nested_fuzz_never_graduates():
+    """Random nested multi-actor histories (edits, merges, undo/redo,
+    save/load) stay on the device tier end to end: zero graduations."""
+    for seed in range(4):
+        rng = random.Random(31_000 + seed)
+        device_backend.GRADUATION_STATS.clear()
+        n_actors = rng.randint(2, 3)
+        base = am.change(am.init("base"),
+                         lambda d: d.update({"seed": 1}))
+        base_changes = am.get_all_changes(base)
+        docs = [am.apply_changes(am.init(f"actor-{i}"), base_changes)
+                for i in range(n_actors)]
+
+        for _ in range(5):
+            for i in range(n_actors):
+                if rng.random() < 0.85:
+                    docs[i] = _random_nested_edit(rng, docs[i],
+                                                  f"actor-{i}")
+                if rng.random() < 0.15 and am.can_undo(docs[i]):
+                    docs[i] = am.undo(docs[i])
+                    if rng.random() < 0.5 and am.can_redo(docs[i]):
+                        docs[i] = am.redo(docs[i])
+            i, j = rng.sample(range(n_actors), 2)
+            docs[i] = am.merge(docs[i], docs[j])
+
+        merged = docs[0]
+        for d in docs[1:]:
+            merged = am.merge(merged, d)
+        merged = am.load(am.save(merged))          # replay path too
+        am.to_json(merged)                          # full materialization
+        assert isinstance(Frontend.get_backend_state(merged),
+                          device_backend.DeviceBackendState), \
+            f"seed {seed}: left the device tier"
+        assert device_backend.GRADUATION_STATS == {}, \
+            f"seed {seed}: graduated on well-formed input: " \
+            f"{device_backend.GRADUATION_STATS}"
+
+
+def test_malformed_delivery_is_the_only_graduation_trigger():
+    """An op action outside the wire schema — the one remaining trigger —
+    is surfaced in GRADUATION_STATS and then authoritatively REJECTED by
+    the oracle (the reference throws on unknown op types too,
+    backend/op_set.js applyOps); the prior document state stays usable."""
+    import pytest
+
+    device_backend.GRADUATION_STATS.clear()
+    doc = am.change(am.init("aaaa"), lambda d: d.__setitem__("x", 1))
+    malformed = {"actor": "zzzz", "seq": 1, "deps": {}, "ops": [
+        {"action": "frobnicate", "obj": am.ROOT_ID, "key": "z"},
+    ]}
+    with pytest.raises(ValueError, match="Unknown operation type"):
+        am.apply_changes(doc, [malformed])
+    assert device_backend.GRADUATION_STATS == {"out_of_scope": 1}
+    # the failed delivery left the original document fully usable
+    assert am.to_json(doc) == {"x": 1}
+    doc2 = am.change(doc, lambda d: d.__setitem__("y", 2))
+    assert am.to_json(doc2) == {"x": 1, "y": 2}
