@@ -150,6 +150,21 @@ class CausalDeviceDoc:
             return True
         return all_deps.get(self.actor_table[op["actor_rank"]], 0) >= op["seq"]
 
+    @staticmethod
+    def _shared_frontier(deps_list, rows, seqs):
+        """The ONE deps dict shared (by identity) by every given row, all
+        at seq 1 — the wide-concurrent-merge shape (N actors, one
+        frontier) — or None. Identity is deliberate: `intern_deps`
+        (columnar.py) collapses equal dicts at batch construction, so the
+        common shape is recognized in O(rows) pointer compares and the
+        closure/admission work collapses to a single computation. Any
+        other shape falls back to the general per-row path."""
+        d0 = deps_list[rows[0]]
+        for r in rows:
+            if seqs[r] != 1 or deps_list[r] is not d0:
+                return None
+        return d0
+
     # ------------------------------------------------------------------
     # batch application
     # ------------------------------------------------------------------
@@ -170,6 +185,23 @@ class CausalDeviceDoc:
         queue_after: list = []
         batch_actors = batch.actors
         batch_seqs = batch.seqs.tolist() if batch.n_changes else []
+
+        # fast path — wide concurrent merge: empty queue, every change at
+        # seq 1 from a distinct new actor, all sharing ONE already-covered
+        # dep frontier. One check admits the whole batch as one round.
+        # (A frontier naming a batch actor would need the slow path's
+        # self-dep skip; such an actor has clock>=1 and fails the new-actor
+        # test, so the fallback is automatic.)
+        if not prior_queue and batch.n_changes:
+            d0 = self._shared_frontier(batch.deps, range(batch.n_changes),
+                                       batch_seqs)
+            if d0 is not None and all(
+                    clock.get(a, 0) >= s for a, s in d0.items()):
+                actor_set = set(batch_actors)
+                if (len(actor_set) == batch.n_changes
+                        and not (actor_set & clock.keys())):
+                    return ([[(batch, r) for r in range(batch.n_changes)]],
+                            [], prior_queue)
         while pending:
             ready, not_ready = [], []
             for item in pending:
@@ -232,15 +264,24 @@ class CausalDeviceDoc:
     def _group_round(ready) -> list:
         """Group one round's (batch, row) pairs by source batch and compute
         each group's op mask."""
+        b0 = ready[0][0]
+        if len(ready) == b0.n_changes and all(it[0] is b0 for it in ready):
+            # single whole batch (the fast-schedule shape): rows are the
+            # full dedeuplicated set by construction
+            return [(b0, np.arange(b0.n_changes, dtype=np.int32),
+                     slice(None))]
         by_batch: dict = {}
         for b, row in ready:
             by_batch.setdefault(id(b), (b, []))[1].append(row)
         groups = []
         for b, rows in by_batch.values():
-            rows_arr = np.asarray(sorted(rows), np.int32)
-            if len(rows_arr) == b.n_changes:
-                mask = slice(None)  # whole batch ready: no filtering needed
+            if len(rows) == b.n_changes:
+                # whole batch ready (scheduler dedupes, so a full-length
+                # row list IS 0..n-1): no sort, no filtering
+                rows_arr = np.arange(b.n_changes, dtype=np.int32)
+                mask = slice(None)
             else:
+                rows_arr = np.sort(np.asarray(rows, np.int32))
                 mask = np.isin(b.op_change, rows_arr)
             groups.append((b, rows_arr, mask))
         return groups
@@ -248,13 +289,28 @@ class CausalDeviceDoc:
     def _round_bookkeeping(self, b, rows_arr):
         """Advance clock/_all_deps for a round's rows; returns the snapshots
         `_rollback_bookkeeping` needs if the round's ingest fails."""
-        prev_clock: dict = {}
-        prev_deps: dict = {}
         clock = self.clock
         all_deps = self._all_deps
         actors, deps_list = b.actors, b.deps
         seqs = b.seqs.tolist()
-        for row in rows_arr.tolist():
+        rows = rows_arr.tolist()
+
+        d0 = self._shared_frontier(deps_list, rows, seqs) if rows else None
+        if d0 is not None:
+            # one closure serves the whole round; bookkeeping is bulk
+            # C-speed dict work (dict.fromkeys/update) per row
+            hit = self._compute_all_deps(actors[rows[0]], 1, d0)
+            row_actors = [actors[r] for r in rows]
+            pairs = [(a, 1) for a in row_actors]
+            prev_clock = {a: clock.get(a) for a in row_actors}
+            prev_deps = {p: all_deps.get(p) for p in pairs}
+            all_deps.update(dict.fromkeys(pairs, hit))
+            clock.update(dict.fromkeys(row_actors, 1))
+            return prev_clock, prev_deps
+
+        prev_clock = {}
+        prev_deps = {}
+        for row in rows:
             actor, seq = actors[row], seqs[row]
             if actor not in prev_clock:
                 prev_clock[actor] = clock.get(actor)
@@ -352,15 +408,26 @@ class CausalDeviceDoc:
             for b, rows_arr, mask in self._group_round(ready):
                 actors, deps_list = b.actors, b.deps
                 seqs_l = b.seqs.tolist()
-                pairs, closures = [], []
-                for row in rows_arr.tolist():
-                    actor, seq = actors[row], seqs_l[row]
+                rows_l = rows_arr.tolist()
+                d0 = (self._shared_frontier(deps_list, rows_l, seqs_l)
+                      if rows_l else None)
+                if d0 is not None:
                     hit = self._compute_all_deps(
-                        actor, seq, deps_list[row], all_deps=all_map,
+                        actors[rows_l[0]], 1, d0, all_deps=all_map,
                         memo=memo_map)
-                    deps_overlay[(actor, seq)] = hit
-                    pairs.append((actor, seq))
-                    closures.append(hit)
+                    pairs = [(actors[r], 1) for r in rows_l]
+                    closures = [hit] * len(rows_l)
+                    deps_overlay.update(dict.fromkeys(pairs, hit))
+                else:
+                    pairs, closures = [], []
+                    for row in rows_l:
+                        actor, seq = actors[row], seqs_l[row]
+                        hit = self._compute_all_deps(
+                            actor, seq, deps_list[row], all_deps=all_map,
+                            memo=memo_map)
+                        deps_overlay[(actor, seq)] = hit
+                        pairs.append((actor, seq))
+                        closures.append(hit)
                 exec_plan = None
                 if b.n_ops:
                     exec_plan, shadow = self._plan_round(b, mask, shadow)

@@ -20,6 +20,24 @@ from .._common import (HEAD_PARENT, KIND_DEL, KIND_INC, KIND_INS,  # noqa: F401
                        KIND_SET, parse_elem_id)
 
 
+def intern_deps(deps: list) -> list:
+    """Collapse equal dep dicts to one shared object. Wide concurrent
+    batches (N changes all depending on the same frontier) then expose
+    that shape by IDENTITY, which the engine's shared-frontier fast paths
+    key on (engine/base.py:_shared_frontier) — admission and closure
+    bookkeeping become O(1) dict work per change instead of a per-change
+    closure walk."""
+    cache: dict = {}
+    out = []
+    for d in deps:
+        key = tuple(sorted(d.items()))
+        hit = cache.get(key)
+        if hit is None:
+            hit = cache[key] = d
+        out.append(hit)
+    return out
+
+
 @dataclass
 class MapChangeBatch:
     """A batch of changes targeting one map object, columnar.
@@ -106,7 +124,8 @@ class MapChangeBatch:
 
         return cls(
             obj_id=obj_id, actors=actors,
-            seqs=np.asarray(seqs, np.int32), deps=deps, messages=messages,
+            seqs=np.asarray(seqs, np.int32), deps=intern_deps(deps),
+            messages=messages,
             op_change=np.asarray(cols["change"], np.int32),
             op_kind=np.asarray(cols["kind"], np.int8),
             op_key=np.asarray(cols["key"], np.int32),
@@ -231,7 +250,8 @@ class TextChangeBatch:
 
         return cls(
             obj_id=obj_id, actors=actors,
-            seqs=np.asarray(seqs, np.int32), deps=deps, messages=messages,
+            seqs=np.asarray(seqs, np.int32), deps=intern_deps(deps),
+            messages=messages,
             op_change=np.asarray(cols["change"], np.int32),
             op_kind=np.asarray(cols["kind"], np.int8),
             op_target_actor=np.asarray(cols["ta"], np.int32),

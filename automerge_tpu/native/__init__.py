@@ -46,7 +46,7 @@ def _load():
                 os.makedirs(os.path.dirname(_SO), exist_ok=True)
                 subprocess.run(
                     ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                     _SRC, "-o", _SO],
+                     "-pthread", _SRC, "-o", _SO],
                     check=True, capture_output=True, timeout=120)
             lib = ctypes.CDLL(_SO)
             lib.amtpu_parse.restype = ctypes.c_void_p
@@ -179,9 +179,10 @@ def decode_text_changes(data, obj_id: str):
             s = raw.decode("utf-8")
             return s.split("\n") if s else []
 
+        from ..engine.columnar import intern_deps
         actors = split(lib.amtpu_actors(h))
         actor_table = split(lib.amtpu_actor_table(h))
-        deps = [json.loads(d) for d in split(lib.amtpu_deps(h))]
+        deps = intern_deps([json.loads(d) for d in split(lib.amtpu_deps(h))])
         raw_msgs = lib.amtpu_messages(h).decode("utf-8")
         messages = []
         if n_changes:

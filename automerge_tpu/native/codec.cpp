@@ -18,11 +18,14 @@
 // Build: g++ -O2 -shared -fPIC codec.cpp -o libamtpu_codec.so (driven by
 // automerge_tpu/native/__init__.py, cached; ctypes binding, no pybind11).
 
+#include <algorithm>
 #include <climits>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <vector>
 #include <unordered_map>
 
@@ -516,6 +519,93 @@ struct RunPlan {
     bool blob_lt_128 = true, blob_lt_256 = true;
 };
 
+// ---------------------------------------------------------------------------
+// Parallel run detection. The greedy scan carries only (a) whether the scan
+// position is even with respect to pair consumption — i.e. whether a pair
+// crossing the chunk boundary consumed its first op — and (b) whether the
+// immediately preceding pair ended at pos-2 (run contiguity). Chunks are
+// therefore simulated speculatively for the two possible entry ALIGNMENTS
+// (boundary op not consumed / consumed by a boundary-crossing pair), with
+// contiguity resolved by construction: the sim assumes the "a pair may have
+// ended at start-2" basis, and pairs continuing that entry run accumulate in
+// `lead_len` instead of minting a head. The serial stitch then either merges
+// the lead into the previous chunk's last run (entry was contiguous) or
+// mints the head at the chunk start (it was not). Head slots / residual
+// slots are stored chunk-local and rebased by the stitched global INS count.
+// ---------------------------------------------------------------------------
+
+struct SimOut {
+    std::vector<int64_t> hpos, run_len, head_ins;  // heads; local ins before
+    std::vector<int64_t> rpos, res_ins;  // residuals; local ins after, or -1
+    std::vector<int32_t> blob;
+    int64_t lead_len = 0;   // pairs continuing the PREVIOUS chunk's run
+    int64_t ins_count = 0;  // INS ops consumed in this chunk
+    int exit_state = 0;     // next chunk entry: 0 aligned/non-contig,
+                            // 1 aligned/contig, 2 misaligned (consumed)
+    bool blob_lt_128 = true, blob_lt_256 = true;
+};
+
+static void simulate_chunk(
+    int64_t start, int64_t end, int64_t n, const int8_t* kind,
+    const int32_t* ta, const int32_t* tc, const int32_t* pa,
+    const int32_t* pc, const int64_t* val, const int32_t* row,
+    SimOut& o) {
+    constexpr int8_t INS = 0, SET = 1;
+    constexpr int64_t NO_PAIR = INT64_MIN;  // can never equal i-2
+    if (end > start) {
+        o.blob.reserve((end - start) / 2 + 1);  // avoid regrow copies of
+        o.hpos.reserve(1024);                   // the per-pair vector
+        o.run_len.reserve(1024);
+        o.head_ins.reserve(1024);
+    }
+    int64_t prev_pair = start - 2;  // entry basis: a pair MAY have ended
+                                    // at start-2 (stitch resolves truth)
+    int64_t i = start;
+    while (i < end) {
+        bool pair = (kind[i] == INS && i + 1 < n && kind[i + 1] == SET
+                     && row[i + 1] == row[i] && ta[i + 1] == ta[i]
+                     && tc[i + 1] == tc[i] && val[i + 1] >= 0
+                     && val[i + 1] < (1LL << 31));
+        if (pair) {
+            bool cont = (prev_pair == i - 2 && prev_pair >= 0
+                         && row[i] == row[i - 2]
+                         && ta[i] == ta[i - 2] && tc[i] == tc[i - 2] + 1
+                         && pa[i] == ta[i - 2] && pc[i] == tc[i - 2]);
+            if (cont && o.hpos.empty() && o.rpos.empty()) {
+                o.lead_len++;  // unbroken cont prefix from `start`
+            } else if (cont) {
+                o.run_len.back()++;
+            } else {
+                o.hpos.push_back(i);
+                o.run_len.push_back(1);
+                o.head_ins.push_back(o.ins_count);
+            }
+            int64_t v = val[i + 1];
+            o.blob.push_back((int32_t)v);
+            if (v >= 128) o.blob_lt_128 = false;
+            if (v >= 256) o.blob_lt_256 = false;
+            o.ins_count++;
+            prev_pair = i;
+            i += 2;
+        } else {
+            o.rpos.push_back(i);
+            if (kind[i] == INS) {
+                o.ins_count++;
+                o.res_ins.push_back(o.ins_count);
+            } else {
+                o.res_ins.push_back(-1);
+            }
+            prev_pair = NO_PAIR;
+            i += 1;
+        }
+    }
+    if (i == end) {
+        o.exit_state = (prev_pair == end - 2 && prev_pair >= 0) ? 1 : 0;
+    } else {
+        o.exit_state = 2;  // the pair at end-1 consumed op `end`
+    }
+}
+
 extern "C" {
 
 void* amtpu_detect_runs(
@@ -523,46 +613,94 @@ void* amtpu_detect_runs(
     const int32_t* pa, const int32_t* pc, const int64_t* val,
     const int32_t* row, int64_t base_elems) {
     auto* p = new RunPlan();
-    constexpr int8_t INS = 0, SET = 1;
-    constexpr int64_t NO_PAIR = INT64_MIN;  // can never equal i-2
-    int64_t ins_count = 0;
-    int64_t prev_pair = NO_PAIR;  // op index of the previous pair's INS
-    int64_t i = 0;
-    while (i < n) {
-        bool pair = (kind[i] == INS && i + 1 < n && kind[i + 1] == SET
-                     && row[i + 1] == row[i] && ta[i + 1] == ta[i]
-                     && tc[i + 1] == tc[i] && val[i + 1] >= 0
-                     && val[i + 1] < (1LL << 31));
-        if (pair) {
-            bool cont = (prev_pair == i - 2 && row[i] == row[i - 2]
-                         && ta[i] == ta[i - 2] && tc[i] == tc[i - 2] + 1
-                         && pa[i] == ta[i - 2] && pc[i] == tc[i - 2]);
-            if (!cont) {
-                p->hpos.push_back(i);
-                p->run_len.push_back(0);
-                p->head_slot.push_back(base_elems + ins_count + 1);
-            }
-            p->run_len.back()++;
-            int64_t v = val[i + 1];
-            p->blob.push_back((int32_t)v);
-            if (v >= 128) p->blob_lt_128 = false;
-            if (v >= 256) p->blob_lt_256 = false;
-            ins_count++;
-            prev_pair = i;
-            i += 2;
-        } else {
-            p->rpos.push_back(i);
-            if (kind[i] == INS) {
-                ins_count++;
-                p->res_new_slot.push_back(base_elems + ins_count);
-            } else {
-                p->res_new_slot.push_back(-1);
-            }
-            prev_pair = NO_PAIR;
-            i += 1;
-        }
+
+    constexpr int64_t MIN_CHUNK = 1 << 19;  // thread fan-out threshold
+    int64_t hw = (int64_t)std::thread::hardware_concurrency();
+    // test/tuning hook: AMTPU_DETECT_THREADS forces the fan-out width so
+    // the speculative stitch is exercisable on low-core machines
+    if (const char* env_t = getenv("AMTPU_DETECT_THREADS")) {
+        long forced = atol(env_t);
+        if (forced > 0) hw = forced;
     }
-    p->n_ins = ins_count;
+    int64_t T = std::min(hw > 0 ? hw : 1, (n + MIN_CHUNK - 1) / MIN_CHUNK);
+    T = std::min<int64_t>(T, 32);
+
+    if (T <= 1) {
+        // serial: single chunk, entry aligned and non-contiguous (a lead
+        // cannot form: prev_pair = -2 fails the >= 0 guard)
+        SimOut s;
+        simulate_chunk(0, n, n, kind, ta, tc, pa, pc, val, row, s);
+        p->hpos = std::move(s.hpos);
+        p->run_len = std::move(s.run_len);
+        p->head_slot.resize(p->hpos.size());
+        for (size_t j = 0; j < p->hpos.size(); ++j)
+            p->head_slot[j] = base_elems + s.head_ins[j] + 1;
+        p->rpos = std::move(s.rpos);
+        p->res_new_slot.resize(p->rpos.size());
+        for (size_t j = 0; j < p->rpos.size(); ++j)
+            p->res_new_slot[j] =
+                s.res_ins[j] >= 0 ? base_elems + s.res_ins[j] : -1;
+        p->blob = std::move(s.blob);
+        p->n_ins = s.ins_count;
+        p->blob_lt_128 = s.blob_lt_128;
+        p->blob_lt_256 = s.blob_lt_256;
+        return p;
+    }
+
+    std::vector<int64_t> cuts(T + 1);
+    for (int64_t k = 0; k <= T; ++k) cuts[k] = n * k / T;
+    // two sims per chunk: entry aligned at cuts[k], entry misaligned at
+    // cuts[k]+1 (chunk 0 only aligned)
+    std::vector<SimOut> A(T), M(T);
+    std::vector<std::thread> threads;
+    threads.reserve(2 * T - 1);  // one thread per SIM (not per chunk):
+    for (int64_t k = 0; k < T; ++k) {  // keeps the critical path ~n/T
+        threads.emplace_back([&, k] {  // instead of 2n/T
+            simulate_chunk(cuts[k], cuts[k + 1], n, kind, ta, tc, pa, pc,
+                           val, row, A[k]);
+        });
+        if (k > 0)
+            threads.emplace_back([&, k] {
+                simulate_chunk(cuts[k] + 1, cuts[k + 1], n, kind, ta, tc,
+                               pa, pc, val, row, M[k]);
+            });
+    }
+    for (auto& t : threads) t.join();
+
+    // serial stitch: resolve each chunk's entry state, rebase slots
+    int state = 0;
+    int64_t ins_base = 0;
+    for (int64_t k = 0; k < T; ++k) {
+        SimOut& s = (state == 2) ? M[k] : A[k];
+        if (s.lead_len) {
+            if (state == 0) {
+                // entry was NOT contiguous: the lead is its own run
+                // headed at the chunk's first op (local ins count 0;
+                // state 0 implies the aligned sim, so the first op is
+                // at cuts[k])
+                p->hpos.push_back(cuts[k]);
+                p->run_len.push_back(s.lead_len);
+                p->head_slot.push_back(base_elems + ins_base + 1);
+            } else {
+                p->run_len.back() += s.lead_len;
+            }
+        }
+        p->hpos.insert(p->hpos.end(), s.hpos.begin(), s.hpos.end());
+        p->run_len.insert(p->run_len.end(), s.run_len.begin(),
+                          s.run_len.end());
+        for (int64_t h : s.head_ins)
+            p->head_slot.push_back(base_elems + ins_base + h + 1);
+        p->rpos.insert(p->rpos.end(), s.rpos.begin(), s.rpos.end());
+        for (int64_t r : s.res_ins)
+            p->res_new_slot.push_back(
+                r >= 0 ? base_elems + ins_base + r : -1);
+        p->blob.insert(p->blob.end(), s.blob.begin(), s.blob.end());
+        p->blob_lt_128 = p->blob_lt_128 && s.blob_lt_128;
+        p->blob_lt_256 = p->blob_lt_256 && s.blob_lt_256;
+        ins_base += s.ins_count;
+        state = s.exit_state;
+    }
+    p->n_ins = ins_base;
     return p;
 }
 

@@ -233,3 +233,76 @@ def test_llong_wrapping_int_falls_back():
         '[{"actor": "a", "seq": %s, "deps": {}, "ops": []}]' % huge,
     ):
         assert native.decode_text_changes(payload, "t") is None
+
+
+@needs_native
+@pytest.mark.parametrize("seed", range(3))
+def test_run_detection_parity_parallel_path(seed, monkeypatch):
+    """Parity at sizes that cross the native detector's thread fan-out
+    threshold (MIN_CHUNK = 2^19 ops per chunk), with long runs spanning
+    chunk boundaries, boundary residuals, and pairs straddling the cut —
+    the speculative-chunk stitch must be byte-identical to numpy.
+    AMTPU_DETECT_THREADS forces the fan-out so the stitch actually runs
+    even on single-core machines (where hardware_concurrency()==1 would
+    silently take the serial branch)."""
+    from automerge_tpu.engine.runs import _detect_runs_numpy
+    from automerge_tpu.native import detect_runs_native
+
+    monkeypatch.setenv("AMTPU_DETECT_THREADS", "3")
+    rng = np.random.default_rng(900 + seed)
+    n = 1_400_000 + int(rng.integers(0, 7))   # > 2 chunks, odd tails
+    kind = np.full(n, 1, np.int8)
+    ta = np.zeros(n, np.int32)
+    tc = np.zeros(n, np.int32)
+    pa = np.zeros(n, np.int32)
+    pc = np.zeros(n, np.int32)
+    val = np.zeros(n, np.int64)
+    row = np.zeros(n, np.int32)
+    i, r, c = 0, 0, 1
+    while i < n - 1:
+        pick = rng.random()
+        if pick < 0.82:
+            # a typing run of random length (often crossing a boundary)
+            L = int(rng.integers(1, 120_000))
+            L = min(L, (n - 1 - i) // 2)
+            if L <= 0:
+                break
+            idx = i + 2 * np.arange(L)
+            kind[idx] = 0
+            kind[idx + 1] = 1
+            a_ = int(rng.integers(0, 5))
+            ta[idx] = a_
+            ta[idx + 1] = a_
+            ctr = c + np.arange(L)
+            tc[idx] = ctr
+            tc[idx + 1] = ctr
+            pa[idx] = a_
+            pc[idx] = ctr - 1
+            pa[i] = int(rng.integers(0, 5))      # run head: foreign parent
+            pc[i] = int(rng.integers(0, 50))
+            val[idx + 1] = rng.integers(32, 300, L)
+            row[idx] = r
+            row[idx + 1] = r
+            c += L + 1
+            i += 2 * L
+        else:
+            # residual op (del/inc/bare ins) right at arbitrary offsets
+            kind[i] = int(rng.integers(0, 4))
+            ta[i] = int(rng.integers(0, 5))
+            tc[i] = c
+            c += 1
+            i += 1
+        r += 1
+    a = _detect_runs_numpy(kind, ta, tc, pa, pc, val, row, 37)
+    out = detect_runs_native(kind, ta, tc, pa, pc, val, row, 37)
+    assert out is not None
+    (hpos, run_len, head_slot, rpos, res_new_slot, blob, n_ins,
+     lt128, lt256) = out
+    np.testing.assert_array_equal(hpos, a.hpos)
+    np.testing.assert_array_equal(run_len, a.run_len)
+    np.testing.assert_array_equal(head_slot, a.head_slot)
+    np.testing.assert_array_equal(rpos, a.rpos)
+    np.testing.assert_array_equal(res_new_slot, a.res_new_slot)
+    np.testing.assert_array_equal(blob, a.blob)
+    assert n_ins == a.n_ins
+    assert lt128 == a.blob_lt_128 and lt256 == a.blob_lt_256
