@@ -1,5 +1,5 @@
-"""BASELINE.md benchmark configs 1-5 + conflict-heavy (6) and
-frontend-splice (8) configs.
+"""BASELINE.md benchmark configs 1-5 + conflict-heavy (6),
+interactive-latency (7), and frontend-splice (8) configs.
 
 Usage: python -m benchmarks.run_all [--quick] [--record ROUND]
 
@@ -227,6 +227,36 @@ def config6_conflict_heavy(n_actors: int = 200, n_targets: int = 500):
          n_conflicts=len(doc.conflicts))
 
 
+def config7_interactive_latency(n_base: int = 100_000, n_changes: int = 60):
+    """Interactive latency: ONE 10-op change applied to an n_base-element
+    Text document through the full public API (the reference's core
+    editing loop, frontend/index.js change -> backend applyLocalChange ->
+    patch). Reports p50/p99 per-change wall time. Target: <= 15 ms p50 on
+    the device tier (diff emission vectorized); the sub-ms host fast path
+    is designed in docs/INTERNALS.md §4.8 (write-behind local rounds)."""
+    import time as _time
+
+    import automerge_tpu as am
+    from automerge_tpu import Text
+
+    doc = am.change(am.init("user"),
+                    lambda d: d.__setitem__("t", Text("x" * n_base)))
+    lat = []
+    for i in range(n_changes):
+        t0 = _time.perf_counter()
+        doc = am.change(
+            doc, lambda d, i=i: d["t"].insert_at(5000 + 11 * i,
+                                                 *"helloworld"))
+        lat.append(_time.perf_counter() - t0)
+    assert len(doc["t"]) == n_base + 10 * n_changes
+    warm = np.asarray(lat[n_changes // 6:]) * 1e3   # drop compile warmup
+    p50 = float(np.percentile(warm, 50))
+    p99 = float(np.percentile(warm, 99))
+    emit("cfg7_interactive_10op_change_100k_doc", p50, "ms_p50",
+         p99_ms=round(p99, 2), n_changes=n_changes,
+         note="one 10-char insert per change through am.change")
+
+
 def config8_frontend_splice(n_big: int = 1_000_000, n_base_ab: int = 200_000,
                             n_ins_ab: int = 20_000):
     """Frontend patch application: a bulk text-insert patch landing in the
@@ -291,6 +321,7 @@ def main():
     config3_docset(n_docs=100 if quick else 1000)
     config4_trellis(quick=quick)
     config6_conflict_heavy()
+    config7_interactive_latency(n_changes=20 if quick else 60)
     config8_frontend_splice(n_big=200_000 if quick else 1_000_000)
     if record_round is not None:
         # cfg5 = the headline bench, folded into the record file
