@@ -119,11 +119,52 @@ def _sub_change(change: dict, ops: list) -> dict:
             "deps": change.get("deps", {}), "ops": ops}
 
 
+_DELETED = object()   # overlay sentinel: register emptied by a pending del
+
+
+class _TextOverlay:
+    """Host view of one text/list object while local rounds are pending
+    (the write-behind fast path, INTERNALS §4.8): element order and
+    visibility by position, plus every pending register write, kept
+    WITHOUT device work. Built once from the device state, advanced
+    incrementally per local change, discarded at flush."""
+
+    __slots__ = ("order", "vis", "writes")
+
+    def __init__(self, order: np.ndarray, vis: np.ndarray):
+        self.order = order          # int64[n] packed (actor_rank, ctr)
+        self.vis = vis              # bool[n], aligned with order
+        self.writes: dict = {}      # elemId -> {"value":..} | _DELETED
+
+    @classmethod
+    def build(cls, doc) -> "_TextOverlay":
+        """One positions+mirrors read of the CURRENT device state (the
+        only device interaction the overlay ever does)."""
+        n = doc.n_elems
+        if n == 0:
+            return cls(np.empty(0, np.int64), np.empty(0, bool))
+        from ..engine.host_index import pack_keys
+        pos = np.asarray(doc._positions()[1:])
+        order_slot = np.empty(n, np.int64)
+        order_slot[pos] = np.arange(1, n + 1)
+        h = doc._mirrors()
+        actor, ctr = doc.index.slot_to_key(order_slot)
+        order = pack_keys(actor.astype(np.int64), ctr.astype(np.int64))
+        vis = np.array(h["has_value"], bool)[order_slot]
+        return cls(order, vis)
+
+    def pos_of(self, packed: int) -> int:
+        """Raw position of an element (vectorized scan); -1 if absent."""
+        hit = np.flatnonzero(self.order == packed)
+        return int(hit[0]) if hit.size else -1
+
+
 class _TextObj:
     """Host wrapper for one device text/list object + diffing snapshots."""
 
     __slots__ = ("kind", "doc", "max_elem", "prev_n", "prev_vis",
-                 "prev_value", "prev_conf", "announced")
+                 "prev_value", "prev_conf", "announced", "ov",
+                 "_pool_scan")
 
     def __init__(self, obj_id: str, kind: str):
         from ..engine.text_doc import DeviceTextDoc
@@ -135,6 +176,20 @@ class _TextObj:
         self.prev_value = np.zeros(1, np.int32)
         self.prev_conf: dict = {}            # slot -> conflict signature
         self.announced = False               # create diff emitted?
+        self.ov: Optional[_TextOverlay] = None   # live while rounds pend
+        self._pool_scan = (0, False)         # (pool len scanned, has links)
+
+    def pool_has_links(self) -> bool:
+        """Whether any pooled value is a link — scanned incrementally
+        (pool entries only ever append), so the per-keystroke fast-path
+        eligibility check and `_link_children` stay O(new entries)."""
+        pool = self.doc.value_pool
+        n, hit = self._pool_scan
+        if hit or len(pool) == n:
+            return hit
+        hit = any(e.get("link") for e in pool[n:])
+        self._pool_scan = (len(pool), hit)
+        return hit
 
     def conflict_sig(self) -> dict:
         """Comparable, decode-free conflict snapshot: slot -> tuple of
@@ -202,6 +257,8 @@ class _DeviceCore:
         self.commands: list = []             # delivery log for fork/replay
         self._cv = None                      # (actors, lens) vector cache
         self.actor_rank: dict = {}           # actor -> dense rank (states order)
+        self.pending: list = []              # fast-path local changes not
+                                             # yet replayed into the engine
 
     def clock_vectors(self):
         """(actors list, per-actor applied-change counts as int64 vector),
@@ -248,9 +305,22 @@ class _DeviceCore:
 
     # -- application ----------------------------------------------------
 
-    def apply(self, changes, undoable: bool) -> list:
-        """Admit + distribute + diff one delivery. Returns patch diffs."""
+    def apply(self, changes, undoable: bool, is_local: bool = False) -> list:
+        """Admit + distribute + diff one delivery. Returns patch diffs.
+
+        `is_local` marks a change originated by THIS document's frontend
+        (apply_local_change / undo / redo) — the only deliveries the
+        write-behind fast path may serve: a remote delivery that happens
+        to look like the next change must still go through the engine's
+        concurrency resolution (covering checks, add-wins)."""
         changes = [_clean(c) for c in changes]
+        if is_local and len(changes) == 1 and not self.queue:
+            fast = self._try_fast_local(changes[0], undoable)
+            if fast is not None:
+                return fast
+        # anything the fast path cannot serve first replays pending local
+        # rounds into the engine so device state is current again
+        self.flush_pending()
         local = changes[0] if (undoable and changes) else None
         self.queue.extend(changes)
         applied: list = []
@@ -269,46 +339,299 @@ class _DeviceCore:
             if not progress:
                 break
         if local is not None and local in applied:
-            # inverse-op capture: the reference captures inside applyAssign
-            # (op_set.js:201-213), i.e. each op sees the previous ops of the
-            # SAME change already applied. Simulate that with an as-applied
-            # overlay: a local change causally covers the whole current
-            # state, so after a set/link the register is exactly [that op],
-            # after a del it is empty, and an inc folds into covered
-            # counter values. Pre-state reads come from _field_ops.
-            inverse: list = []
-            seen: dict = {}    # (obj, key) -> simulated register op list
-            for op in local.get("ops", ()):
-                action = op.get("action")
-                if action not in ("set", "del", "link", "inc"):
-                    continue
-                k = (op["obj"], op["key"])
-                cur = seen.get(k)
-                if cur is None:
-                    cur = self._field_ops(op["obj"], op["key"])
-                if action == "inc":
-                    inverse.append({"action": "inc", "obj": op["obj"],
-                                    "key": op["key"], "value": -op["value"]})
-                    seen[k] = [
-                        {**o, "value": o["value"] + op["value"]}
-                        if o.get("datatype") == "counter" else o
-                        for o in cur]
-                    continue
-                inverse.extend(cur or [{"action": "del", "obj": op["obj"],
-                                        "key": op["key"]}])
-                if action == "del":
-                    seen[k] = []
-                else:
-                    rec = {"action": action, "obj": op["obj"],
-                           "key": op["key"], "value": op["value"]}
-                    if op.get("datatype"):
-                        rec["datatype"] = op["datatype"]
-                    seen[k] = [rec]
-            self.undo_stack = self.undo_stack[: self.undo_pos] + [inverse]
-            self.undo_pos += 1
-            self.redo_stack = []   # a fresh change invalidates pending redos
+            self._push_undo(self._capture_inverse(local))
         touched, created = self._distribute(applied, creations)
         return self._emit_diffs(touched, created)
+
+    def _capture_inverse(self, local: dict) -> list:
+        """Inverse-op capture: the reference captures inside applyAssign
+        (op_set.js:201-213), i.e. each op sees the previous ops of the
+        SAME change already applied. Simulate that with an as-applied
+        overlay: a local change causally covers the whole current
+        state, so after a set/link the register is exactly [that op],
+        after a del it is empty, and an inc folds into covered
+        counter values. Pre-state reads come from _field_ops."""
+        inverse: list = []
+        seen: dict = {}    # (obj, key) -> simulated register op list
+        for op in local.get("ops", ()):
+            action = op.get("action")
+            if action not in ("set", "del", "link", "inc"):
+                continue
+            k = (op["obj"], op["key"])
+            cur = seen.get(k)
+            if cur is None:
+                cur = self._field_ops(op["obj"], op["key"])
+            if action == "inc":
+                inverse.append({"action": "inc", "obj": op["obj"],
+                                "key": op["key"], "value": -op["value"]})
+                seen[k] = [
+                    {**o, "value": o["value"] + op["value"]}
+                    if o.get("datatype") == "counter" else o
+                    for o in cur]
+                continue
+            inverse.extend(cur or [{"action": "del", "obj": op["obj"],
+                                    "key": op["key"]}])
+            if action == "del":
+                seen[k] = []
+            else:
+                rec = {"action": action, "obj": op["obj"],
+                       "key": op["key"], "value": op["value"]}
+                if op.get("datatype"):
+                    rec["datatype"] = op["datatype"]
+                seen[k] = [rec]
+        return inverse
+
+    def _push_undo(self, inverse: list):
+        self.undo_stack = self.undo_stack[: self.undo_pos] + [inverse]
+        self.undo_pos += 1
+        self.redo_stack = []   # a fresh change invalidates pending redos
+
+    # -- write-behind fast path (INTERNALS §4.8) ------------------------
+    #
+    # Small LOCAL rounds in the three interactive shapes — a chained
+    # typing run (ins+set pairs), a contiguous delete run, a single set —
+    # are served entirely on the host: causal admission, op-wise diff
+    # emission against a position/visibility overlay, and undo capture,
+    # with the change queued for deferred engine replay. The device is
+    # caught up (`flush_pending`) before anything the overlay cannot
+    # answer. Reference shape being matched: per-op application + diff
+    # emission, op_set.js:283-300.
+
+    _FAST_MAX_OPS = 512
+
+    def _try_fast_local(self, change: dict, undoable: bool):
+        """Serve one local change host-side; None -> take the device path."""
+        ops = change.get("ops", ())
+        if not ops or len(ops) > self._FAST_MAX_OPS:
+            return None
+        actor, seq = change.get("actor"), change.get("seq")
+        if not isinstance(actor, str) or not isinstance(seq, int):
+            return None
+        obj = ops[0].get("obj")
+        wrapper = self.objects.get(obj)
+        if (not isinstance(wrapper, _TextObj)
+                or any(op.get("obj") != obj for op in ops)):
+            return None
+        doc = wrapper.doc
+        if doc.conflicts or doc.queue or wrapper.pool_has_links():
+            return None     # conflict semantics / links: device path
+        rank = doc._actor_rank.get(actor)
+        if rank is None:
+            return None     # first change by this actor interns on the
+                            # device path; later ones ride the overlay
+        if seq != len(self.states.get(actor, ())) + 1 \
+                or not self._ready(change):
+            # duplicates/queued deliveries keep the general machinery
+            return None
+
+        shape = self._fast_shape(ops, actor, wrapper)
+        if shape is None:
+            return None
+        kind_, payload = shape
+        if kind_ in ("del_run", "set_one"):
+            # a delete/overwrite is unconditional only when the change
+            # causally covers the WHOLE document (true for real local
+            # changes by construction); anything else needs the engine's
+            # add-wins resolution
+            base = dict(change.get("deps", {}))
+            if seq > 1:
+                base[actor] = seq - 1
+            closure = _transitive(self.states, base)
+            if any(s > closure.get(a, 0) for a, s in self.clock.items()):
+                return None
+
+        if wrapper.ov is None:
+            wrapper.ov = _TextOverlay.build(doc)
+        ov = wrapper.ov
+        plan = self._fast_plan(kind_, payload, ov, doc)
+        if plan is None:
+            # the change falls to the device path, which will mutate the
+            # engine: a kept overlay would go stale (and with no pending
+            # rounds, nothing else clears it)
+            if not self.pending:
+                wrapper.ov = None
+            return None
+
+        if not self._admit(change, {}):
+            return []        # idempotent duplicate: nothing to do
+        if undoable:
+            if kind_ == "ins_run":
+                # every set targets an element this change mints, so the
+                # generic capture would read an empty register for each:
+                # the inverse is one del per new element, directly
+                inverse = [{"action": "del", "obj": obj,
+                            "key": f"{actor}:{e}"} for e in plan[1]]
+                self._push_undo(inverse)
+            else:
+                self._push_undo(self._capture_inverse(change))
+        diffs = self._fast_execute(kind_, plan, wrapper, obj, ov, actor,
+                                   rank)
+        self.pending.append(change)
+        return diffs
+
+    def _fast_shape(self, ops, actor: str, wrapper: "_TextObj"):
+        """Classify ops as one of the fast shapes; None if anything else."""
+        first = ops[0]
+        a0 = first.get("action")
+        if a0 == "ins":
+            # chained typing run: ins(parent, e0), set(actor:e0, v0),
+            # ins(actor:e0, e1), set(actor:e1, v1), ...
+            if len(ops) % 2 or first.get("elem") is None \
+                    or first["elem"] <= wrapper.max_elem:
+                return None
+            elems, values = [], []
+            prev_key = first.get("key")
+            for i in range(0, len(ops), 2):
+                ins_op, set_op = ops[i], ops[i + 1]
+                e = ins_op.get("elem")
+                if (ins_op.get("action") != "ins"
+                        or set_op.get("action") != "set"
+                        or e is None
+                        or (elems and e != elems[-1] + 1)
+                        or ins_op.get("key") !=
+                        (prev_key if i == 0 else f"{actor}:{elems[-1]}")
+                        or set_op.get("key") != f"{actor}:{e}"
+                        or isinstance(set_op.get("value"), dict)):
+                    return None
+                elems.append(e)
+                values.append((set_op.get("value"),
+                               set_op.get("datatype")))
+            return ("ins_run", (first.get("key"), elems, values))
+        if a0 == "del":
+            keys = []
+            for op in ops:
+                if op.get("action") != "del" or not op.get("key"):
+                    return None
+                keys.append(op["key"])
+            return ("del_run", keys)
+        if a0 == "set" and len(ops) == 1 and first.get("key") \
+                and not isinstance(first.get("value"), dict):
+            return ("set_one", (first["key"],
+                                (first.get("value"),
+                                 first.get("datatype"))))
+        return None
+
+    @staticmethod
+    def _fast_packed(doc, elem_key: str):
+        """elemId string -> packed (rank, ctr) in the owning doc's actor
+        space (the overlay's order encoding); None when unparseable or
+        the actor is unknown to this doc."""
+        from .._common import parse_elem_id
+        try:
+            actor, ctr = parse_elem_id(elem_key)
+        except Exception:
+            return None
+        rank = doc._actor_rank.get(actor)
+        if rank is None:
+            return None
+        return (int(rank) << 32) | int(ctr)
+
+    def _fast_plan(self, kind_, payload, ov: "_TextOverlay", doc):
+        """Resolve every referenced element BEFORE mutating anything;
+        None -> ineligible (device path)."""
+        if kind_ == "ins_run":
+            parent_key, elems, values = payload
+            if parent_key == "_head":
+                p = -1
+            else:
+                pk = self._fast_packed(doc, parent_key)
+                if pk is None:
+                    return None
+                p = ov.pos_of(pk)
+                if p < 0:
+                    return None
+            return (p, elems, values)
+        if kind_ == "del_run":
+            keys = payload
+            positions = []
+            for key in keys:
+                pk = self._fast_packed(doc, key)
+                if pk is None:
+                    return None
+                p = ov.pos_of(pk)
+                if p < 0 or not ov.vis[p]:
+                    return None
+                positions.append(p)
+            # contiguous VISIBLE run: each next target is the next visible
+            # element after the previous one
+            for q, p in zip(positions, positions[1:]):
+                if p <= q or ov.vis[q + 1: p].any():
+                    return None
+            return (positions, keys)
+        # set_one
+        key, value = payload
+        pk = self._fast_packed(doc, key)
+        if pk is None:
+            return None
+        p = ov.pos_of(pk)
+        if p < 0 or not ov.vis[p]:
+            return None
+        return (p, key, value)
+
+    def _fast_execute(self, kind_, plan, wrapper: "_TextObj", obj: str,
+                      ov: "_TextOverlay", actor: str, rank: int):
+        """Mutate the overlay and emit op-wise diffs (cannot fail)."""
+        paths = self._paths()
+        path = paths.get(obj)
+        typ = wrapper.kind
+        diffs: list = []
+        cum = np.cumsum(ov.vis)         # visible count through position i
+        if kind_ == "ins_run":
+            p, elems, values = plan
+            base = int(cum[p]) if p >= 0 else 0
+            new_packed = (np.int64(rank) << 32) | np.asarray(elems,
+                                                             np.int64)
+            ov.order = np.insert(ov.order, p + 1, new_packed)
+            ov.vis = np.insert(ov.vis, p + 1, np.ones(len(elems), bool))
+            for j, (e, (v, dt)) in enumerate(zip(elems, values)):
+                elem_id = f"{actor}:{e}"
+                diff = {"action": "insert", "obj": obj, "type": typ,
+                        "index": base + j, "elemId": elem_id,
+                        "value": v, "path": path}
+                if dt:
+                    diff["datatype"] = dt
+                diffs.append(diff)
+                rec = {"value": v}
+                if dt:
+                    rec["datatype"] = dt
+                ov.writes[elem_id] = rec
+            wrapper.max_elem = max(wrapper.max_elem, elems[-1])
+            diffs.append({"action": "maxElem", "obj": obj, "type": typ,
+                          "value": wrapper.max_elem, "path": path})
+        elif kind_ == "del_run":
+            positions, keys = plan
+            index = int(cum[positions[0]]) - 1
+            for p, key in zip(positions, keys):
+                diffs.append({"action": "remove", "obj": obj, "type": typ,
+                              "index": index, "path": path})
+                ov.vis[p] = False
+                ov.writes[key] = _DELETED
+        else:  # set_one
+            p, key, (v, dt) = plan
+            diff = {"action": "set", "obj": obj, "type": typ,
+                    "index": int(cum[p]) - 1, "value": v, "path": path}
+            if dt:
+                diff["datatype"] = dt
+            diffs.append(diff)
+            rec = {"value": v}
+            if dt:
+                rec["datatype"] = dt
+            ov.writes[key] = rec
+        return diffs
+
+    def flush_pending(self):
+        """Replay pending fast-path rounds into the engine (no diffs: they
+        were emitted op-wise when the rounds applied); refresh the diff
+        snapshots and drop the overlays."""
+        if not self.pending:
+            return
+        pending, self.pending = self.pending, []
+        touched, _ = self._distribute(pending, {})
+        for oid in touched:
+            w = self.objects.get(oid)
+            if isinstance(w, _TextObj):
+                w.snapshot()
+                w.ov = None
 
     # -- undo/redo (mirror of backend/index.js:258-316 + op_set undo) ---
 
@@ -324,6 +647,19 @@ class _DeviceCore:
             if wrapper is None:
                 return []
         doc = wrapper.doc
+        if isinstance(wrapper, _TextObj) and wrapper.ov is not None:
+            # pending fast-path rounds: their register writes live in the
+            # overlay (engine state is behind); untouched registers fall
+            # through to the device mirrors, which are still valid for them
+            hit = wrapper.ov.writes.get(key)
+            if hit is _DELETED:
+                return []
+            if hit is not None:
+                op = {"action": "set", "obj": obj_id, "key": key,
+                      "value": hit["value"]}
+                if hit.get("datatype"):
+                    op["datatype"] = hit["datatype"]
+                return [op]
         if isinstance(wrapper, _TextObj):
             from ..engine.host_index import pack_keys
             from .._common import parse_elem_id
@@ -384,7 +720,7 @@ class _DeviceCore:
                                            "key": op["key"]}])
         self.undo_pos -= 1
         self.redo_stack = self.redo_stack + [redo_ops]
-        return self.apply([change], False)
+        return self.apply([change], False, is_local=True)
 
     def do_redo(self, request: dict) -> list:
         if not self.redo_stack:
@@ -395,7 +731,7 @@ class _DeviceCore:
                   "message": request.get("message"), "ops": redo_ops}
         self.undo_pos += 1
         self.redo_stack = self.redo_stack[:-1]
-        return self.apply([change], False)
+        return self.apply([change], False, is_local=True)
 
     def _seed_all_deps(self) -> dict:
         return {(a, i + 1): e["allDeps"]
@@ -456,6 +792,14 @@ class _DeviceCore:
             touched |= by_obj.keys()
             if root_ops:
                 touched.add(ROOT_ID)
+
+        # engine application stales any overlay on a touched object (the
+        # single choke point: every path that mutates an object's engine
+        # state goes through here)
+        for oid in touched:
+            w = self.objects.get(oid)
+            if isinstance(w, _TextObj):
+                w.ov = None
 
         if ROOT_ID in touched:
             self.root.doc.apply_changes(
@@ -544,7 +888,7 @@ class _DeviceCore:
         doc = wrapper.doc
         out = []
         if isinstance(wrapper, _TextObj):
-            if not any(e.get("link") for e in doc.value_pool):
+            if not wrapper.pool_has_links():
                 return out
             if doc.n_elems == 0:
                 return out
@@ -714,6 +1058,7 @@ class _DeviceCore:
 
     def rebuild_diffs(self) -> list:
         """Whole-document construction diffs (getPatch semantics)."""
+        self.flush_pending()   # materialization reads the engine state
         diffs: list = []
         paths = self._paths()
         for oid in self.obj_order:
@@ -738,7 +1083,9 @@ class _DeviceCore:
             elif cmd[0] == "redo":
                 clone.do_redo(cmd[1])
             else:  # "local"
-                clone.apply([cmd[1]], cmd[1].get("undoable", True) is not False)
+                clone.apply([cmd[1]],
+                            cmd[1].get("undoable", True) is not False,
+                            is_local=True)
             clone.commands.append(cmd)
         return clone
 
@@ -747,7 +1094,8 @@ class _DeviceCore:
         clean = self.fork(version)
         for slot in ("states", "history", "queue", "clock", "deps",
                      "undo_pos", "undo_stack", "redo_stack", "objects",
-                     "obj_order", "root", "commands", "_cv", "actor_rank"):
+                     "obj_order", "root", "commands", "_cv", "actor_rank",
+                     "pending"):
             setattr(self, slot, getattr(clean, slot))
 
     def graduate(self, version: int) -> _OracleState:
@@ -835,7 +1183,8 @@ def _device_apply(state: DeviceBackendState, changes, undoable: bool,
         return _oracle.apply_changes(oracle_state, changes)
     core = state.writable_core()
     try:
-        diffs = core.apply(changes, undoable)
+        diffs = core.apply(changes, undoable,
+                           is_local=command[0] == "local")
     except Exception:
         core.restore(state._version)
         raise

@@ -1,0 +1,168 @@
+"""Write-behind local fast path (backend/device.py:_try_fast_local).
+
+Small local rounds in the interactive shapes (typing runs, delete runs,
+single sets) are served host-side with op-wise diffs and replayed into the
+engine later (INTERNALS §4.8). These tests pin:
+
+- oracle parity on randomized interleavings of fast-shaped local edits,
+  remote merges (flush boundaries), undo/redo, and save/load;
+- that the fast path actually serves the interactive shapes (pending grows)
+  and that remote deliveries flush it;
+- that a remote delivery arriving between local rounds still gets full
+  concurrency resolution (the add-wins case that must NOT ride the
+  fast path).
+"""
+
+import random
+
+import automerge_tpu as am
+from automerge_tpu import Text
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.backend import facade as oracle_backend
+from automerge_tpu.backend.device import _DeviceCore, DeviceBackendState
+
+
+def _core(doc):
+    state = Frontend.get_backend_state(doc)
+    assert isinstance(state, DeviceBackendState)
+    return state._core
+
+
+def fingerprint(doc):
+    return (am.to_json(doc),
+            {k: am.get_conflicts(doc, k) for k in am.to_json(doc)})
+
+
+def oracle_twin(doc):
+    """Replay the document's full history into an oracle-backed doc."""
+    twin = am.init({"actorId": "twin",
+                    "backend": oracle_backend.Backend})
+    return am.apply_changes(twin, am.get_all_changes(doc))
+
+
+def test_typing_run_rides_fast_path_and_merge_flushes():
+    doc = am.change(am.init("aaaa"),
+                    lambda d: d.__setitem__("t", Text("hello world")))
+    for i in range(5):
+        doc = am.change(doc, lambda d, i=i: d["t"].insert_at(5 + i, "X"))
+    core = _core(doc)
+    assert len(core.pending) == 5          # all five rode the fast path
+    assert str(doc["t"]) == "helloXXXXX world"
+    peer = am.apply_changes(am.init("bbbb"), am.get_all_changes(doc))
+    peer = am.change(peer, lambda d: d["t"].insert_at(0, "Q"))
+    merged = am.merge(doc, peer)           # remote delivery -> flush
+    assert _core(merged).pending == []
+    assert str(merged["t"]) == "QhelloXXXXX world"
+    assert am.to_json(oracle_twin(merged)) == am.to_json(merged)
+
+
+def test_delete_and_set_shapes_ride_fast_path():
+    doc = am.change(am.init("aaaa"),
+                    lambda d: d.__setitem__("t", Text("abcdef")))
+    doc = am.change(doc, lambda d: [d["t"].delete_at(1),
+                                    d["t"].delete_at(1)])
+    doc = am.change(doc, lambda d: d["t"].set(0, "A"))
+    core = _core(doc)
+    assert len(core.pending) == 2
+    assert str(doc["t"]) == "Adef"
+    # save/load replays the full (already-admitted) history
+    assert am.to_json(am.load(am.save(doc)))["t"] == "Adef"
+
+
+def test_concurrent_delete_does_not_ride_fast_path():
+    """The add-wins case: a concurrent remote delete looks like the next
+    change but must take the engine path (covering checks)."""
+    a = am.change(am.init("aaaa"),
+                  lambda d: d.__setitem__("t", Text("xyz")))
+    b = am.apply_changes(am.init("bbbb"), am.get_all_changes(a))
+    a2 = am.change(a, lambda d: d["t"].delete_at(1))
+    b2 = am.change(b, lambda d: d["t"].set(1, "Y"))   # concurrent: add-wins
+    m1, m2 = am.merge(a2, b2), am.merge(b2, a2)
+    assert str(m1["t"]) == str(m2["t"]) == "xYz"
+
+
+def test_undo_redo_of_fast_rounds():
+    doc = am.change(am.init("aaaa"),
+                    lambda d: d.__setitem__("t", Text("base")))
+    doc = am.change(doc, lambda d: d["t"].insert_at(4, *"123"))
+    assert len(_core(doc).pending) >= 1
+    assert str(doc["t"]) == "base123"
+    doc = am.undo(doc)
+    assert str(doc["t"]) == "base"
+    doc = am.redo(doc)
+    assert str(doc["t"]) == "base123"
+    doc = am.change(doc, lambda d: d["t"].delete_at(0))
+    doc = am.undo(doc)
+    assert str(doc["t"]) == "base123"
+
+
+def test_stale_state_fork_replays_fast_rounds():
+    doc = am.change(am.init("aaaa"),
+                    lambda d: d.__setitem__("t", Text("fork")))
+    doc2 = am.change(doc, lambda d: d["t"].insert_at(0, "A"))
+    # branch from the OLD state: the core forks by replay, including the
+    # pending fast round bookkeeping
+    branch = am.change(doc, lambda d: d["t"].insert_at(4, "Z"))
+    assert str(doc2["t"]) == "Afork"
+    assert str(branch["t"]) == "forkZ"
+
+
+def test_randomized_interleaving_matches_oracle():
+    for seed in range(4):
+        rng = random.Random(52_000 + seed)
+        base = am.change(am.init("base"),
+                         lambda d: d.__setitem__("t", Text("seedtext")))
+        base_changes = am.get_all_changes(base)
+        docs = [am.apply_changes(am.init(f"actor-{i}"), base_changes)
+                for i in range(2)]
+        for _ in range(12):
+            i = rng.randrange(2)
+
+            def edit(d, rng=rng):
+                t = d["t"]
+                r = rng.random()
+                if r < 0.5 or len(t) == 0:
+                    at = rng.randint(0, len(t))
+                    t.insert_at(at, *rng.choice(["a", "bc", "xyz"]))
+                elif r < 0.75:
+                    at = rng.randrange(len(t))
+                    k = min(rng.randint(1, 3), len(t) - at)
+                    for _ in range(k):
+                        t.delete_at(at)
+                else:
+                    t.set(rng.randrange(len(t)), "S")
+            docs[i] = am.change(docs[i], edit)
+            if rng.random() < 0.2 and am.can_undo(docs[i]):
+                docs[i] = am.undo(docs[i])
+            if rng.random() < 0.3:
+                j = 1 - i
+                docs[i] = am.merge(docs[i], docs[j])
+        merged = am.merge(docs[0], docs[1])
+        merged2 = am.merge(docs[1], docs[0])
+        assert str(merged["t"]) == str(merged2["t"]), f"seed {seed}"
+        twin = oracle_twin(merged)
+        assert am.to_json(twin) == am.to_json(merged), f"seed {seed}"
+        # elemId-level parity, not just text
+        assert [e["elemId"] for e in merged["t"].elems] == \
+            [e["elemId"] for e in twin["t"].elems], f"seed {seed}"
+
+
+def test_ineligible_plan_does_not_leave_stale_overlay():
+    """A change that matches a fast shape but fails planning (e.g.
+    non-contiguous deletes) takes the device path; the overlay built
+    during the attempt must not survive stale, or the NEXT fast round
+    would emit diffs against pre-device-apply positions."""
+    doc = am.change(am.init("aaaa"),
+                    lambda d: d.__setitem__("t", Text("abcdef")))
+    # non-contiguous deletes in one change: del at 0 and (after shift) 2
+    doc = am.change(doc, lambda d: [d["t"].delete_at(0),
+                                    d["t"].delete_at(2)])
+    assert str(doc["t"]) == "bcef"
+    # next fast-shaped round must see the post-delete state
+    doc = am.change(doc, lambda d: d["t"].set(2, "Z"))
+    assert str(doc["t"]) == "bcZf"
+    doc = am.change(doc, lambda d: d["t"].insert_at(4, *"!!"))
+    assert str(doc["t"]) == "bcZf!!"
+    twin = oracle_twin(doc)
+    assert [e["elemId"] for e in doc["t"].elems] == \
+        [e["elemId"] for e in twin["t"].elems]
