@@ -227,6 +227,156 @@ def config6_conflict_heavy(n_actors: int = 200, n_targets: int = 500):
          n_conflicts=len(doc.conflicts))
 
 
+def config5b_residual_heavy(n_actors: int = 10_000, quick: bool = False):
+    """Adversarial headline shape: 20% of ops are RESIDUALS (bare deletes
+    of distinct base elements + bare inserts without values) that cannot
+    ride the dense run path — they go through apply_residual_packed. The
+    clean headline (cfg5/bench.py) has ZERO residuals in the timed region;
+    this row bounds the cost of realistic mixed loads. Regression
+    threshold: >= 25% of the clean headline's ops/s on the same platform.
+    Path under test: ops/ingest.py apply_residual_packed."""
+    import bench as B
+    from automerge_tpu.engine import DeviceTextDoc, TextChangeBatch
+    from automerge_tpu.engine.columnar import KIND_DEL, KIND_INS, KIND_SET
+
+    if quick:
+        n_actors = 500
+    base_n = 100 * n_actors          # every actor gets a distinct del range
+    run_pairs, n_del, n_bare = 400, 100, 100   # 800+100+100 = 1000 ops
+    n_per = 2 * run_pairs + n_del + n_bare
+    n_ops = n_actors * n_per
+    actors = [f"actor-{i:06d}" for i in range(n_actors)]
+    op_change = np.repeat(np.arange(n_actors, dtype=np.int32), n_per)
+    kind = np.empty(n_ops, np.int8)
+    ta = np.zeros(n_ops, np.int32)
+    tc = np.zeros(n_ops, np.int32)
+    pa = np.zeros(n_ops, np.int32)
+    pc = np.zeros(n_ops, np.int32)
+    val = np.zeros(n_ops, np.int64)
+    pair_kind = np.tile(np.array([KIND_INS, KIND_SET], np.int8), run_pairs)
+    ctrs = np.arange(1, run_pairs + 1, dtype=np.int32) + base_n + 1
+    for a in range(n_actors):
+        s = a * n_per
+        e_run = s + 2 * run_pairs
+        kind[s:e_run] = pair_kind
+        ta[s:e_run] = a
+        tc[s: e_run: 2] = ctrs
+        tc[s + 1: e_run: 2] = ctrs
+        pa[s] = n_actors                      # 'base' rank
+        pc[s] = a * 100 + 1
+        pa[s + 2: e_run: 2] = a
+        pc[s + 2: e_run: 2] = ctrs[:-1]
+        val[s + 1: e_run: 2] = 97 + (a % 26)
+        # 100 bare deletes of this actor's distinct base range
+        d0 = e_run
+        kind[d0: d0 + n_del] = KIND_DEL
+        ta[d0: d0 + n_del] = n_actors
+        tc[d0: d0 + n_del] = a * 100 + 1 + np.arange(n_del)
+        # 100 bare inserts (no value: invisible elements)
+        b0 = d0 + n_del
+        kind[b0: b0 + n_bare] = KIND_INS
+        ta[b0: b0 + n_bare] = a
+        tc[b0: b0 + n_bare] = ctrs[-1] + 1 + np.arange(n_bare)
+        pa[b0: b0 + n_bare] = n_actors
+        pc[b0: b0 + n_bare] = a * 100 + 50
+    batch = TextChangeBatch(
+        obj_id="t", actors=actors, seqs=np.ones(n_actors, np.int32),
+        deps=[{"base": 1}] * n_actors, messages=[None] * n_actors,
+        op_change=op_change, op_kind=kind, op_target_actor=ta,
+        op_target_ctr=tc, op_parent_actor=pa, op_parent_ctr=pc,
+        op_value=val, actor_table=actors + ["base"], value_pool=[])
+
+    def run():
+        doc = DeviceTextDoc("t")
+        doc.eager_materialize = True
+        doc.apply_batch(B.base_batch("t", base_n))
+        doc.text()
+        doc.apply_batch(batch)
+        text = doc.text()
+        # deletes landed (base shrank), runs landed (typed chars present)
+        assert len(text) == base_n - n_actors * n_del \
+            + n_actors * run_pairs
+
+    dt = timed(run, warmups=1, reps=1)
+    emit(f"cfg5b_residual_heavy_{n_actors}_actors", n_ops / dt, "ops/s",
+         vs_baseline=(n_ops / dt) / 100e6,
+         residual_fraction=0.2,
+         threshold="<4x slower than clean cfg5 on same platform")
+
+
+def config5c_two_causal_rounds(n_actors: int = 10_000, quick: bool = False):
+    """Adversarial headline shape: every actor delivers TWO causally
+    chained changes (seq 2 depends on seq 1), so the merge cannot be one
+    round — admission schedules two rounds and the engine pays two
+    prepare/commit cycles. Bounds the per-round overhead the single-round
+    headline never shows. Path under test: engine/base.py _schedule +
+    multi-round prepare."""
+    import bench as B
+    from automerge_tpu.engine import DeviceTextDoc, TextChangeBatch
+    from automerge_tpu.engine.columnar import KIND_INS, KIND_SET
+
+    if quick:
+        n_actors = 500
+    base_n = 50_000 if quick else 1_000_000
+    pairs_per_change = 250           # 500 ops x 2 changes = 1k ops/actor
+    n_changes = 2 * n_actors
+    n_per = 2 * pairs_per_change
+    n_ops = n_changes * n_per
+    actors = [f"actor-{i:06d}" for i in range(n_actors)]
+    # change rows: actor a seq 1 = row 2a, seq 2 = row 2a+1
+    op_change = np.repeat(np.arange(n_changes, dtype=np.int32), n_per)
+    kind = np.tile(np.array([KIND_INS, KIND_SET], np.int8),
+                   n_changes * pairs_per_change)
+    ta = np.repeat(np.arange(n_actors, dtype=np.int32), 2 * n_per)
+    tc = np.zeros(n_ops, np.int32)
+    pa = np.zeros(n_ops, np.int32)
+    pc = np.zeros(n_ops, np.int32)
+    val = np.zeros(n_ops, np.int64)
+    rng = np.random.default_rng(7)
+    targets = rng.integers(1, base_n, n_actors)
+    c1 = np.arange(1, pairs_per_change + 1, dtype=np.int32) + base_n + 1
+    c2 = c1 + pairs_per_change
+    for a in range(n_actors):
+        for half, ctrs in ((0, c1), (1, c2)):
+            s = (2 * a + half) * n_per
+            tc[s: s + n_per: 2] = ctrs
+            tc[s + 1: s + n_per: 2] = ctrs
+            if half == 0:
+                pa[s] = n_actors
+                pc[s] = int(targets[a])
+            else:
+                pa[s] = a                 # continue own seq-1 run
+                pc[s] = c1[-1]
+            pa[s + 2: s + n_per: 2] = a
+            pc[s + 2: s + n_per: 2] = ctrs[:-1]
+            val[s + 1: s + n_per: 2] = 97 + (a % 26)
+    seqs = np.empty(n_changes, np.int32)
+    seqs[0::2] = 1
+    seqs[1::2] = 2
+    shared = {"base": 1}
+    batch = TextChangeBatch(
+        obj_id="t", actors=[a for a in actors for _ in range(2)],
+        seqs=seqs, deps=[shared] * n_changes,
+        messages=[None] * n_changes, op_change=op_change, op_kind=kind,
+        op_target_actor=ta, op_target_ctr=tc, op_parent_actor=pa,
+        op_parent_ctr=pc, op_value=val, actor_table=actors + ["base"],
+        value_pool=[])
+
+    def run():
+        doc = DeviceTextDoc("t")
+        doc.eager_materialize = True
+        doc.apply_batch(B.base_batch("t", base_n))
+        doc.text()
+        prepared = doc.prepare_batch(batch)
+        assert len(prepared.rounds) == 2      # genuinely two causal rounds
+        doc.commit_prepared(prepared)
+        assert len(doc.text()) == base_n + n_ops // 2
+
+    dt = timed(run, warmups=1, reps=1)
+    emit(f"cfg5c_two_causal_rounds_{n_actors}_actors", n_ops / dt, "ops/s",
+         vs_baseline=(n_ops / dt) / 100e6, n_rounds=2)
+
+
 def config7_interactive_latency(n_base: int = 100_000, n_changes: int = 60):
     """Interactive latency: ONE 10-op change applied to an n_base-element
     Text document through the full public API (the reference's core
@@ -346,6 +496,8 @@ def main():
     config2_map_counter()
     config3_docset(n_docs=100 if quick else 1000)
     config4_trellis(quick=quick)
+    config5b_residual_heavy(quick=quick)
+    config5c_two_causal_rounds(quick=quick)
     config6_conflict_heavy()
     config7_interactive_latency(n_changes=20 if quick else 60)
     config8_frontend_splice(n_big=200_000 if quick else 1_000_000)

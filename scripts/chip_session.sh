@@ -19,11 +19,11 @@ grep -q "rc=0" <(tail -1 "$LOG") || { echo "tunnel down, aborting" >> "$LOG"; ex
 export AMTPU_SKIP_PREFLIGHT=1   # this session IS the parent probe
 
 AUTOMERGE_TPU_TESTS_ON_TPU=1 \
-  run "tpu_smoke"      900 python -m pytest tests/test_segments.py tests/test_engine_parity.py -q
+  run "tpu_smoke"      900 python -m pytest tests/test_segments.py tests/test_engine_parity.py tests/test_fast_local.py -q
 grep -q "rc=0" <(tail -1 "$LOG") || { echo "on-chip smoke FAILED, not recording benchmarks" >> "$LOG"; exit 4; }
 run "bench"            900 python bench.py
 run "planned_ab"       900 python profile_bench.py --planned
 run "trace"            600 python profile_bench.py --trace
 run "pallas_ab"        900 python profile_bench.py --pallas
-run "configs_record"  2400 python -m benchmarks.run_all --record 3
+run "configs_record"  3600 python -m benchmarks.run_all --record 4
 echo "=== chip session done $(date -u +%T) ===" >> "$LOG"
