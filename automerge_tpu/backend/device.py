@@ -129,12 +129,17 @@ class _TextOverlay:
     WITHOUT device work. Built once from the device state, advanced
     incrementally per local change, discarded at flush."""
 
-    __slots__ = ("order", "vis", "writes")
+    __slots__ = ("order", "vis", "writes", "path")
 
     def __init__(self, order: np.ndarray, vis: np.ndarray):
         self.order = order          # int64[n] packed (actor_rank, ctr)
         self.vis = vis              # bool[n], aligned with order
         self.writes: dict = {}      # elemId -> {"value":..} | _DELETED
+        self.path = False           # object's root path, resolved lazily
+                                    # (False = not yet; stable while the
+                                    # overlay lives: links cannot change
+                                    # without an engine apply, which
+                                    # discards the overlay)
 
     @classmethod
     def build(cls, doc) -> "_TextOverlay":
@@ -542,21 +547,29 @@ class _DeviceCore:
                     return None
             return (p, elems, values)
         if kind_ == "del_run":
+            # contiguous VISIBLE run: scan for the FIRST target only, then
+            # walk forward — each next target must be the next visible
+            # element (one O(n) scan total, not one per key)
             keys = payload
-            positions = []
-            for key in keys:
+            pk = self._fast_packed(doc, keys[0])
+            if pk is None:
+                return None
+            p = ov.pos_of(pk)
+            if p < 0 or not ov.vis[p]:
+                return None
+            positions = [p]
+            n = len(ov.order)
+            for key in keys[1:]:
                 pk = self._fast_packed(doc, key)
                 if pk is None:
                     return None
-                p = ov.pos_of(pk)
-                if p < 0 or not ov.vis[p]:
+                q = p + 1
+                while q < n and not ov.vis[q]:
+                    q += 1
+                if q >= n or int(ov.order[q]) != pk:
                     return None
-                positions.append(p)
-            # contiguous VISIBLE run: each next target is the next visible
-            # element after the previous one
-            for q, p in zip(positions, positions[1:]):
-                if p <= q or ov.vis[q + 1: p].any():
-                    return None
+                positions.append(q)
+                p = q
             return (positions, keys)
         # set_one
         key, value = payload
@@ -571,8 +584,9 @@ class _DeviceCore:
     def _fast_execute(self, kind_, plan, wrapper: "_TextObj", obj: str,
                       ov: "_TextOverlay", actor: str, rank: int):
         """Mutate the overlay and emit op-wise diffs (cannot fail)."""
-        paths = self._paths()
-        path = paths.get(obj)
+        if ov.path is False:
+            ov.path = self._paths().get(obj)   # one BFS per overlay life
+        path = ov.path
         typ = wrapper.kind
         diffs: list = []
         cum = np.cumsum(ov.vis)         # visible count through position i

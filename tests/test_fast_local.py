@@ -141,7 +141,7 @@ def test_randomized_interleaving_matches_oracle():
         merged2 = am.merge(docs[1], docs[0])
         assert str(merged["t"]) == str(merged2["t"]), f"seed {seed}"
         twin = oracle_twin(merged)
-        assert am.to_json(twin) == am.to_json(merged), f"seed {seed}"
+        assert fingerprint(twin) == fingerprint(merged), f"seed {seed}"
         # elemId-level parity, not just text
         assert [e["elemId"] for e in merged["t"].elems] == \
             [e["elemId"] for e in twin["t"].elems], f"seed {seed}"
