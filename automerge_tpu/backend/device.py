@@ -215,11 +215,26 @@ class _TextObj:
         self.prev_conf = self.conflict_sig()
 
 
+class _MapOverlay:
+    """Pending-register view of one map/table object (write-behind fast
+    path, INTERNALS §4.8): maps need no positions — just the pending
+    writes and the object's cached root path."""
+
+    __slots__ = ("writes", "path")
+
+    def __init__(self):
+        self.writes: dict = {}      # key -> {"value":..} | _DELETED
+        self.path = False           # resolved lazily; stable while alive
+                                    # (link-overwriting rounds are
+                                    # ineligible, so reachability is
+                                    # frozen until the next engine apply)
+
+
 class _MapObj:
     """Host wrapper for one device map/table object + diffing snapshot
     (the root map is `_MapObj(ROOT_ID, "map")`)."""
 
-    __slots__ = ("kind", "doc", "max_elem", "prev", "announced")
+    __slots__ = ("kind", "doc", "max_elem", "prev", "announced", "ov")
 
     def __init__(self, obj_id: str, kind: str):
         from ..engine.map_doc import DeviceMapDoc
@@ -228,6 +243,7 @@ class _MapObj:
         self.max_elem = 0                    # uniform wrapper interface
         self.prev: dict = {}                 # key -> (raw value, conflict sig)
         self.announced = False
+        self.ov: Optional[_MapOverlay] = None    # live while rounds pend
 
     def current(self) -> dict:
         doc = self.doc
@@ -412,10 +428,29 @@ class _DeviceCore:
         actor, seq = change.get("actor"), change.get("seq")
         if not isinstance(actor, str) or not isinstance(seq, int):
             return None
+        if seq != len(self.states.get(actor, ())) + 1 \
+                or not self._ready(change):
+            # duplicates/queued deliveries keep the general machinery
+            return None
         obj = ops[0].get("obj")
-        wrapper = self.objects.get(obj)
-        if (not isinstance(wrapper, _TextObj)
-                or any(op.get("obj") != obj for op in ops)):
+        if any(op.get("obj") != obj for op in ops):
+            # multi-object rounds: eligible only when EVERY target is a
+            # map/table register object (the nested-board edit shape)
+            wrappers = {}
+            for op in ops:
+                o = op.get("obj")
+                if o not in wrappers:
+                    w = self.root if o == ROOT_ID else self.objects.get(o)
+                    if not isinstance(w, _MapObj):
+                        return None
+                    wrappers[o] = w
+            return self._try_fast_map(change, ops, actor, seq, wrappers,
+                                      undoable)
+        wrapper = self.root if obj == ROOT_ID else self.objects.get(obj)
+        if isinstance(wrapper, _MapObj):
+            return self._try_fast_map(change, ops, actor, seq,
+                                      {obj: wrapper}, undoable)
+        if not isinstance(wrapper, _TextObj):
             return None
         doc = wrapper.doc
         if doc.conflicts or doc.queue or wrapper.pool_has_links():
@@ -424,26 +459,14 @@ class _DeviceCore:
         if rank is None:
             return None     # first change by this actor interns on the
                             # device path; later ones ride the overlay
-        if seq != len(self.states.get(actor, ())) + 1 \
-                or not self._ready(change):
-            # duplicates/queued deliveries keep the general machinery
-            return None
 
         shape = self._fast_shape(ops, actor, wrapper)
         if shape is None:
             return None
         kind_, payload = shape
-        if kind_ in ("del_run", "set_one"):
-            # a delete/overwrite is unconditional only when the change
-            # causally covers the WHOLE document (true for real local
-            # changes by construction); anything else needs the engine's
-            # add-wins resolution
-            base = dict(change.get("deps", {}))
-            if seq > 1:
-                base[actor] = seq - 1
-            closure = _transitive(self.states, base)
-            if any(s > closure.get(a, 0) for a, s in self.clock.items()):
-                return None
+        if kind_ in ("del_run", "set_one") \
+                and not self._covers_doc(change, actor, seq):
+            return None
 
         if wrapper.ov is None:
             wrapper.ov = _TextOverlay.build(doc)
@@ -471,6 +494,85 @@ class _DeviceCore:
                 self._push_undo(self._capture_inverse(change))
         diffs = self._fast_execute(kind_, plan, wrapper, obj, ov, actor,
                                    rank)
+        self.pending.append(change)
+        return diffs
+
+    def _covers_doc(self, change: dict, actor: str, seq: int) -> bool:
+        """Whether the change's dep closure covers the WHOLE document
+        clock: deletes/overwrites are unconditional only then (true for
+        real local changes by construction); anything else needs the
+        engine's add-wins/LWW resolution."""
+        base = dict(change.get("deps", {}))
+        if seq > 1:
+            base[actor] = seq - 1
+        closure = _transitive(self.states, base)
+        return not any(s > closure.get(a, 0)
+                       for a, s in self.clock.items())
+
+    def _try_fast_map(self, change, ops, actor, seq, wrappers: dict,
+                      undoable):
+        """Map/table register rounds: set/del across one or more map
+        objects — the nested interactive shape (board field edits touch
+        the card map AND its meta map in one change). No positions, so
+        each overlay is just the pending writes; rounds that would
+        overwrite a LINK value are ineligible (reachability must stay
+        frozen while path caches live)."""
+        for w in wrappers.values():
+            if w.doc.conflicts or w.doc.queue:
+                return None
+        recs = []
+        for op in ops:
+            action = op.get("action")
+            key = op.get("key")
+            if action not in ("set", "del") or not key \
+                    or not isinstance(key, str):
+                return None
+            if action == "set" and isinstance(op.get("value"), dict):
+                return None
+            recs.append((op["obj"], action, key, op.get("value"),
+                         op.get("datatype")))
+        if not self._covers_doc(change, actor, seq):
+            return None
+        # current register of every touched key must not hold a link
+        # (overwriting one changes reachability under live path caches)
+        for o, _, key, _, _ in recs:
+            for cur in self._field_ops(o, key):
+                if cur.get("action") == "link":
+                    return None
+
+        if not self._admit(change, {}):
+            return []
+        if undoable:
+            self._push_undo(self._capture_inverse(change))
+        diffs = []
+        paths = None   # one BFS per round at most, shared by fresh overlays
+        for o, action, key, value, dt in recs:
+            wrapper = wrappers[o]
+            if wrapper.ov is None:
+                wrapper.ov = _MapOverlay()
+            ov = wrapper.ov
+            if ov.path is False:
+                if o == ROOT_ID:
+                    ov.path = []
+                else:
+                    if paths is None:
+                        paths = self._paths()
+                    ov.path = paths.get(o)
+            typ = wrapper.kind
+            if action == "set":
+                diff = {"action": "set", "obj": o, "type": typ,
+                        "key": key, "value": value, "path": ov.path}
+                if dt:
+                    diff["datatype"] = dt
+                rec = {"value": value}
+                if dt:
+                    rec["datatype"] = dt
+                ov.writes[key] = rec
+            else:
+                diff = {"action": "remove", "obj": o, "type": typ,
+                        "key": key, "path": ov.path}
+                ov.writes[key] = _DELETED
+            diffs.append(diff)
         self.pending.append(change)
         return diffs
 
@@ -642,9 +744,12 @@ class _DeviceCore:
         pending, self.pending = self.pending, []
         touched, _ = self._distribute(pending, {})
         for oid in touched:
-            w = self.objects.get(oid)
+            w = self.root if oid == ROOT_ID else self.objects.get(oid)
             if isinstance(w, _TextObj):
                 w.snapshot()
+            elif isinstance(w, _MapObj):
+                w.prev = w.current()
+            if w is not None:
                 w.ov = None
 
     # -- undo/redo (mirror of backend/index.js:258-316 + op_set undo) ---
@@ -661,7 +766,7 @@ class _DeviceCore:
             if wrapper is None:
                 return []
         doc = wrapper.doc
-        if isinstance(wrapper, _TextObj) and wrapper.ov is not None:
+        if wrapper.ov is not None:
             # pending fast-path rounds: their register writes live in the
             # overlay (engine state is behind); untouched registers fall
             # through to the device mirrors, which are still valid for them
@@ -811,8 +916,8 @@ class _DeviceCore:
         # single choke point: every path that mutates an object's engine
         # state goes through here)
         for oid in touched:
-            w = self.objects.get(oid)
-            if isinstance(w, _TextObj):
+            w = self.root if oid == ROOT_ID else self.objects.get(oid)
+            if w is not None:
                 w.ov = None
 
         if ROOT_ID in touched:

@@ -166,3 +166,70 @@ def test_ineligible_plan_does_not_leave_stale_overlay():
     twin = oracle_twin(doc)
     assert [e["elemId"] for e in doc["t"].elems] == \
         [e["elemId"] for e in twin["t"].elems]
+
+
+def test_map_rounds_ride_fast_path():
+    """Register edits on nested maps/tables (the board shape) are served
+    host-side; a link-overwriting round is NOT (reachability must stay
+    frozen while overlays live)."""
+    doc = am.change(am.init("aaaa"), lambda d: d.update(
+        {"cards": [{"title": "c0", "meta": {"votes": 1}}], "top": 1}))
+    base_pending = len(_core(doc).pending)
+    doc = am.change(doc, lambda d: d["cards"][0].__setitem__("title", "t2"))
+    doc = am.change(doc, lambda d: d["cards"][0]["meta"]
+                    .__setitem__("votes", 5))
+    doc = am.change(doc, lambda d: d.__setitem__("top", 2))
+    core = _core(doc)
+    assert len(core.pending) == base_pending + 3   # all three rode fast
+    j = am.to_json(doc)
+    assert j["cards"][0]["title"] == "t2"
+    assert j["cards"][0]["meta"]["votes"] == 5 and j["top"] == 2
+    # deleting a key that HOLDS A LINK must take the device path
+    doc = am.change(doc, lambda d: d.__delitem__("cards"))
+    assert "cards" not in am.to_json(doc)
+    twin = oracle_twin(doc)
+    assert am.to_json(twin) == am.to_json(doc)
+
+
+def test_map_undo_of_fast_rounds():
+    doc = am.change(am.init("aaaa"), lambda d: d.update({"k": 1}))
+    doc = am.change(doc, lambda d: d.__setitem__("k", 2))
+    assert _core(doc).pending
+    doc = am.undo(doc)
+    assert am.to_json(doc)["k"] == 1
+    doc = am.redo(doc)
+    assert am.to_json(doc)["k"] == 2
+
+
+def test_randomized_map_interleaving_matches_oracle():
+    for seed in range(3):
+        rng = random.Random(63_000 + seed)
+        base = am.change(am.init("base"), lambda d: d.update(
+            {"m": {"a": 1}, "t": Text("xy")}))
+        base_changes = am.get_all_changes(base)
+        docs = [am.apply_changes(am.init(f"actor-{i}"), base_changes)
+                for i in range(2)]
+        for _ in range(10):
+            i = rng.randrange(2)
+
+            def edit(d, rng=rng):
+                r = rng.random()
+                if r < 0.4:
+                    d["m"][rng.choice("abc")] = rng.randrange(100)
+                elif r < 0.55 and len(d["m"]) > 1:
+                    ks = [k for k in d["m"] if k != "a"]
+                    if ks:
+                        del d["m"][rng.choice(ks)]
+                elif r < 0.8:
+                    t = d["t"]
+                    t.insert_at(rng.randint(0, len(t)), rng.choice("pq"))
+                else:
+                    d[rng.choice("xyz")] = rng.randrange(10)
+            docs[i] = am.change(docs[i], edit)
+            if rng.random() < 0.3:
+                docs[i] = am.merge(docs[i], docs[1 - i])
+        merged = am.merge(docs[0], docs[1])
+        merged2 = am.merge(docs[1], docs[0])
+        twin = oracle_twin(merged)
+        assert am.to_json(merged) == am.to_json(merged2) \
+            == am.to_json(twin), f"seed {seed}"
