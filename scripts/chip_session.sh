@@ -5,6 +5,12 @@
 set -u
 cd "$(dirname "$0")/.."
 LOG=scripts/chip_session.log
+
+# single-flight guard: the chip admits ONE client; a second concurrent
+# session would wedge both (the probe loop may auto-launch this script)
+exec 9> /tmp/chip_session.lock
+flock -n 9 || { echo "chip session already running; exiting" >> "$LOG"; exit 5; }
+
 echo "=== chip session $(date -u +%FT%TZ) ===" >> "$LOG"
 
 run() {
