@@ -28,7 +28,12 @@ def preflight_device(timeout_s: int = 90, total_budget_s: float = 0.0) -> bool:
     each probe pays a full backend init, seconds on a remote tunnel)."""
     if os.environ.get("AMTPU_SKIP_PREFLIGHT") == "1":
         return True
-    timeout_s = float(os.environ.get("AMTPU_PREFLIGHT_PROBE_S", timeout_s))
+    try:
+        timeout_s = float(os.environ.get("AMTPU_PREFLIGHT_PROBE_S") or
+                          timeout_s)
+    except ValueError:
+        pass   # malformed override: keep the default, never crash the
+               # fail-fast path the stale fallback depends on
     deadline = time.monotonic() + total_budget_s
     backoff = 10.0
     while True:

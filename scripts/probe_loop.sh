@@ -3,15 +3,15 @@
 # chip measurement session DETACHED (it outlives this probe process) and
 # exit. Writes status lines to /tmp/tpu_probe_status.txt.
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
-# the chip admits ONE client: never probe while a chip session holds the
-# lock (checked before EVERY probe — a session can start mid-loop)
+# the chip admits ONE client and the probe IS a client: hold the session
+# lock for the whole loop (a session in flight -> don't probe; our lock
+# also keeps a session from starting mid-probe)
 exec 9> /tmp/chip_session.lock
+if ! flock -n 9; then
+  echo "chip session in flight; not probing ($(date +%H:%M:%S))" >> /tmp/tpu_probe_status.txt
+  exit 0
+fi
 for i in $(seq 1 6); do
-  if ! flock -n 9; then
-    echo "chip session in flight; not probing ($(date +%H:%M:%S))" >> /tmp/tpu_probe_status.txt
-    exit 0
-  fi
-  flock -u 9   # release before probing: the session lock is the one that matters
   echo "probe $i at $(date +%H:%M:%S)" >> /tmp/tpu_probe_status.txt
   if timeout 80 python -c "import jax; d=jax.devices(); assert d and d[0].platform=='tpu', d; print('TPU UP:', d)" >> /tmp/tpu_probe_status.txt 2>&1; then
     echo "TUNNEL_UP at $(date +%H:%M:%S) — launching chip session" >> /tmp/tpu_probe_status.txt

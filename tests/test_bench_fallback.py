@@ -23,6 +23,9 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 
 def _run_bench(env_extra):
     env = dict(os.environ)
+    # a lingering probe-skip knob (chip_session.sh exports it) would
+    # bypass the very preflight these tests exercise
+    env.pop("AMTPU_SKIP_PREFLIGHT", None)
     # make the probe fail REGARDLESS of tunnel health: pin the platform to
     # axon (no CPU fallback can satisfy the probe) and point the plugin at
     # a TEST-NET address that is never routable — NOT 127.0.0.1, which is

@@ -1,0 +1,144 @@
+"""Public-surface and wire-schema conformance.
+
+Counterpart of the reference's typescript_test.ts (718 lines validating
+the complete TS surface, @types/automerge/index.d.ts): here the contract
+is checked at RUNTIME — every public symbol the reference's typings
+promise has an analogue, and every wire object the library actually emits
+(changes, patches, diffs, sync messages) validates against the TypedDict
+schemas in automerge_tpu/types.py, including JSON round-trip stability
+(the reference pins that in test/test.js:230-235).
+"""
+
+import json
+import typing
+
+import automerge_tpu as am
+from automerge_tpu import Connection, DocSet, Text
+from automerge_tpu import types as T
+from automerge_tpu.backend import default as Backend
+from automerge_tpu import frontend as Frontend
+
+
+def _allowed_keys(td) -> set:
+    return set(typing.get_type_hints(td))
+
+
+def _check_keys(obj: dict, td, ctx: str):
+    extra = set(obj) - _allowed_keys(td)
+    assert not extra, f"{ctx}: keys outside the wire schema: {extra}"
+
+
+# ---------------------------------------------------------------------------
+# public surface (facade, frontend, backend, sync — the d.ts namespaces)
+# ---------------------------------------------------------------------------
+
+def test_facade_surface_complete():
+    """Every facade function the reference exports (automerge.js:136-149,
+    d.ts:18-54) has an analogue."""
+    for name in ("init", "from_", "change", "empty_change", "undo",
+                 "redo", "can_undo", "can_redo", "load", "save", "merge",
+                 "diff", "get_changes", "get_all_changes", "apply_changes",
+                 "get_missing_deps", "equals", "get_history", "to_json",
+                 "get_conflicts", "get_actor_id", "set_actor_id",
+                 "get_object_id", "uuid", "ROOT_ID"):
+        assert hasattr(am, name), f"facade missing {name}"
+    for cls in ("Text", "Table", "Counter", "Connection", "DocSet",
+                "WatchableDoc", "SyncHub"):
+        assert hasattr(am, cls), f"facade missing class {cls}"
+
+
+def test_frontend_backend_namespaces():
+    """Frontend (d.ts:141-163) and Backend (d.ts:165-175) namespaces."""
+    for name in ("init", "change", "empty_change", "apply_patch",
+                 "can_undo", "undo", "can_redo", "redo", "get_object_id",
+                 "get_actor_id", "set_actor_id", "get_conflicts",
+                 "get_backend_state"):
+        assert hasattr(Frontend, name), f"Frontend missing {name}"
+    for name in ("init", "apply_changes", "apply_local_change",
+                 "get_patch", "get_changes", "get_changes_for_actor",
+                 "get_missing_changes", "get_missing_deps", "merge",
+                 "undo", "redo"):
+        assert hasattr(Backend, name), f"Backend missing {name}"
+
+
+# ---------------------------------------------------------------------------
+# wire objects the library EMITS validate against the schemas
+# ---------------------------------------------------------------------------
+
+def _sample_doc():
+    doc = am.change(am.init("aaaa"), lambda d: d.update(
+        {"t": Text("hi"), "n": am.Counter(1), "k": 1}))
+    doc = am.change(doc, lambda d: [d["t"].insert_at(2, "!"),
+                                    d["n"].increment(2)])
+    return doc
+
+
+def test_emitted_changes_validate():
+    doc = _sample_doc()
+    changes = am.get_all_changes(doc)
+    assert changes
+    for ch in changes:
+        _check_keys(ch, T.Change, "change")
+        assert isinstance(ch["actor"], str) and isinstance(ch["seq"], int)
+        for op in ch["ops"]:
+            _check_keys(op, T.Op, f"op in seq {ch['seq']}")
+            assert op["action"] in typing.get_args(T.OpAction)
+
+
+def test_emitted_patches_validate():
+    doc = _sample_doc()
+    state = Frontend.get_backend_state(doc)
+    patch = Backend.get_patch(state)
+    _check_keys(patch, T.Patch, "patch")
+    for diff in patch["diffs"]:
+        _check_keys(diff, T.Diff, "diff")
+        assert diff["action"] in typing.get_args(T.DiffAction)
+        if "type" in diff:
+            assert diff["type"] in typing.get_args(T.CollectionType)
+        for c in diff.get("conflicts", []):
+            _check_keys(c, T.Conflict, "conflict")
+
+
+def test_sync_messages_validate():
+    ds_a, ds_b = DocSet(), DocSet()
+    sent = []
+    conn_a = Connection(ds_a, sent.append)
+    conn_b = Connection(ds_b, lambda m: conn_a.receive_msg(m))
+    ds_a.set_doc("d", _sample_doc())
+    conn_a.open()
+    conn_b.open()
+    for _ in range(4):
+        pending, sent[:] = list(sent), []
+        for m in pending:
+            conn_b.receive_msg(m)
+    # drain whatever conn_a produced last
+    assert am.to_json(ds_b.get_doc("d")) == am.to_json(ds_a.get_doc("d"))
+    # validate every message that crossed the wire
+    ds_c = DocSet()
+    msgs = []
+    conn_c = Connection(ds_c, msgs.append)
+    conn_c.open()
+    conn_c.receive_msg({"docId": "d",
+                        "clock": dict(Frontend.get_backend_state(
+                            ds_a.get_doc("d")).clock)})
+    for m in msgs:
+        _check_keys(m, T.Message, "sync message")
+
+
+def test_changes_survive_json_round_trip():
+    """The wire format is plain JSON: serializing and re-parsing changes
+    must reconstruct an identical document (reference test.js:230-235)."""
+    doc = _sample_doc()
+    wire = json.dumps(am.get_all_changes(doc))
+    rebuilt = am.apply_changes(am.init("bbbb"), json.loads(wire))
+    assert am.to_json(rebuilt) == am.to_json(doc)
+    assert [e["elemId"] for e in rebuilt["t"].elems] == \
+        [e["elemId"] for e in doc["t"].elems]
+
+
+def test_save_load_framing_is_json():
+    doc = _sample_doc()
+    blob = am.save(doc)
+    parsed = json.loads(blob)          # framing is documented JSON
+    assert isinstance(parsed, (list, dict))
+    assert am.to_json(am.load(blob)) == am.to_json(doc)
