@@ -19,7 +19,7 @@ import automerge_tpu as am
 from automerge_tpu import Text
 from automerge_tpu import frontend as Frontend
 from automerge_tpu.backend import facade as oracle_backend
-from automerge_tpu.backend.device import _DeviceCore, DeviceBackendState
+from automerge_tpu.backend.device import DeviceBackendState
 
 
 def _core(doc):

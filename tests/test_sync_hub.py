@@ -91,7 +91,6 @@ def test_n_connections_share_one_hub_and_one_diff():
     peers' believed clocks agree, ONE get_missing_changes extraction
     serves all N (the reference's per-Connection loop would diff N times,
     src/connection.js:58-74)."""
-    from automerge_tpu.sync import connection as conn_mod
     from automerge_tpu.sync import hub as hub_mod
 
     ds = DocSet()
