@@ -9,7 +9,6 @@ engine, built in ``automerge_tpu.ops``).
 
 from __future__ import annotations
 
-import re
 
 # The root object of every document (src/common.js:1).
 ROOT_ID = "00000000-0000-0000-0000-000000000000"
@@ -20,7 +19,6 @@ KIND_INS, KIND_SET, KIND_DEL, KIND_INC = 0, 1, 2, 3
 HEAD_PARENT = -1  # parent-actor encoding for the virtual list head ('_head')
 
 # elemId = "<actorId>:<counter>" — counter is a Lamport timestamp unique per list.
-_ELEM_ID_RE = re.compile(r"^(.*):(\d+)$")
 
 
 def is_object(value) -> bool:
