@@ -323,3 +323,63 @@ def test_closed_connection_absorbs_late_messages_without_sending():
     assert len(out_b) == n_sent         # nothing written to dead transport
     assert ds_b._sync_hub is None       # did not rejoin
     assert am.to_json(ds_b.get_doc("doc")) == {"x": 1, "y": 2}  # absorbed
+
+
+def test_lossy_network_recovers_on_reconnect():
+    """Messages dropped at random are recovered by peer reconnection: a
+    (re)joining peer is re-advertised everything, so a lossless exchange
+    after reconnect converges every node — the protocol's recovery story
+    (the reference's, too: re-sends happen on state change or peer (re)
+    connect, never spontaneously)."""
+    import random
+
+    for seed in (1, 2, 3):
+        rng = random.Random(41_000 + seed)
+        sets = [DocSet() for _ in range(3)]
+        queues = {(i, j): [] for i in range(3) for j in range(3) if i != j}
+        conns = {}
+
+        def connect(i, j):
+            conns[(i, j)] = Connection(sets[i], queues[(i, j)].append)
+            conns[(i, j)].open()
+
+        for i in range(3):
+            for j in range(3):
+                if i != j:
+                    connect(i, j)
+
+        def pump(drop_p, rounds=15):
+            for _ in range(rounds):
+                moved = False
+                for (i, j), q in queues.items():
+                    while q:
+                        msg = q.pop(0)
+                        if rng.random() < drop_p:
+                            continue
+                        conns[(j, i)].receive_msg(msg)
+                        moved = True
+                if not moved:
+                    break
+
+        sets[0].set_doc("d", am.change(am.init("seed"),
+                                       lambda d: d.__setitem__("x", 0)))
+        for step in range(6):           # lossy editing period
+            i = rng.randrange(3)
+            cur = sets[i].get_doc("d")
+            if cur is not None:
+                sets[i].set_doc("d", am.change(
+                    am.set_actor_id(cur, f"n{i}s{step}"),
+                    lambda d: d.__setitem__(f"k{step}", i)))
+            pump(drop_p=0.3, rounds=2)
+
+        # recovery: reconnect every face, then drain losslessly
+        for pair in list(conns):
+            conns[pair].close()
+            connect(*pair)
+        for _ in range(5):
+            pump(drop_p=0.0)
+        states = [am.to_json(sets[i].get_doc("d")) for i in range(3)
+                  if sets[i].get_doc("d") is not None]
+        assert len(states) >= 2, f"seed {seed}: doc never spread"
+        assert all(s == states[0] for s in states), \
+            f"seed {seed}: diverged after reconnect: {states}"
