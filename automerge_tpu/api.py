@@ -83,7 +83,15 @@ def merge(local_doc, remote_doc):
     local_state = Frontend.get_backend_state(local_doc)
     remote_state = Frontend.get_backend_state(remote_doc)
     state, patch = Backend.merge(local_state, remote_state)
-    if not patch["diffs"]:
+    # "no diffs" does NOT mean "nothing applied": this backend emits NET
+    # diffs, so a remote history whose net effect is zero (e.g. a delete
+    # followed by its undo) applies real changes yet produces an empty
+    # diff list. Returning local_doc then would silently drop those
+    # changes from the returned lineage (they would never re-sync — the
+    # clock says we have them). Short-circuit only when the clock proves
+    # nothing was applied. The reference's diff-based guard
+    # (src/automerge.js:68-78) is safe only under per-op diff emission.
+    if not patch["diffs"] and patch["clock"] == dict(local_state.clock):
         return local_doc
     patch["state"] = state
     return Frontend.apply_patch(local_doc, patch)

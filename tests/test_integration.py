@@ -412,3 +412,44 @@ class TestFreeze:
         # default docs are not frozen (same as the reference)
         d1["sneaky"] = 1
         assert d1["sneaky"] == 1
+
+
+class TestNetZeroMerge:
+    def test_merge_applies_net_zero_histories(self):
+        """A remote history whose net effect is zero (delete + its undo)
+        emits NO net diffs — merge must still apply the changes, or they
+        are silently dropped from the returned lineage and a later
+        different-order merge diverges (soak seed 400057)."""
+        import automerge_tpu as am
+        from automerge_tpu import Text
+        from automerge_tpu import frontend as Frontend
+
+        base = am.change(am.init("base"),
+                         lambda d: d.__setitem__("t", Text("seed")))
+        bc = am.get_all_changes(base)
+        a = am.apply_changes(am.init("actor-0"), bc)
+        b = am.apply_changes(am.init("actor-1"), bc)
+        a = am.change(a, lambda d: d.__setitem__("c", 36))
+        # b: delete three chars, then undo (restores) -> net-zero
+        b = am.change(b, lambda d: [d["t"].delete_at(0) for _ in range(3)])
+        b = am.undo(b)
+        assert str(b["t"]) == "seed"
+
+        m = am.merge(a, b)
+        clock = dict(Frontend.get_backend_state(m).clock)
+        assert clock.get("actor-1", 0) == 2, clock   # changes ARE applied
+        got = {(c["actor"], c["seq"]) for c in am.get_all_changes(m)}
+        assert ("actor-1", 1) in got and ("actor-1", 2) in got
+
+        # and the order-independence that seed 400057 violated
+        c0 = am.apply_changes(am.init("obs1"), am.get_all_changes(m))
+        m2 = am.merge(b, a)
+        c1 = am.apply_changes(am.init("obs2"), am.get_all_changes(m2))
+        assert am.to_json(c0) == am.to_json(c1)
+
+    def test_merge_with_nothing_new_returns_same_doc(self):
+        import automerge_tpu as am
+        a = am.change(am.init("aaaa"), lambda d: d.__setitem__("x", 1))
+        b = am.merge(am.init("bbbb"), a)
+        # b has nothing a lacks: merge must return the SAME doc object
+        assert am.merge(a, b) is a
