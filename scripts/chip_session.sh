@@ -20,7 +20,9 @@ run() {
   echo "--- $name rc=$? ---" >> "$LOG"
 }
 
-run "probe"            120 python -c "import jax; print(jax.devices())"
+# shared strict probe: proves a NON-CPU device actually computes — a
+# silent CPU fallback would run the whole measurement queue off-chip
+run "probe"            120 python scripts/probe_device.py
 grep -q "rc=0" <(tail -1 "$LOG") || { echo "tunnel down, aborting" >> "$LOG"; exit 3; }
 export AMTPU_SKIP_PREFLIGHT=1   # this session IS the parent probe
 

