@@ -16,6 +16,7 @@ cfg6 asserts the residual/slow register path actually ran).
 """
 
 import sys
+import time
 
 import numpy as np
 
@@ -286,22 +287,52 @@ def config5b_residual_heavy(n_actors: int = 10_000, quick: bool = False):
         op_target_ctr=tc, op_parent_actor=pa, op_parent_ctr=pc,
         op_value=val, actor_table=actors + ["base"], value_pool=[])
 
-    def run():
-        doc = DeviceTextDoc("t")
-        doc.eager_materialize = True
-        doc.apply_batch(B.base_batch("t", base_n))
-        doc.text()
-        doc.apply_batch(batch)
-        text = doc.text()
-        # deletes landed (base shrank), runs landed (typed chars present)
-        assert len(text) == base_n - n_actors * n_del \
-            + n_actors * run_pairs
+    def merge_once(merge_batch, expect_vis):
+        """bench.py's exact timing discipline (bench.py run_once): base
+        doc built untimed, prepare (host plan + h2d staging) untimed,
+        timed region = commit_prepared + codes-only materialize + the one
+        scalar-fetch sync. Returns best-of-2 commit seconds after a
+        warm-up pays the jit compiles."""
+        def once():
+            doc = DeviceTextDoc("t")
+            doc.eager_materialize = True
+            doc.apply_batch(B.base_batch("t", base_n))
+            doc.text()
+            prepared = doc.prepare_batch(merge_batch)
+            t0 = time.perf_counter()
+            doc.commit_prepared(prepared)
+            doc._materialize(with_pos=False)
+            scal = doc._scalars()
+            dt = time.perf_counter() - t0
+            assert int(scal[0]) == expect_vis, (int(scal[0]), expect_vis)
+            return dt
+        once()                      # warm-up: compiles at these shapes
+        return min(once() for _ in range(2))
 
-    dt = timed(run, warmups=1, reps=1)
-    emit(f"cfg5b_residual_heavy_{n_actors}_actors", n_ops / dt, "ops/s",
-         vs_baseline=(n_ops / dt) / 100e6,
+    # the CLEAN same-scale merge, timed with the identical discipline in
+    # the same process — the only way the 4x bound is actually comparable
+    # (round 4's version timed base-doc rebuild + double materialize for
+    # the residual row but commit-only for clean: unfalsifiable)
+    clean = B.merge_batch("t", n_actors, n_per, base_n)
+    clean_dt = merge_once(clean, base_n + n_actors * (n_per // 2))
+    resid_dt = merge_once(batch,
+                          base_n - n_actors * n_del + n_actors * run_pairs)
+    clean_rate = clean.n_ops / clean_dt
+    resid_rate = n_ops / resid_dt
+    slowdown = clean_rate / resid_rate
+    # the stated bound, ASSERTED so the suite fails when the residual
+    # path regresses instead of recording an unfalsifiable string
+    assert slowdown < 4.0, (
+        f"residual-heavy merge {slowdown:.1f}x slower than the clean "
+        f"same-scale merge (bound: <4x): clean {clean_rate:,.0f} ops/s "
+        f"vs residual {resid_rate:,.0f} ops/s")
+    emit(f"cfg5b_residual_heavy_{n_actors}_actors", resid_rate, "ops/s",
+         vs_baseline=resid_rate / 100e6,
          residual_fraction=0.2,
-         threshold="<4x slower than clean cfg5 on same platform")
+         clean_same_scale_ops_per_sec=round(clean_rate),
+         slowdown_vs_clean=round(slowdown, 2),
+         threshold="asserted in code: <4x slower than clean same-scale "
+                   "merge, identical timed region (commit+materialize+sync)")
 
 
 def config5c_two_causal_rounds(n_actors: int = 10_000, quick: bool = False):
