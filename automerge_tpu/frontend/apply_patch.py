@@ -339,8 +339,11 @@ def _text_target(object_id: str, cache: dict, updated: dict):
     if object_id not in updated:
         cached = cache.get(object_id)
         if cached is not None:
+            # O(n_chunks) copy-on-write snapshot, NOT an O(n) list copy —
+            # this is the per-keystroke frontend cost on large documents
+            # (ChunkedElems docstring, types.py)
             updated[object_id] = instantiate_text(
-                object_id, list(cached.elems), cached._max_elem)
+                object_id, cached.elems.copy(), cached._max_elem)
         else:
             updated[object_id] = instantiate_text(object_id, [], 0)
     return updated[object_id]

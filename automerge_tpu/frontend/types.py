@@ -13,6 +13,7 @@ attempts raise (the reference's deep-freeze option, README.md:208-212).
 
 from __future__ import annotations
 
+import bisect
 import datetime as _dt
 from typing import Any, Iterator, Optional
 
@@ -169,6 +170,218 @@ class WriteableCounter(Counter):
         return self.increment(-delta)
 
 
+class ChunkedElems:
+    """Copy-on-write chunked sequence backing ``Text.elems``.
+
+    The frontend's immutable-snapshot contract means every change that
+    touches a Text produces a NEW elems sequence while the old document
+    keeps the old one. With a flat list, the snapshot is an O(n) copy per
+    change — ~1 ms per keystroke on a 100k-char document, and the
+    dominant term in the interactive loop (the reference pays the same
+    shape via Immutable.js `List`, frontend/apply_patch.js — its
+    persistent vectors ARE structural sharing; this class is the Python
+    equivalent). Here `copy()` shares chunk references in O(n_chunks) and
+    each mutation privatizes only the chunk it lands in, so a 10-char
+    insert costs one ~CHUNK-element chunk copy instead of 100k.
+
+    Supports exactly the sequence surface the frontend uses: int/slice
+    reads, int writes, `insert`, slice-insertion (`e[i:i] = run`),
+    contiguous-range deletion, `len`, iteration.
+    """
+
+    __slots__ = ("_chunks", "_shared", "_starts", "_len")
+    CHUNK = 2048
+
+    def __init__(self, seq=None):
+        data = list(seq) if seq is not None else []
+        C = self.CHUNK
+        self._chunks = ([data[i: i + C] for i in range(0, len(data), C)]
+                        or [[]])
+        self._shared = [False] * len(self._chunks)
+        self._len = len(data)
+        self._starts = None
+
+    def copy(self) -> "ChunkedElems":
+        """O(n_chunks) snapshot: both sides share every chunk until one
+        of them writes."""
+        new = ChunkedElems.__new__(ChunkedElems)
+        new._chunks = list(self._chunks)
+        new._len = self._len
+        new._starts = self._starts   # rebuilt fresh on demand, never
+        self._shared = [True] * len(self._chunks)   # mutated in place
+        new._shared = [True] * len(self._chunks)
+        return new
+
+    # -- index bookkeeping ------------------------------------------
+    def _offsets(self):
+        if self._starts is None:
+            starts, acc = [], 0
+            for c in self._chunks:
+                starts.append(acc)
+                acc += len(c)
+            self._starts = starts
+        return self._starts
+
+    def _locate(self, i):
+        starts = self._offsets()
+        ci = bisect.bisect_right(starts, i) - 1
+        return ci, i - starts[ci]
+
+    def _own(self, ci):
+        if self._shared[ci]:
+            self._chunks[ci] = list(self._chunks[ci])
+            self._shared[ci] = False
+        return self._chunks[ci]
+
+    def _norm(self, i):
+        if i < 0:
+            i += self._len
+        if not 0 <= i < self._len:
+            raise IndexError("ChunkedElems index out of range")
+        return i
+
+    # -- reads -------------------------------------------------------
+    def __len__(self):
+        return self._len
+
+    def __iter__(self):
+        for c in self._chunks:
+            yield from c
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            start, stop, step = i.indices(self._len)
+            if step == 1:
+                return self._slice(start, stop)
+            return [self[j] for j in range(start, stop, step)]
+        i = self._norm(i)
+        ci, off = self._locate(i)
+        return self._chunks[ci][off]
+
+    def _slice(self, start, stop):
+        out = []
+        if start >= stop:
+            return out
+        ci, off = self._locate(start)
+        remaining = stop - start
+        while remaining > 0:
+            take = self._chunks[ci][off: off + remaining]
+            out.extend(take)
+            remaining -= len(take)
+            ci += 1
+            off = 0
+        return out
+
+    # -- writes ------------------------------------------------------
+    def __setitem__(self, i, v):
+        if isinstance(i, slice):
+            start, stop, step = i.indices(self._len)
+            if step != 1:
+                raise TypeError("extended-step slice assignment "
+                                "unsupported")
+            if start != stop:
+                self._del_range(start, stop)
+            self._insert_run(start, list(v))
+            return
+        i = self._norm(i)
+        ci, off = self._locate(i)
+        self._own(ci)[off] = v
+
+    def insert(self, i, v):
+        if i < 0:
+            i += self._len
+        self._insert_run(max(0, min(i, self._len)), [v])
+
+    def __delitem__(self, i):
+        if isinstance(i, slice):
+            start, stop, step = i.indices(self._len)
+            if step != 1:
+                raise TypeError("extended-step slice deletion unsupported")
+            self._del_range(start, stop)
+            return
+        i = self._norm(i)
+        self._del_range(i, i + 1)
+
+    def _insert_run(self, idx, items):
+        n = len(items)
+        if not n:
+            return
+        C = self.CHUNK
+        if n > C:
+            # bulk run (a remote peer's merged typing run): split the
+            # target chunk once and splice pre-chunked pieces between the
+            # halves — inserting into a chunk and re-splitting would copy
+            # the run twice more
+            pieces = [items[i: i + C] for i in range(0, n, C)]
+            if self._len == 0:                  # replace the [[]] sentinel
+                self._chunks = pieces
+                self._shared = [False] * len(pieces)
+            elif idx >= self._len:
+                self._chunks.extend(pieces)
+                self._shared.extend([False] * len(pieces))
+            else:
+                ci, off = self._locate(idx)
+                c = self._chunks[ci]
+                halves = ([c[:off]] if off else []) + pieces + \
+                    ([c[off:]] if off < len(c) else [])
+                self._chunks[ci: ci + 1] = halves
+                self._shared[ci: ci + 1] = [False] * len(halves)
+            self._len += n
+            self._starts = None
+            return
+        if idx >= self._len:                    # append
+            ci = len(self._chunks) - 1
+            off = len(self._chunks[ci])
+        else:
+            ci, off = self._locate(idx)
+        c = self._own(ci)
+        c[off:off] = items
+        self._len += n
+        self._starts = None
+        if len(c) > 2 * C:                      # keep chunks bounded
+            pieces = [c[i: i + C] for i in range(0, len(c), C)]
+            self._chunks[ci: ci + 1] = pieces
+            self._shared[ci: ci + 1] = [False] * len(pieces)
+
+    def _del_range(self, start, stop):
+        stop = min(stop, self._len)
+        if start >= stop:
+            return
+        ci, off = self._locate(start)
+        remaining = stop - start
+        while remaining > 0:
+            size = len(self._chunks[ci])
+            if off == 0 and remaining >= size and len(self._chunks) > 1:
+                # whole-chunk delete: drop the reference — privatizing a
+                # shared chunk only to discard it would be the O(n) copy
+                # this class exists to avoid
+                del self._chunks[ci]
+                del self._shared[ci]            # next chunk slides to ci
+                remaining -= size
+                continue
+            c = self._own(ci)
+            take = min(size - off, remaining)
+            del c[off: off + take]
+            remaining -= take
+            if not c and len(self._chunks) > 1:
+                del self._chunks[ci]
+                del self._shared[ci]
+            else:
+                ci += 1
+            off = 0
+        self._len -= stop - start
+        self._starts = None
+
+    def __eq__(self, other):
+        if isinstance(other, (ChunkedElems, list)):
+            return len(self) == len(other) and all(
+                a == b for a, b in zip(self, other))
+        return NotImplemented
+
+    def __repr__(self):
+        return f"ChunkedElems({list(self)!r})"
+
+
 class Text:
     """Sequence-of-characters (or embedded objects) CRDT view
     (frontend/text.js:3-165). ``elems`` entries are dicts
@@ -180,11 +393,11 @@ class Text:
         self._max_elem: int = 0
         self.context = None
         if isinstance(text, str):
-            self.elems = [{"value": ch} for ch in text]
+            self.elems = ChunkedElems({"value": ch} for ch in text)
         elif isinstance(text, (list, tuple)):
-            self.elems = [{"value": v} for v in text]
+            self.elems = ChunkedElems({"value": v} for v in text)
         elif text is None:
-            self.elems = []
+            self.elems = ChunkedElems()
         else:
             raise TypeError(f"Unsupported initial value for Text: {text!r}")
 
@@ -285,7 +498,8 @@ class Text:
 def instantiate_text(object_id, elems, max_elem) -> Text:
     instance = Text()
     instance._object_id = object_id
-    instance.elems = elems
+    instance.elems = (elems if isinstance(elems, ChunkedElems)
+                      else ChunkedElems(elems))
     instance._max_elem = max_elem or 0
     return instance
 

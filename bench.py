@@ -58,13 +58,14 @@ def base_batch(obj_id: str, n: int) -> TextChangeBatch:
 
 
 def merge_batch(obj_id: str, n_actors: int, ops_per_change: int,
-                base_n: int, seed: int = 0) -> TextChangeBatch:
+                base_n: int, seed: int = 0,
+                actor_prefix: str = "actor") -> TextChangeBatch:
     """n_actors concurrent changes, each a typing run of ops_per_change ops
     starting at a Zipfian-hot position in the base document."""
     rng = np.random.default_rng(seed)
     run = ops_per_change // 2            # ins+set pairs
     n_ops = n_actors * run * 2
-    actors = [f"actor-{i:06d}" for i in range(n_actors)]
+    actors = [f"{actor_prefix}-{i:06d}" for i in range(n_actors)]
     op_change = np.repeat(np.arange(n_actors, dtype=np.int32), run * 2)
     kind = np.tile(np.array([KIND_INS, KIND_SET], np.int8), n_actors * run)
     ta = np.repeat(np.arange(n_actors, dtype=np.int32), run * 2)

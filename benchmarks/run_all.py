@@ -335,6 +335,72 @@ def config5b_residual_heavy(n_actors: int = 10_000, quick: bool = False):
                    "merge, identical timed region (commit+materialize+sync)")
 
 
+def config5d_overlap(n_actors: int = 10_000, quick: bool = False):
+    """The PreparedBatch pipelining seam, exercised end-to-end (VERDICT r4
+    Next #4): two causally independent half-batches merge back-to-back;
+    the overlapped schedule runs `prepare_batch` of half 2 (host planning
+    + h2d staging) WHILE the device still executes half 1's commit — jax
+    dispatch is asynchronous, and the engine's only forced syncs are the
+    prepare-side `block_until_ready(staged)` (waits on the new round's
+    transfers, not the running kernels) and the final scalar fetch. The
+    serial comparator hard-barriers on half 1's output tables before
+    planning half 2. e2e_overlapped ~ max(prepare, commit) per round where
+    host and device are separate processors (the chip); on this box's ONE
+    CPU core, host planning and 'device' compute share the core, so rough
+    parity here + a gain on the chip row is the expected shape.
+
+    Path under test: engine/base.py prepare_batch/commit_prepared (the
+    seam's contract: plan binds to a generation; commit is bookkeeping +
+    dispatch only)."""
+    import bench as B
+    from automerge_tpu.engine import DeviceTextDoc
+
+    if quick:
+        n_actors = 500
+    base_n = 100 * n_actors
+    half = n_actors // 2
+    b1 = B.merge_batch("t", half, 1000, base_n, seed=1, actor_prefix="alpha")
+    b2 = B.merge_batch("t", half, 1000, base_n, seed=2, actor_prefix="beta")
+    n_ops = b1.n_ops + b2.n_ops
+    expect = base_n + 2 * half * 500
+
+    def run(overlap):
+        import jax
+        doc = DeviceTextDoc("t")
+        doc.apply_batch(B.base_batch("t", base_n))
+        doc.text()
+        t0 = time.perf_counter()
+        doc.commit_prepared(doc.prepare_batch(b1))
+        if not overlap:
+            # pure completion barrier on half 1's kernels — no extra
+            # compute, so serial-vs-overlapped isolates scheduling alone
+            jax.block_until_ready(list(doc._dev.values()))
+        doc.commit_prepared(doc.prepare_batch(b2))
+        doc._materialize(with_pos=False)
+        scal = doc._scalars()
+        dt = time.perf_counter() - t0
+        assert int(scal[0]) == expect, (int(scal[0]), expect)
+        return dt
+
+    run(True)                                  # warm-up: jit compiles
+    serial = min(run(False) for _ in range(2))
+    overlapped = min(run(True) for _ in range(2))
+    gain = serial / overlapped
+    # overlap must never LOSE meaningfully: it removes a barrier and adds
+    # no work (generous margin absorbs one-core scheduling noise)
+    assert overlapped <= serial * 1.15, (
+        f"overlapped schedule slower than serial: {overlapped:.4f}s vs "
+        f"{serial:.4f}s")
+    emit(f"cfg5d_e2e_overlapped_{n_actors}_actors", n_ops / overlapped,
+         "ops/s", vs_baseline=(n_ops / overlapped) / 100e6,
+         e2e_serial_s=round(serial, 4),
+         e2e_overlapped_s=round(overlapped, 4),
+         overlap_gain=round(gain, 3),
+         threshold="asserted in code: overlapped <= 1.15x serial "
+                   "(tracking: gain ~1 on one shared CPU core; the win "
+                   "shows where host and device are separate processors)")
+
+
 def config5c_two_causal_rounds(n_actors: int = 10_000, quick: bool = False):
     """Adversarial headline shape: every actor delivers TWO causally
     chained changes (seq 2 depends on seq 1), so the merge cannot be one
@@ -453,15 +519,22 @@ def config7_interactive_latency(n_base: int = 100_000, n_changes: int = 60):
     warm = np.asarray(lat[skip:]) * 1e3
     be_warm = np.asarray(be_lat[skip:]) * 1e3
     p50 = float(np.percentile(warm, 50))
+    p99 = float(np.percentile(warm, 99))
+    # stated-and-asserted interactive targets (VERDICT r4 Next #5): the
+    # ChunkedElems COW store removed the per-keystroke O(n) snapshot copy
+    # (measured p50 3.12 -> 1.01 ms, p99 40.8 -> 2.4 ms at this size)
+    assert p50 <= 1.5, f"interactive full-API p50 {p50:.2f} ms > 1.5 ms"
+    assert p99 <= 10.0, f"interactive full-API p99 {p99:.2f} ms > 10 ms"
     emit("cfg7_interactive_10op_change_100k_doc", p50, "ms_p50",
-         p99_ms=round(float(np.percentile(warm, 99)), 2),
+         p99_ms=round(p99, 2),
          backend_p50_ms=round(float(np.percentile(be_warm, 50)), 3),
          backend_p99_ms=round(float(np.percentile(be_warm, 99)), 3),
          n_changes=n_changes,
+         threshold="asserted in code: p50 <= 1.5 ms, p99 <= 10 ms",
          note="one 10-char insert per change through am.change; backend_* "
               "isolates apply_local_change (the device-tier write-behind "
               "fast path, INTERNALS 4.8); the remainder is frontend "
-              "immutable-snapshot cost")
+              "snapshot cost (ChunkedElems COW, types.py)")
 
 
 def config8_frontend_splice(n_big: int = 1_000_000, n_base_ab: int = 200_000,
@@ -473,7 +546,9 @@ def config8_frontend_splice(n_big: int = 1_000_000, n_base_ab: int = 200_000,
     per insert (O(n_ins * n_base)); the splice-batched path is one slice
     assignment (O(n_base + n_ins)). Tail-append patches are linear either
     way, so the A/B uses a mid-document run. Host-only (no device).
-    Regression threshold: batched >= 10x element-wise at the A/B size."""
+    Regression threshold: batched >= 4x element-wise at the A/B size
+    (was 10x against the flat-list elems store; the chunked COW store
+    made element-wise insertion O(CHUNK) per insert, see the assert)."""
     import time as _time
 
     from automerge_tpu.frontend.apply_patch import apply_diffs
@@ -504,7 +579,12 @@ def config8_frontend_splice(n_big: int = 1_000_000, n_base_ab: int = 200_000,
     assert [e["elemId"] for e in el_doc.elems] == \
         [e["elemId"] for e in sp_doc.elems]          # A/B parity
     speedup = el_s / sp_s
-    assert speedup >= 10, f"splice batching only {speedup:.1f}x"
+    # Pre-ChunkedElems, element-wise insertion shifted the flat list's
+    # whole tail per insert (O(n_ins * n_base)) and batching won 40-50x.
+    # The chunked COW elems store made element-wise O(n_ins * CHUNK), so
+    # the remaining batched win is amortized per-insert bookkeeping
+    # (~7x observed at 20k-into-200k); the threshold tracks that regime.
+    assert speedup >= 4, f"splice batching only {speedup:.1f}x"
     big_s, _ = apply_once(n_big, n_big, splice=True)
     emit(f"cfg8_frontend_apply_{n_big // 1000}k_insert_patch",
          n_big / big_s, "chars/s",
@@ -533,6 +613,7 @@ def main():
     config4_trellis(quick=quick)
     config5b_residual_heavy(quick=quick)
     config5c_two_causal_rounds(quick=quick)
+    config5d_overlap(quick=quick)
     config6_conflict_heavy()
     config7_interactive_latency(n_changes=20 if quick else 60)
     config8_frontend_splice(n_big=200_000 if quick else 1_000_000)

@@ -1,0 +1,144 @@
+"""ChunkedElems: the COW chunked store backing Text.elems.
+
+The frontend's immutable-snapshot contract (every change produces a new
+document while old ones stay valid — the reference gets this from
+Immutable.js persistent vectors, frontend/apply_patch.js) is carried here
+by chunk-level copy-on-write. These tests pin (a) sequence semantics
+against a plain-list mirror under random mutation, and (b) snapshot
+isolation: post-copy mutations on either side never leak to the other.
+"""
+
+import numpy as np
+import pytest
+
+from automerge_tpu.frontend.types import ChunkedElems, Text
+
+
+def test_sequence_ops_mirror_plain_list():
+    rng = np.random.default_rng(7)
+    ce = ChunkedElems(range(100))
+    ref = list(range(100))
+    for step in range(400):
+        op = rng.integers(0, 5)
+        n = len(ref)
+        if op == 0:                                  # insert run at point
+            i = int(rng.integers(0, n + 1))
+            run = [int(x) for x in rng.integers(0, 999, rng.integers(1, 7))]
+            ce[i:i] = run
+            ref[i:i] = run
+        elif op == 1 and n:                          # point write
+            i = int(rng.integers(0, n))
+            ce[i] = ref[i] = int(rng.integers(0, 999))
+        elif op == 2 and n:                          # point delete
+            i = int(rng.integers(0, n))
+            del ce[i]
+            del ref[i]
+        elif op == 3 and n:                          # range delete
+            i = int(rng.integers(0, n))
+            j = int(rng.integers(i, min(n, i + 9) + 1))
+            del ce[i:j]
+            del ref[i:j]
+        else:                                        # insert single
+            i = int(rng.integers(0, n + 1))
+            v = int(rng.integers(0, 999))
+            ce.insert(i, v)
+            ref.insert(i, v)
+        assert len(ce) == len(ref), f"step {step}"
+        if step % 25 == 0:
+            assert list(ce) == ref, f"step {step}"
+            if ref:
+                k = int(rng.integers(0, len(ref)))
+                assert ce[k] == ref[k]
+                assert ce[k : k + 5] == ref[k : k + 5]
+    assert list(ce) == ref
+
+
+def test_bulk_run_insert_crosses_chunks():
+    C = ChunkedElems.CHUNK
+    ce = ChunkedElems(range(3 * C))
+    ref = list(range(3 * C))
+    run = list(range(10_000, 10_000 + 5 * C + 3))    # > CHUNK: bulk path
+    ce[C + 17 : C + 17] = run
+    ref[C + 17 : C + 17] = run
+    assert len(ce) == len(ref)
+    assert list(ce) == ref
+    # appends also take the bulk path
+    ce[len(ce):len(ce)] = run
+    ref[len(ref):len(ref)] = run
+    assert list(ce) == ref
+
+
+def test_copy_is_isolated_both_directions():
+    ce = ChunkedElems(range(5000))
+    snap = ce.copy()
+    before = list(snap)
+    ce[123] = -1
+    ce[4000:4000] = [7, 8, 9]
+    del ce[0]
+    assert list(snap) == before            # snapshot unaffected by source
+    snap[200] = -2
+    del snap[300:350]
+    assert ce[0] == 1 and ce[122] == -1    # source unaffected by snapshot
+    assert len(ce) == 5002
+    assert len(snap) == 4950
+
+
+def test_copy_cost_is_chunk_count_not_elements():
+    """The interactive-latency win (cfg7): snapshots must not scale with
+    document size. A 200k-element copy touches ~n/CHUNK chunk refs."""
+    import time
+    ce = ChunkedElems({"value": "x"} for _ in range(200_000))
+    t0 = time.perf_counter()
+    for _ in range(50):
+        ce.copy()
+    per_copy = (time.perf_counter() - t0) / 50
+    flat = list(ce)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        list(flat)
+    per_list = (time.perf_counter() - t0) / 5
+    assert per_copy < per_list / 10, (per_copy, per_list)
+
+
+def test_text_snapshot_chain_stays_valid():
+    """am.change chains: every intermediate doc keeps its own content."""
+    import automerge_tpu as am
+
+    doc = am.change(am.init({"actorId": "u"}),
+                    lambda d: d.__setitem__("t", Text("abcdef")))
+    snaps = [doc]
+    for i in range(8):
+        doc = am.change(doc, lambda d, i=i: d["t"].insert_at(3, str(i)))
+        snaps.append(doc)
+    texts = [str(am.to_json(s)["t"]) for s in snaps]
+    assert texts[0] == "abcdef"
+    for i in range(1, 9):
+        assert len(texts[i]) == 6 + i
+        assert texts[i][3] == str(i - 1)
+
+
+def test_no_empty_chunks_invariant():
+    """Bulk insert into an empty store must replace the [[]] sentinel,
+    and whole-chunk deletes must drop references without privatizing."""
+    C = ChunkedElems.CHUNK
+    ce = ChunkedElems()
+    ce[0:0] = list(range(3 * C))
+    assert all(len(c) > 0 for c in ce._chunks), [len(c) for c in ce._chunks]
+    assert list(ce) == list(range(3 * C))
+    snap = ce.copy()
+    del ce[0 : 2 * C]                      # spans two whole shared chunks
+    assert list(ce) == list(range(2 * C, 3 * C))
+    assert len(snap) == 3 * C              # snapshot untouched
+    del ce[0 : len(ce)]                    # delete everything
+    assert len(ce) == 0 and list(ce) == []
+    ce.insert(0, 42)                       # still usable afterwards
+    assert list(ce) == [42]
+
+
+def test_extended_step_slices_rejected():
+    ce = ChunkedElems(range(10))
+    with pytest.raises(TypeError):
+        ce[::2] = [1, 2, 3]
+    with pytest.raises(TypeError):
+        del ce[::2]
+    assert ce[::2] == [0, 2, 4, 6, 8]      # stepped READS still work
