@@ -282,6 +282,22 @@ class OpSetIndex:
             overwritten = [other for other in ops if not self.is_concurrent(other, op)]
             remaining = [other for other in ops if self.is_concurrent(other, op)]
 
+        if op["action"] in ("set", "link"):
+            # AT MOST ONE op per actor per register. Two same-actor ops can
+            # only coexist transiently when one change assigns a key twice
+            # (undo/redo re-minting a conflict set does exactly this); the
+            # later op of the change supersedes its predecessor. Keeping
+            # both and relying on sort order is ORDER-DEPENDENT: a full
+            # reverse after a stable ascending sort flips the same-actor
+            # pair on every later application that re-sorts the register,
+            # so peers that applied different interleavings materialize
+            # different winners from identical change sets (found by
+            # scripts/soak.py, general profile seed 6; the reference's
+            # sortBy(actor).reverse() has the same latent flip).
+            superseded = [o for o in remaining if o["actor"] == op["actor"]]
+            overwritten = overwritten + superseded
+            remaining = [o for o in remaining if o["actor"] != op["actor"]]
+
         # Overwritten links drop out of the child's inbound index.
         for prior in overwritten:
             if prior["action"] == "link":
@@ -292,10 +308,8 @@ class OpSetIndex:
             self.by_object[op["value"]].inbound.append(op)
         if op["action"] in ("set", "link"):
             remaining = remaining + [op]
-        # ascending stable sort then full reverse (not reverse=True): mirrors
-        # the reference's sortBy(actor).reverse(), whose same-actor ties land
-        # in reverse insertion order so the last-written op wins
-        # (/root/reference/backend/op_set.js:245)
+        # descending by actor id — keys are now unique per actor, so the
+        # sort is total and application-order-independent
         remaining = sorted(remaining, key=lambda o: o["actor"])[::-1]
         rec.keys[op["key"]] = remaining
 

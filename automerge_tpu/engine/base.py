@@ -634,6 +634,12 @@ class CausalDeviceDoc:
                     self.value_pool.append(entry)
                     pooled = -len(self.value_pool)
                     counter = entry.get("datatype") == "counter"
+                # at most one op per actor per register (same convergence
+                # rule as the oracle, op_set.py _apply_assign: a later op
+                # of the same change supersedes its predecessor; same-rank
+                # pairs make the winner application-order-dependent)
+                surviving = [o for o in surviving
+                             if o["actor_rank"] != actor_rank]
                 surviving.append({"actor_rank": actor_rank, "seq": seq,
                                   "value": pooled, "counter": counter})
             regs[slot] = surviving
@@ -643,10 +649,10 @@ class CausalDeviceDoc:
         # share a slot with a loop op — the single-op gate)
         for s, slot_ops in regs.items():
             i = int(np.searchsorted(uniq, s))
-            # ascending stable sort + full reverse mirrors the reference's
-            # sortBy(actor).reverse(): same-actor ties (one change assigning
-            # a key twice) resolve to the LAST-written op, matching the
-            # oracle (backend/op_set.py _apply_assign)
+            # descending by actor rank — unique per actor (the filter at
+            # append time), so the order is total and
+            # application-order-independent, matching the oracle
+            # (backend/op_set.py _apply_assign)
             ops = sorted(slot_ops, key=lambda o: o["actor_rank"])[::-1]
             if ops:
                 w = ops[0]

@@ -82,6 +82,14 @@ class TestQueueSurvivesFailedRound:
 
 
 class TestSameActorTieBreak:
+    """One change assigning the same key twice: the LATER op supersedes
+    its predecessor — no self-conflict survives. (Deliberate deviation
+    from the reference's observable artifact: keeping both same-actor ops
+    in the register makes the winner application-order-dependent — the
+    redo-of-conflict convergence bug, tests/test_integration.py
+    TestRedoConflictConvergence — and the reference's per-actor conflict
+    map rendered a same-actor 'conflict' nonsensically anyway.)"""
+
     CHANGE = {"actor": "a", "seq": 1, "deps": {},
               "ops": [setop(ROOT_ID, "k", 1), setop(ROOT_ID, "k", 2)]}
 
@@ -90,10 +98,10 @@ class TestSameActorTieBreak:
         state, patch = Backend.apply_changes(state, [self.CHANGE])
         final = patch["diffs"][-1]
         assert final["value"] == 2
-        assert [c["value"] for c in final["conflicts"]] == [1]
+        assert not final.get("conflicts")   # predecessor superseded
 
     def test_engine_last_written_wins(self):
         doc = DeviceMapDoc(ROOT_ID)
         doc.apply_changes([self.CHANGE])
         assert doc.to_dict() == {"k": 2}
-        assert doc.conflicts_for("k") == {"a": 1}
+        assert doc.conflicts_for("k") in (None, {})

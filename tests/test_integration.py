@@ -453,3 +453,44 @@ class TestNetZeroMerge:
         b = am.merge(am.init("bbbb"), a)
         # b has nothing a lacks: merge must return the SAME doc object
         assert am.merge(a, b) is a
+
+
+class TestRedoConflictConvergence:
+    def test_redo_of_conflicted_register_converges_all_orders(self):
+        """A redo change re-mints the WHOLE conflict set of a register as
+        multiple same-actor ops in one change. Keeping both ops and
+        breaking ties by list order is application-order-dependent: a
+        stable ascending sort followed by a full reverse flips the
+        same-actor pair on every later re-sort of the register, so peers
+        that merged in different orders materialized different winners
+        from IDENTICAL change sets (found by scripts/soak.py general
+        profile seed 6). The register now keeps at most one op per actor
+        — the later op of the change supersedes its predecessor."""
+        import automerge_tpu as am
+
+        base = am.change(am.init("base"), lambda d: d.__setitem__("m", {"k": 0}))
+        bc = am.get_all_changes(base)
+        a1 = am.apply_changes(am.init("actor-1"), bc)
+        a2 = am.apply_changes(am.init("actor-2"), bc)
+        a2 = am.change(a2, lambda d: d["m"].__setitem__("k", 32))
+        a1 = am.change(a1, lambda d: d["m"].__setitem__("k", 49))
+        a1 = am.merge(a1, a2)            # a1 sees the conflict {49, 32}
+        a1 = am.undo(a1)                 # seq2: restore pre-conflict value
+        a1 = am.redo(a1)                 # seq3: re-mints BOTH 32 and 49
+        # a0 wrote concurrently with the undo/redo pair
+        subset = [c for c in am.get_all_changes(a1)
+                  if (c["actor"], c["seq"]) in
+                  {("base", 1), ("actor-1", 1), ("actor-2", 1)}]
+        a0 = am.apply_changes(am.init("actor-0"), subset)
+        a0 = am.change(a0, lambda d: d["m"].__setitem__("k", 43))
+
+        import itertools
+        winners = set()
+        for perm in itertools.permutations([a0, a1, a2]):
+            m = am.init("observer")
+            for p in perm:
+                m = am.merge(m, p)
+            winners.add(am.to_json(m)["m"]["k"])
+        # every application order materializes the same winner: actor-1's
+        # redo causally covers 32, and actor-1 > actor-0 on the tiebreak
+        assert winners == {49}, winners

@@ -1,0 +1,27 @@
+"""Short-budget smoke of the committed soak harness (scripts/soak.py).
+
+The full campaign runs hundreds of seeds (round 4's ad-hoc version found
+the net-zero-merge convergence bug); CI runs a handful per profile so the
+harness itself can never rot. Reproduce any failure exactly with:
+`python scripts/soak.py --profile <name> --sessions 1 --seed-base <seed>`.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import soak  # noqa: E402
+
+
+@pytest.mark.parametrize("profile", sorted(soak.PROFILES))
+def test_soak_profile_smoke(profile):
+    for seed in range(3):
+        soak.PROFILES[profile](seed)
+
+
+def test_runner_reports_and_exits_cleanly():
+    assert soak.run("general", sessions=2, seed_base=100) == 0
