@@ -33,5 +33,5 @@ run "bench"            900 python bench.py
 run "planned_ab"       900 python profile_bench.py --planned
 run "trace"            600 python profile_bench.py --trace
 run "pallas_ab"        900 python profile_bench.py --pallas
-run "configs_record"  3600 python -m benchmarks.run_all --record 4
+run "configs_record"  3600 python -m benchmarks.run_all --record "${AMTPU_ROUND:-5}"
 echo "=== chip session done $(date -u +%T) ===" >> "$LOG"
