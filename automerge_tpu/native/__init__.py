@@ -32,6 +32,32 @@ _lib = None
 _lib_failed = False
 
 
+def _host_supports_avx2() -> bool:
+    """True iff THIS machine's CPU runs AVX2. g++ happily compiles
+    -march=x86-64-v3 on an AVX2-less x86 host (the compiler never checks
+    the host CPU), and the resulting .so dies with SIGILL at the first
+    vectorized call — a hard process kill no except-clause can catch, so
+    the gate must be the runtime capability, not compile success."""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            return "avx2" in fh.read()
+    except OSError:          # non-Linux: stay on baseline codegen
+        return False
+
+
+def _build_flags() -> list:
+    # x86-64-v3 (AVX2/FMA baseline) lets gcc vectorize the columnar
+    # predicate loops in detect_runs (measured 77 -> 47.5 ms at 10M ops);
+    # NOT -march=native, so the .so stays valid on any AVX2-capable host
+    flags = ["-O3", "-shared", "-fPIC", "-std=c++17", "-pthread"]
+    if _host_supports_avx2():
+        flags.insert(0, "-march=x86-64-v3")
+    return flags
+
+
+_FLAGS_STAMP = os.path.join(_HERE, "build", "build_flags.txt")
+
+
 def _load():
     """Build (if stale) and load the codec library; None if unavailable."""
     global _lib, _lib_failed
@@ -41,13 +67,23 @@ def _load():
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            if (not os.path.exists(_SO)
+            flags = _build_flags()
+            # the flags are part of the cache key: an mtime-only check
+            # would keep serving a stale -O2 build (or an AVX2 build to
+            # a host that can't run it) forever
+            try:
+                with open(_FLAGS_STAMP) as fh:
+                    stamp_current = fh.read() == " ".join(flags)
+            except OSError:
+                stamp_current = False
+            if (not os.path.exists(_SO) or not stamp_current
                     or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
                 os.makedirs(os.path.dirname(_SO), exist_ok=True)
                 subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                     "-pthread", _SRC, "-o", _SO],
+                    ["g++", *flags, _SRC, "-o", _SO],
                     check=True, capture_output=True, timeout=120)
+                with open(_FLAGS_STAMP, "w") as fh:
+                    fh.write(" ".join(flags))
             lib = ctypes.CDLL(_SO)
             lib.amtpu_parse.restype = ctypes.c_void_p
             lib.amtpu_parse.argtypes = [ctypes.c_char_p, ctypes.c_long,

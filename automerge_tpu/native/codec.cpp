@@ -560,6 +560,11 @@ static void simulate_chunk(
     }
     int64_t prev_pair = start - 2;  // entry basis: a pair MAY have ended
                                     // at start-2 (stitch resolves truth)
+    // NOTE: a block-precomputed predicate-mask variant was measured
+    // SLOWER here (the short-circuiting scalar compares run once per
+    // PAIR, i.e. half the ops, while masks must be computed for every
+    // op); the win on this path is -O3 -march=x86-64-v3 codegen, not
+    // manual restructuring.
     int64_t i = start;
     while (i < end) {
         bool pair = (kind[i] == INS && i + 1 < n && kind[i + 1] == SET
