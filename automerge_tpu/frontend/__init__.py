@@ -16,7 +16,8 @@ from __future__ import annotations
 
 from .._common import ROOT_ID
 from .._uuid import uuid as _uuid
-from .apply_patch import apply_diffs, clone_root_object, update_parent_objects
+from .apply_patch import (InboundIndex, apply_diffs, clone_root_object,
+                          copy_inbound, update_parent_objects)
 from .context import Context
 from .proxies import ListProxy, MapProxy, root_object_proxy
 from .types import Counter, ListDoc, MapDoc, Table, Text
@@ -115,7 +116,7 @@ def _make_change(doc, request_type, context, options):
 
 def _apply_patch_to_doc(doc, patch, state, from_backend):
     actor = get_actor_id(doc)
-    inbound = dict(doc._inbound)
+    inbound = copy_inbound(doc._inbound)
     updated: dict = {}
     apply_diffs(patch["diffs"], doc._cache, updated, inbound)
     update_parent_objects(doc._cache, updated, inbound)
@@ -179,7 +180,7 @@ def init(options=None):
         state["backendState"] = options["backend"].init()
     root._options = options
     root._cache = {ROOT_ID: root}
-    root._inbound = {}
+    root._inbound = InboundIndex()
     root._state = state
     root._freeze()
     return root

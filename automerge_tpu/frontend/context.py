@@ -12,7 +12,7 @@ import datetime as _dt
 
 from .._common import make_elem_id
 from .._uuid import uuid
-from .apply_patch import apply_diffs
+from .apply_patch import apply_diffs, copy_inbound
 from .types import (Counter, ListDoc, MapDoc, Table, Text, WriteableCounter,
                     datetime_to_timestamp)
 
@@ -37,7 +37,7 @@ class Context:
         self.actor_id = actor_id
         self.cache = doc._cache
         self.updated: dict = {}
-        self.inbound: dict = dict(doc._inbound)
+        self.inbound: dict = copy_inbound(doc._inbound)
         self.ops: list = []
         self.diffs: list = []
         self.closed = False  # set when the change block ends; later mutations
