@@ -561,6 +561,57 @@ def config7_interactive_latency(n_base: int = 100_000, n_changes: int = 60):
               "snapshot cost (ChunkedElems COW, types.py)")
 
 
+def config7b_nested_under_large_root(n_root: int = 100_000,
+                                     n_changes: int = 20):
+    """Interactive latency for the REALISTIC nested-document shape: one
+    small nested map edited under a large root. Round 5 found the parent
+    relink pass scanning every root entry per nested change (~70 ms at
+    this size); the keyed relink (InboundIndex.key_of,
+    frontend/apply_patch.py) makes the cost the root's own clone, not a
+    scan. Same 3-attempt contention discipline as cfg7."""
+    import time as _time
+
+    import automerge_tpu as am
+
+    doc = am.init("user")
+    for c in range(4):
+        doc = am.change(doc, lambda d, c=c: [
+            d.__setitem__(f"k{c}-{i}", i) for i in range(n_root // 4)])
+    doc = am.change(doc, lambda d: d.__setitem__(
+        "board", {"meta": {"title": "t"}}))
+
+    P50_TARGET_MS, ATTEMPTS = 10.0, 3
+    skip = n_changes // 5
+
+    def measure(doc):
+        lat = []
+        for i in range(n_changes):
+            t0 = _time.perf_counter()
+            doc = am.change(doc, lambda d, i=i: d["board"]["meta"]
+                            .__setitem__("title", f"v{i}"))
+            lat.append(_time.perf_counter() - t0)
+        assert am.to_json(doc)["board"]["meta"]["title"] == \
+            f"v{n_changes - 1}"
+        return float(np.percentile(np.asarray(lat[skip:]) * 1e3, 50)), doc
+
+    for attempt in range(ATTEMPTS):
+        p50, doc = measure(doc)
+        if p50 <= P50_TARGET_MS:
+            break
+        if attempt < ATTEMPTS - 1:
+            _time.sleep(4)
+    assert p50 <= P50_TARGET_MS, \
+        f"nested-change p50 {p50:.2f} ms > {P50_TARGET_MS} ms"
+    emit(f"cfg7b_nested_change_under_{n_root // 1000}k_root", p50,
+         "ms_p50", n_changes=n_changes,
+         threshold=f"asserted in code: p50 <= {P50_TARGET_MS} ms "
+                   f"(persistent across up to {ATTEMPTS} attempts); "
+                   "was ~70 ms pre keyed-relink",
+         note="one nested map key set per am.change under a "
+              f"{n_root}-key root; cost = root clone, not a root scan "
+              "(frontend/apply_patch.py InboundIndex.key_of)")
+
+
 def config8_frontend_splice(n_big: int = 1_000_000, n_base_ab: int = 200_000,
                             n_ins_ab: int = 20_000):
     """Frontend patch application: a bulk text-insert patch landing in the
@@ -650,6 +701,7 @@ def main():
     config5d_overlap(quick=quick)
     config6_conflict_heavy()
     config7_interactive_latency(n_changes=20 if quick else 60)
+    config7b_nested_under_large_root(n_root=20_000 if quick else 100_000)
     config8_frontend_splice(n_big=200_000 if quick else 1_000_000)
     if record_round is not None:
         # cfg5 = the headline bench, folded into the record file

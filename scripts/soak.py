@@ -11,6 +11,7 @@ Profiles (each session is deterministic in its seed):
   general   nested histories with undo/redo and merge interleavings
   conflict  same-key / same-element races with partial pairwise sync
   lossy     Connection-protocol sync over a dropping network with churn
+  table     concurrent Table row add/update/remove with partial sync
 
 Usage:
   python scripts/soak.py [--profile all] [--sessions 30] [--seed-base 0]
@@ -236,8 +237,53 @@ def session_lossy(seed: int) -> None:
     assert ok, f"lossy seed {seed} diverged: {diff}"
 
 
+def session_table(seed: int) -> None:
+    """Concurrent Table row add/update/remove with partial sync — the
+    row-oriented surface the other profiles never touch."""
+    am = _am()
+    from automerge_tpu import Table
+    rng = np.random.default_rng(seed)
+    base = am.change(am.init("base"), lambda d: d.__setitem__("t", Table()))
+    changes = am.get_all_changes(base)
+    peers = [am.apply_changes(am.init(f"tw{i}"), changes) for i in range(3)]
+    known_rows: list = []           # row ids any peer has minted
+    for step in range(int(rng.integers(12, 24))):
+        i = int(rng.integers(0, len(peers)))
+        act = int(rng.integers(0, 4))
+        if act == 0 or not known_rows:       # add a row
+            holder = {}
+            def add(d, i=i, s=step, holder=holder):
+                holder["id"] = d["t"].add(
+                    {"by": f"tw{i}", "step": s,
+                     "v": int(rng.integers(0, 99))})
+            peers[i] = am.change(peers[i], add)
+            known_rows.append(holder["id"])
+        elif act == 1:                       # update a row if visible here
+            rid = known_rows[int(rng.integers(0, len(known_rows)))]
+            if peers[i]["t"].by_id(rid) is not None:
+                peers[i] = am.change(
+                    peers[i], lambda d, rid=rid, s=step:
+                    d["t"].by_id(rid).__setitem__("v", 1000 + s))
+        elif act == 2:                       # remove a row if visible here
+            rid = known_rows[int(rng.integers(0, len(known_rows)))]
+            if peers[i]["t"].by_id(rid) is not None:
+                peers[i] = am.change(
+                    peers[i], lambda d, rid=rid: d["t"].remove(rid))
+        else:                                # partial sync
+            j = int(rng.integers(0, len(peers)))
+            if j != i:
+                peers[i] = am.merge(peers[i], peers[j])
+    for _ in range(2):
+        for i in range(len(peers)):
+            for j in range(len(peers)):
+                if i != j:
+                    peers[i] = am.merge(peers[i], peers[j])
+    ok, diff = _converged(am, peers)   # to_json renders tables as dicts
+    assert ok, f"table seed {seed} diverged: {diff}"
+
+
 PROFILES = {"general": session_general, "conflict": session_conflict,
-            "lossy": session_lossy}
+            "lossy": session_lossy, "table": session_table}
 
 
 def run(profile: str, sessions: int, seed_base: int) -> int:
