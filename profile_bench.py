@@ -97,8 +97,16 @@ def pallas_ab():
     import gzip
     import json as _json
 
+    import jax
     import jax.numpy as jnp
     import numpy as np
+
+    if jax.devices()[0].platform == "cpu":
+        # compiled pallas_call is chip-only (CPU supports interpret mode
+        # only, which measures nothing) — skip cleanly so a session
+        # dry-run doesn't report a step failure that on-chip wouldn't have
+        print("pallas_ab: chip-only A/B — skipped on cpu platform")
+        return
 
     from automerge_tpu.ops.scan_pallas import fused_segment_scans
 
@@ -163,7 +171,13 @@ def planned_ab(batch):
             times.append(t() - t0)
             assert int(scal[0]) == BASE_LEN + N_ACTORS * (OPS_PER_CHANGE // 2)
             if not no_mirror:
-                assert len(scal) == 4, "planned kernel did not engage"
+                # the planned materialization returns the 5-scalar pack
+                # (n_vis, n_segs, chain-count + structural-hash verifiers
+                # — text_doc._scalars); the self-contained kernel returns
+                # 2. (Was ==4 from an older pack layout: the round-5
+                # session dry-run caught it failing before any chip
+                # window could.)
+                assert len(scal) == 5, "planned kernel did not engage"
         return min(times)
 
     for name, nm in (("self-contained", True), ("host-planned", False)):

@@ -21,17 +21,49 @@ run() {
 }
 
 # shared strict probe: proves a NON-CPU device actually computes — a
-# silent CPU fallback would run the whole measurement queue off-chip
-run "probe"            120 python scripts/probe_device.py
+# silent CPU fallback would run the whole measurement queue off-chip.
+# AMTPU_SESSION_DRYRUN=1 relaxes the probe to --allow-cpu so the WHOLE
+# session pipeline (step sequencing, gates, record writing, log format)
+# can be exercised without the chip; every emitted row still carries
+# platform:cpu provenance, so a dry run can never masquerade as a chip
+# sweep.
+PROBE_ARGS=""
+if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
+  PROBE_ARGS="--allow-cpu"
+  echo "DRY RUN (cpu-allowed probe): pipeline validation, not chip data" >> "$LOG"
+fi
+run "probe"            120 python scripts/probe_device.py $PROBE_ARGS
 grep -q "rc=0" <(tail -1 "$LOG") || { echo "tunnel down, aborting" >> "$LOG"; exit 3; }
 export AMTPU_SKIP_PREFLIGHT=1   # this session IS the parent probe
 
-AUTOMERGE_TPU_TESTS_ON_TPU=1 \
-  run "tpu_smoke"      900 python -m pytest tests/test_segments.py tests/test_engine_parity.py tests/test_fast_local.py -q
-grep -q "rc=0" <(tail -1 "$LOG") || { echo "on-chip smoke FAILED, not recording benchmarks" >> "$LOG"; exit 4; }
+# ONE smoke definition for both modes (divergence here is exactly what
+# the dry run exists to prevent); the only difference is the on-TPU test
+# pin, meaningless without a chip
+SMOKE_TESTS="tests/test_segments.py tests/test_engine_parity.py tests/test_fast_local.py"
+SMOKE_ENV=(env AUTOMERGE_TPU_TESTS_ON_TPU=1)
+SMOKE_FAIL="on-chip smoke FAILED"
+if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
+  SMOKE_ENV=(env)
+  # distinct marker: probe_forever stops permanently at the real
+  # "on-chip smoke FAILED" marker; a cpu dry-run flake must not kill
+  # the round's probing
+  SMOKE_FAIL="DRYRUN smoke failed (cpu)"
+fi
+run "tpu_smoke"        900 "${SMOKE_ENV[@]}" python -m pytest $SMOKE_TESTS -q
+grep -q "rc=0" <(tail -1 "$LOG") || { echo "$SMOKE_FAIL, not recording benchmarks" >> "$LOG"; exit 4; }
 run "bench"            900 python bench.py
 run "planned_ab"       900 python profile_bench.py --planned
 run "trace"            600 python profile_bench.py --trace
 run "pallas_ab"        900 python profile_bench.py --pallas
-run "configs_record"  3600 python -m benchmarks.run_all --record "${AMTPU_ROUND:-5}"
-echo "=== chip session done $(date -u +%T) ===" >> "$LOG"
+if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
+  # NO --record in a dry run: write_record replaces same-platform rows,
+  # and a pipeline-validation pass must never overwrite the curated cpu
+  # record rows; --quick still validates the run_all invocation
+  run "configs_quick"  1800 python -m benchmarks.run_all --quick
+  # a DIFFERENT marker on purpose: probe_forever stops at the real
+  # "chip session done" marker, and a dry run must not stop the probing
+  echo "=== chip session DRYRUN-complete $(date -u +%T) ===" >> "$LOG"
+else
+  run "configs_record" 3600 python -m benchmarks.run_all --record "${AMTPU_ROUND:-5}"
+  echo "=== chip session done $(date -u +%T) ===" >> "$LOG"
+fi
