@@ -678,6 +678,69 @@ def config8_frontend_splice(n_big: int = 1_000_000, n_base_ab: int = 200_000,
                    "20k-into-200k A/B size")
 
 
+def config9_sync_fanout(n_peers: int = 20, n_changes: int = 50):
+    """Multi-peer sync throughput: one author DocSet fanning every local
+    change out to n_peers over the Connection protocol. The reference
+    instantiates one Connection per peer, each re-diffing every doc per
+    local change (src/connection.js:58-88); here all author-side
+    Connections share one SyncHub (sync/hub.py) — one vectorized
+    ClockMatrix comparison per change regardless of peer count. Measured:
+    end-to-end deliveries (change applied at a peer) per second, full
+    protocol included (clock bookkeeping, extraction, message pump,
+    remote apply + frontend patch)."""
+    import time as _time
+
+    import automerge_tpu as am
+    from automerge_tpu import Connection, DocSet, Text
+
+    author_set = DocSet()
+    author_set.set_doc("doc", am.change(
+        am.init("author"), lambda d: d.__setitem__("t", Text("base"))))
+    peer_sets = [DocSet() for _ in range(n_peers)]
+    out_q = [[] for _ in range(n_peers)]
+    in_q = [[] for _ in range(n_peers)]
+    author_conns = [Connection(author_set, out_q[i].append)
+                    for i in range(n_peers)]
+    peer_conns = [Connection(peer_sets[i], in_q[i].append)
+                  for i in range(n_peers)]
+    for c in author_conns + peer_conns:
+        c.open()
+
+    def pump():
+        moved = True
+        while moved:
+            moved = False
+            for i in range(n_peers):
+                while out_q[i]:
+                    peer_conns[i].receive_msg(out_q[i].pop(0))
+                    moved = True
+                while in_q[i]:
+                    author_conns[i].receive_msg(in_q[i].pop(0))
+                    moved = True
+
+    pump()                                   # initial advertisements
+    t0 = _time.perf_counter()
+    for k in range(n_changes):
+        doc = author_set.get_doc("doc")
+        author_set.set_doc("doc", am.change(
+            doc, lambda d, k=k: d["t"].insert_at(0, *"0123456789")))
+        pump()
+    dt = _time.perf_counter() - t0
+    # each change splices its run at position 0, so the LAST change's run
+    # is frontmost and every run reads in order — full content equality
+    # catches RGA mis-ordering that a length check would miss
+    expect = "0123456789" * n_changes + "base"
+    for ps in peer_sets:
+        got = str(am.to_json(ps.get_doc("doc"))["t"])
+        assert got == expect, (got[:40], len(got))
+    deliveries = n_changes * n_peers
+    emit(f"cfg9_sync_fanout_{n_peers}peers", deliveries / dt,
+         "deliveries/s",
+         changes_per_sec=round(n_changes / dt, 1),
+         n_peers=n_peers, n_changes=n_changes,
+         threshold=TRACKING_ONLY)
+
+
 def main():
     from benchmarks.common import preflight_device
     # allow_cpu: off-chip smoke runs are legitimate here — every emitted
@@ -703,6 +766,8 @@ def main():
     config7_interactive_latency(n_changes=20 if quick else 60)
     config7b_nested_under_large_root(n_root=20_000 if quick else 100_000)
     config8_frontend_splice(n_big=200_000 if quick else 1_000_000)
+    config9_sync_fanout(n_peers=8 if quick else 20,
+                        n_changes=20 if quick else 50)
     if record_round is not None:
         # cfg5 = the headline bench, folded into the record file
         import json as _json

@@ -233,3 +233,61 @@ def test_randomized_map_interleaving_matches_oracle():
         twin = oracle_twin(merged)
         assert am.to_json(merged) == am.to_json(merged2) \
             == am.to_json(twin), f"seed {seed}"
+
+
+class TestFastRemote:
+    """Remote deliveries that causally cover the whole current document
+    ride the write-behind fast path (device.py _try_fast_remote); anything
+    concurrent must take the engine. Both sides pinned against the oracle."""
+
+    def test_covering_remote_stream_matches_oracle(self):
+        author = am.change(am.init("author"),
+                           lambda d: d.__setitem__("t", am.Text("x" * 200)))
+        peer = am.merge(am.init("peer"), author)
+        doc = author
+        for k in range(12):
+            doc = am.change(doc, lambda d, k=k: d["t"]
+                            .insert_at(10 + k, *"ab"))
+        remote = am.get_all_changes(doc)[
+            len(am.get_all_changes(author)):]
+        for ch in remote:                     # one-by-one: the sync shape
+            peer = am.apply_changes(peer, [ch])
+        assert str(am.to_json(peer)["t"]) == str(am.to_json(doc)["t"])
+        twin = oracle_twin(peer)
+        assert am.to_json(twin) == am.to_json(peer)
+
+    def test_concurrent_remote_delivery_keeps_engine_semantics(self):
+        """A delivery that does NOT cover the receiver (receiver has its
+        own concurrent edits) must resolve through the engine: conflicts
+        and RGA ordering identical to the oracle in both merge orders."""
+        base = am.change(am.init("base"),
+                         lambda d: (d.__setitem__("t", am.Text("seed")),
+                                    d.__setitem__("k", 0)))
+        bc = am.get_all_changes(base)
+        a = am.apply_changes(am.init("actor-a"), bc)
+        b = am.apply_changes(am.init("actor-b"), bc)
+        a = am.change(a, lambda d: (d["t"].insert_at(2, "A"),
+                                    d.__setitem__("k", 1)))
+        b = am.change(b, lambda d: (d["t"].insert_at(2, "B"),
+                                    d.__setitem__("k", 2)))
+        a_changes = am.get_all_changes(a)[len(bc):]
+        b_changes = am.get_all_changes(b)[len(bc):]
+        # deliver b's concurrent change into a one-by-one, and vice versa
+        for ch in b_changes:
+            a = am.apply_changes(a, [ch])
+        for ch in a_changes:
+            b = am.apply_changes(b, [ch])
+        assert am.to_json(a) == am.to_json(b)
+        assert am.to_json(a)["k"] == 2        # actor-b outranks actor-a
+        assert am.get_conflicts(a, "k") == {"actor-a": 1}
+        twin = oracle_twin(a)
+        assert am.to_json(twin) == am.to_json(a)
+
+    def test_remote_fast_path_not_undoable_at_receiver(self):
+        author = am.change(am.init("author"),
+                           lambda d: d.__setitem__("t", am.Text("hi")))
+        peer = am.merge(am.init("peer"), author)
+        doc = am.change(author, lambda d: d["t"].insert_at(2, "!"))
+        ch = am.get_all_changes(doc)[-1]
+        peer = am.apply_changes(peer, [ch])
+        assert not am.can_undo(peer)          # remote ops never undoable
