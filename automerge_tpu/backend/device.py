@@ -43,6 +43,7 @@ deterministic replay of the delivery log.
 
 from __future__ import annotations
 
+import bisect
 import logging
 from typing import Optional
 
@@ -447,7 +448,8 @@ class _DeviceCore:
 
         ``require_covered`` (the remote entry): after the cheap shape
         gates, the change must cover the whole document clock — computed
-        once here and reused by the per-shape coverage gates below."""
+        lazily at the per-shape gates below (never before the shape
+        classification: ineligible deliveries must not pay the closure)."""
         ops = change.get("ops", ())
         if not ops or len(ops) > self._FAST_MAX_OPS:
             return None
@@ -459,10 +461,6 @@ class _DeviceCore:
             # duplicates/queued deliveries keep the general machinery
             return None
         covered = None
-        if require_covered:
-            covered = self._covers_doc(change, actor, seq)
-            if not covered:
-                return None
         obj = ops[0].get("obj")
         if any(op.get("obj") != obj for op in ops):
             # multi-object rounds: eligible only when EVERY target is a
@@ -495,7 +493,7 @@ class _DeviceCore:
         if shape is None:
             return None
         kind_, payload = shape
-        if kind_ in ("del_run", "set_one"):
+        if require_covered or kind_ in ("del_run", "set_run"):
             if covered is None:
                 covered = self._covers_doc(change, actor, seq)
             if not covered:
@@ -646,11 +644,18 @@ class _DeviceCore:
                     return None
                 keys.append(op["key"])
             return ("del_run", keys)
-        if a0 == "set" and len(ops) == 1 and first.get("key") \
-                and not isinstance(first.get("value"), dict):
-            return ("set_one", (first["key"],
-                                (first.get("value"),
-                                 first.get("datatype"))))
+        if a0 == "set":
+            # one or more register re-assertions on EXISTING elements —
+            # singly from interactive .set, in runs from redo (do_undo
+            # captures the whole field set it re-applies)
+            sets = []
+            for op in ops:
+                if op.get("action") != "set" or not op.get("key") \
+                        or isinstance(op.get("value"), dict):
+                    return None
+                sets.append((op["key"], (op.get("value"),
+                                         op.get("datatype"))))
+            return ("set_run", sets)
         return None
 
     @staticmethod
@@ -708,15 +713,20 @@ class _DeviceCore:
                 positions.append(q)
                 p = q
             return (positions, keys)
-        # set_one
-        key, value = payload
-        pk = self._fast_packed(doc, key)
-        if pk is None:
-            return None
-        p = ov.pos_of(pk)
-        if p < 0 or not ov.vis[p]:
-            return None
-        return (p, key, value)
+        # set_run: every target must resolve to a KNOWN element;
+        # invisible targets are legal — a covered set on a tombstoned
+        # element re-asserts it visible (the redo-after-undo shape),
+        # emitted as an insert diff at execute time
+        resolved = []
+        for key, value in payload:
+            pk = self._fast_packed(doc, key)
+            if pk is None:
+                return None
+            p = ov.pos_of(pk)
+            if p < 0:
+                return None
+            resolved.append((p, key, value))
+        return resolved
 
     def _fast_execute(self, kind_, plan, wrapper: "_TextObj", obj: str,
                       ov: "_TextOverlay", actor: str, rank: int):
@@ -757,17 +767,31 @@ class _DeviceCore:
                               "index": index, "path": path})
                 ov.vis[p] = False
                 ov.writes[key] = _DELETED
-        else:  # set_one
-            p, key, (v, dt) = plan
-            diff = {"action": "set", "obj": obj, "type": typ,
-                    "index": int(cum[p]) - 1, "value": v, "path": path}
-            if dt:
-                diff["datatype"] = dt
-            diffs.append(diff)
-            rec = {"value": v}
-            if dt:
-                rec["datatype"] = dt
-            ov.writes[key] = rec
+        else:  # set_run
+            flipped: list = []    # positions made visible by THIS run
+            for p, key, (v, dt) in plan:
+                if ov.vis[p]:     # plain value update; bisect_right
+                    # counts a flip of p ITSELF (same elemId set twice
+                    # in one change: the first set made it visible, so
+                    # this set's index is one right of the snapshot)
+                    shift = bisect.bisect_right(flipped, p)
+                    diff = {"action": "set", "obj": obj, "type": typ,
+                            "index": int(cum[p]) - 1 + shift, "value": v,
+                            "path": path}
+                else:             # covered re-assert of a tombstoned
+                    shift = bisect.bisect_left(flipped, p)
+                    ov.vis[p] = True             # element: re-insertion
+                    diff = {"action": "insert", "obj": obj, "type": typ,
+                            "index": int(cum[p]) + shift, "elemId": key,
+                            "value": v, "path": path}
+                    bisect.insort(flipped, p)
+                if dt:
+                    diff["datatype"] = dt
+                diffs.append(diff)
+                rec = {"value": v}
+                if dt:
+                    rec["datatype"] = dt
+                ov.writes[key] = rec
         return diffs
 
     def flush_pending(self):

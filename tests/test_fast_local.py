@@ -251,7 +251,12 @@ class TestFastRemote:
         remote = am.get_all_changes(doc)[
             len(am.get_all_changes(author)):]
         for ch in remote:                     # one-by-one: the sync shape
+            before = len(_core(peer).pending)
             peer = am.apply_changes(peer, [ch])
+            # the covering delivery actually RODE the fast path (a gate
+            # regression falling back to the engine must fail here, not
+            # just silently lose the 14x)
+            assert len(_core(peer).pending) == before + 1
         assert str(am.to_json(peer)["t"]) == str(am.to_json(doc)["t"])
         twin = oracle_twin(peer)
         assert am.to_json(twin) == am.to_json(peer)
@@ -291,3 +296,61 @@ class TestFastRemote:
         ch = am.get_all_changes(doc)[-1]
         peer = am.apply_changes(peer, [ch])
         assert not am.can_undo(peer)          # remote ops never undoable
+
+
+def test_redo_rides_fast_path_and_matches_oracle():
+    """Redo re-asserts the undone field set as a run of `set` ops on
+    TOMBSTONED elements — the set_run visibility-flip shape
+    (device.py _fast_execute). Undo/redo chains on a large doc must stay
+    sub-engine-cost and bit-identical to the oracle."""
+    doc = am.change(am.init("u"),
+                    lambda d: d.__setitem__("t", am.Text("x" * 500)))
+    for i in range(6):
+        doc = am.change(doc, lambda d, i=i: d["t"]
+                        .insert_at(50 + i, *"ab"))
+    for _ in range(4):
+        doc = am.undo(doc)
+    for _ in range(4):
+        before = len(_core(doc).pending)
+        doc = am.redo(doc)
+        assert len(_core(doc).pending) == before + 1   # set_run fast path
+    ref = am.change(am.init("v"),
+                    lambda d: d.__setitem__("t", am.Text("x" * 500)))
+    for i in range(6):
+        ref = am.change(ref, lambda d, i=i: d["t"]
+                        .insert_at(50 + i, *"ab"))
+    assert str(am.to_json(doc)["t"]) == str(am.to_json(ref)["t"])
+    twin = oracle_twin(doc)
+    assert am.to_json(twin) == am.to_json(doc)
+    # undo/redo/merge interleavings converge after the flips
+    peer = am.merge(am.init("w"), doc)
+    peer = am.change(peer, lambda d: d["t"].delete_at(0, 3))
+    m1, m2 = am.merge(doc, peer), am.merge(peer, doc)
+    assert am.to_json(m1) == am.to_json(m2)
+
+
+def test_duplicate_tombstone_reassert_matches_oracle():
+    """Protocol-level: one covering remote change setting the SAME
+    tombstoned elemId twice. The first set flips it visible (insert
+    diff); the second must index one right of the visibility snapshot
+    (bisect_right over the run's flips, device.py _fast_execute)."""
+    from automerge_tpu.backend import facade as oracle_backend
+
+    author = am.change(am.init("author"),
+                       lambda d: d.__setitem__("t", am.Text("abcde")))
+    author = am.change(author, lambda d: d["t"].delete_at(2))
+    peer = am.merge(am.init("peer"), author)
+    hist = am.get_all_changes(author)
+    del_op = [op for ch in hist for op in ch["ops"]
+              if op["action"] == "del"][0]
+    crafted = {"actor": "zzz", "seq": 1,
+               "deps": dict(am.frontend.get_backend_state(author).clock),
+               "ops": [{"action": "set", "obj": del_op["obj"],
+                        "key": del_op["key"], "value": "X"},
+                       {"action": "set", "obj": del_op["obj"],
+                        "key": del_op["key"], "value": "Y"}]}
+    dev = am.apply_changes(peer, [crafted])
+    ora = am.apply_changes(
+        am.init({"actorId": "obs", "backend": oracle_backend.Backend}),
+        hist + [crafted])
+    assert str(am.to_json(dev)["t"]) == str(am.to_json(ora)["t"]) == "abYde"
