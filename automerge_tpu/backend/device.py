@@ -172,10 +172,10 @@ class _TextObj:
                  "prev_value", "prev_conf", "announced", "ov",
                  "_pool_scan")
 
-    def __init__(self, obj_id: str, kind: str):
+    def __init__(self, obj_id: str, kind: str, capacity_hint: int = 64):
         from ..engine.text_doc import DeviceTextDoc
         self.kind = kind                     # "text" | "list"
-        self.doc = DeviceTextDoc(obj_id, capacity=64)
+        self.doc = DeviceTextDoc(obj_id, capacity=capacity_hint)
         self.max_elem = 0
         self.prev_n = 0                      # n_elems at last snapshot
         self.prev_vis = np.zeros(1, bool)    # slot-aligned visibility
@@ -237,10 +237,10 @@ class _MapObj:
 
     __slots__ = ("kind", "doc", "max_elem", "prev", "announced", "ov")
 
-    def __init__(self, obj_id: str, kind: str):
+    def __init__(self, obj_id: str, kind: str, capacity_hint: int = 16):
         from ..engine.map_doc import DeviceMapDoc
         self.kind = kind                     # "map" | "table"
-        self.doc = DeviceMapDoc(obj_id, capacity=16)
+        self.doc = DeviceMapDoc(obj_id, capacity=capacity_hint)
         self.max_elem = 0                    # uniform wrapper interface
         self.prev: dict = {}                 # key -> (raw value, conflict sig)
         self.announced = False
@@ -926,6 +926,27 @@ class _DeviceCore:
         if not applied:
             return set(), []
         routed: list = []            # (change, by_obj, root_ops) per change
+        op_totals = None             # per-obj op counts, for creation sizing
+
+        def totals() -> dict:
+            nonlocal op_totals
+            if op_totals is None:
+                op_totals = {}
+                for c2 in applied:
+                    for o2 in c2["ops"]:
+                        # link counts too: nested-object keys and table
+                        # rows are assigned via link, not set
+                        if o2.get("action") in ("ins", "set", "link"):
+                            t = o2["obj"]
+                            op_totals[t] = op_totals.get(t, 0) + 1
+            return op_totals
+
+        if len(applied) >= 4:
+            # bulk delivery (load replays whole histories): pre-size the
+            # ROOT map too — it exists from core init and never gets a
+            # creation hint, but a root-key-heavy load would otherwise
+            # grow it through every bucket, one XLA compile per shape
+            self.root.doc.reserve(totals().get(ROOT_ID, 0) + 16)
         created_at: dict = {}        # obj -> index of its creating change
         # (insertion-ordered: doubles as the created-object list)
         touched: set = set()
@@ -936,11 +957,22 @@ class _DeviceCore:
                 action = op["action"]
                 obj = op["obj"]
                 if action in _MAKE_KIND:
+                    # creation sizing: a bulk delivery (load replays the
+                    # whole history) otherwise grows each new doc through
+                    # every capacity bucket, paying a fresh jit compile
+                    # per bucket shape — the dominant cost of am.load
+                    # (measured: 12 s for a 10k-char doc, ~all in XLA
+                    # compiles). One O(ops) pass over the delivery
+                    # pre-sizes every object it creates to its final
+                    # bucket.
                     kind = _MAKE_KIND[action]
+                    hint = totals().get(obj, 0)
                     if kind in ("text", "list"):
-                        wrapper = _TextObj(obj, kind)
+                        wrapper = _TextObj(obj, kind,
+                                           capacity_hint=hint + 64)
                     else:
-                        wrapper = _MapObj(obj, kind)
+                        wrapper = _MapObj(obj, kind,
+                                          capacity_hint=hint + 16)
                     wrapper.doc.clock = dict(
                         creations.get((ch["actor"], ch["seq"]), self.clock))
                     wrapper.doc.clock.pop(ch["actor"], None)

@@ -43,6 +43,16 @@ class DeviceMapDoc(CausalDeviceDoc):
     # device state
     # ------------------------------------------------------------------
 
+    def reserve(self, n: int):
+        """Raise the capacity floor so upcoming applies jump straight to
+        bucket(n) instead of growing through every intermediate bucket —
+        each bucket is a distinct static shape, i.e. a fresh XLA compile
+        (the am.load pathology; backend/device.py _distribute). Safe with
+        live tables: the ingest kernel extends operands to out_cap
+        (ops/ingest.py _ext)."""
+        from ..ops.ingest import bucket
+        self._cap = max(self._cap, bucket(max(n, 16)))
+
     def _ensure_dev(self) -> dict:
         if self._dev is None:
             import jax.numpy as jnp

@@ -741,6 +741,41 @@ def config9_sync_fanout(n_peers: int = 20, n_changes: int = 50):
          threshold=TRACKING_ONLY)
 
 
+def config10_save_load(n_changes: int = 40, run_chars: int = 250):
+    """Persistence round-trip (reference: src/automerge.js save/load —
+    serialize the change history, rebuild by replay). Load used to grow
+    each device doc through every capacity bucket, paying a fresh XLA
+    compile per bucket shape (~12 s for this doc, round 5); creation
+    sizing from the delivery's op totals (backend/device.py _distribute)
+    pins the shapes, leaving one-time per-shape compiles (warm process:
+    ~0.2 s). Reported warm: best of 2 loads after a throwaway first."""
+    import time as _time
+
+    import automerge_tpu as am
+    from automerge_tpu import Text
+
+    doc = am.change(am.init("u"), lambda d: d.__setitem__("t", Text("x")))
+    for _ in range(n_changes):
+        doc = am.change(doc, lambda d: d["t"]
+                        .insert_at(0, *("ab" * (run_chars // 2))))
+    n_chars = 1 + n_changes * run_chars
+    t0 = _time.perf_counter()
+    blob = am.save(doc)
+    save_s = _time.perf_counter() - t0
+    holder = {}
+
+    def one_load():
+        holder["back"] = am.load(blob)
+
+    load_s = timed(one_load, warmups=1, reps=2)   # shared discipline
+    assert str(am.to_json(holder["back"])["t"]) == str(am.to_json(doc)["t"])
+    emit(f"cfg10_save_load_{n_chars // 1000}k_chars_{n_changes}_changes",
+         n_chars / load_s, "chars_loaded/s",
+         save_ms=round(save_s * 1e3, 1), load_ms=round(load_s * 1e3, 1),
+         blob_kb=len(blob) // 1024,
+         threshold=TRACKING_ONLY)
+
+
 def main():
     from benchmarks.common import preflight_device
     # allow_cpu: off-chip smoke runs are legitimate here — every emitted
@@ -768,6 +803,7 @@ def main():
     config8_frontend_splice(n_big=200_000 if quick else 1_000_000)
     config9_sync_fanout(n_peers=8 if quick else 20,
                         n_changes=20 if quick else 50)
+    config10_save_load(n_changes=15 if quick else 40)
     if record_round is not None:
         # cfg5 = the headline bench, folded into the record file
         import json as _json

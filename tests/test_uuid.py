@@ -1,0 +1,44 @@
+"""UUID factory — parity with the reference's swappable-factory hook
+(/root/reference/src/uuid.js:1-12, test analogue uuid_test.js): the
+determinism seam every fuzz/trace suite relies on."""
+
+import re
+
+import automerge_tpu as am
+from automerge_tpu import _uuid
+
+
+def test_default_factory_is_uuid4():
+    v = am.uuid()
+    assert re.fullmatch(
+        r"[0-9a-f]{8}-[0-9a-f]{4}-4[0-9a-f]{3}-[89ab][0-9a-f]{3}"
+        r"-[0-9a-f]{12}", v), v
+    assert am.uuid() != v                     # fresh value per call
+
+
+def test_factory_is_swappable_and_resettable():
+    counter = {"n": 0}
+
+    def fixed():
+        counter["n"] += 1
+        return f"fixed-{counter['n']}"
+
+    _uuid.set_factory(fixed)
+    try:
+        assert am.uuid() == "fixed-1"
+        assert am.uuid() == "fixed-2"
+    finally:
+        _uuid.reset()
+    assert re.fullmatch(r"[0-9a-f-]{36}", am.uuid())
+
+
+def test_minted_object_ids_use_the_factory():
+    ids = iter(f"det-{i}" for i in range(100))
+    _uuid.set_factory(lambda: next(ids))
+    try:
+        doc = am.change(am.init("actor"),
+                        lambda d: d.__setitem__("m", {"k": 1}))
+        obj_id = am.get_object_id(doc["m"])
+        assert obj_id.startswith("det-"), obj_id
+    finally:
+        _uuid.reset()
