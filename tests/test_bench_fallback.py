@@ -17,23 +17,32 @@ import tempfile
 
 import pytest
 
+from automerge_tpu._env import virtual_cpu_env
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 
 
 def _run_bench(env_extra):
-    env = dict(os.environ)
+    # make the probe fail REGARDLESS of tunnel health by landing the
+    # subprocess on the scrubbed virtual-CPU platform via the ONE shared
+    # scrub recipe (virtual_cpu_env: pops the axon plugin trigger AND pins
+    # JAX_PLATFORMS=cpu — both are needed because a registered plugin can
+    # initialize regardless of JAX_PLATFORMS, and the on-TPU test mode
+    # skips conftest's own scrub). The strict probe rejects cpu exactly as
+    # it rejects a dead tunnel. (An earlier version instead pointed the
+    # plugin at an unroutable TEST-NET address, which stopped forcing
+    # failure — and failed this test — precisely when the tunnel came UP.
+    # Trade-off: the unroutable address also exercised preflight_device's
+    # probe-hang/TimeoutExpired branch; the cpu probe exits fast, so that
+    # branch is no longer covered here. AMTPU_PREFLIGHT_PROBE_S stays as a
+    # belt-and-braces cap should the probe ever wedge.)
+    env = virtual_cpu_env(1)
     # a lingering probe-skip knob (chip_session.sh exports it) would
     # bypass the very preflight these tests exercise
     env.pop("AMTPU_SKIP_PREFLIGHT", None)
-    # make the probe fail REGARDLESS of tunnel health: pin the platform to
-    # axon (no CPU fallback can satisfy the probe) and point the plugin at
-    # a TEST-NET address that is never routable — NOT 127.0.0.1, which is
-    # this environment's real loopback relay
     env.update({"AMTPU_PREFLIGHT_BUDGET_S": "1",
                 "AMTPU_PREFLIGHT_PROBE_S": "15",
-                "JAX_PLATFORMS": "axon",
-                "PALLAS_AXON_POOL_IPS": "203.0.113.1",
                 **env_extra})
     return subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                           capture_output=True, text=True, env=env,
@@ -64,8 +73,11 @@ def test_no_device_no_record_exits_3(stash_last_good):
 
 
 def test_no_device_serves_stale_last_good(stash_last_good):
-    # "axon" is the platform string the chip ACTUALLY stamps (BASELINE.md,
-    # every observed chip log) — the fallback must serve it unchanged
+    # both "axon" (rounds 1-4 logs) and "tpu" (round-5 chip session) have
+    # been observed as the chip's platform stamp — the rule everywhere is
+    # `platform != "cpu"` (benchmarks.common.is_chip_platform), and the
+    # fallback must serve a non-cpu record unchanged whichever string it
+    # carries
     rec = {"metric": "ops_per_sec_merged_text_10k_actors_1M_doc",
            "value": 123, "unit": "ops/s", "vs_baseline": 0.001,
            "platform": "axon", "recorded_at_utc": "2026-07-30T00:00:00Z"}
@@ -77,6 +89,54 @@ def test_no_device_serves_stale_last_good(stash_last_good):
     assert line["value"] == 123
     assert line["stale"] is True
     assert "last locally recorded on-chip run" in line["stale_reason"]
+
+
+def test_preflight_hang_path(monkeypatch):
+    """The probe-hang branch (a wedged tunnel makes the probe subprocess
+    exceed its timeout) must honor the per-probe timeout override, retry
+    within the budget, and come back False — this is the flow that keeps
+    a dead tunnel from eating the driver's whole time budget (BENCH_r03
+    was lost to exactly that). Covered in-process with a stubbed
+    subprocess.run because no env trick can make the real probe hang
+    deterministically (the old unroutable-address trick stopped hanging
+    once the chip became reachable)."""
+    import subprocess as sp
+
+    from benchmarks import common
+
+    monkeypatch.delenv("AMTPU_SKIP_PREFLIGHT", raising=False)
+    monkeypatch.setenv("AMTPU_PREFLIGHT_PROBE_S", "5")
+    seen_timeouts = []
+
+    def hang(cmd, capture_output, text, timeout):
+        seen_timeouts.append(timeout)
+        raise sp.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(common.subprocess, "run", hang)
+    monkeypatch.setattr(common.time, "sleep", lambda s: None)
+    # budget small enough that the first failed probe exhausts it
+    assert common.preflight_device(total_budget_s=0.5) is False
+    assert seen_timeouts == [5.0]   # env override reached subprocess.run
+
+    # malformed override: default per-probe timeout survives, no crash
+    monkeypatch.setenv("AMTPU_PREFLIGHT_PROBE_S", "not-a-number")
+    seen_timeouts.clear()
+    assert common.preflight_device(timeout_s=90) is False
+    assert seen_timeouts == [90.0]
+
+    # a probe that succeeds after one hang: the retry loop must recover
+    monkeypatch.setenv("AMTPU_PREFLIGHT_PROBE_S", "5")
+    calls = {"n": 0}
+
+    def hang_then_up(cmd, capture_output, text, timeout):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise sp.TimeoutExpired(cmd, timeout)
+        return sp.CompletedProcess(cmd, 0, stdout="CHIP UP", stderr="")
+
+    monkeypatch.setattr(common.subprocess, "run", hang_then_up)
+    assert common.preflight_device(total_budget_s=60.0) is True
+    assert calls["n"] == 2
 
 
 def test_chip_platform_gate_accepts_axon():
