@@ -410,7 +410,13 @@ class DeviceTextDocSet:
         bits. A divergent or missing mirror is REBUILT from the real chain
         bits (the affected call serves through the self-contained kernel;
         the next call is planned again) and only drops to None if the
-        rebuild itself fails."""
+        rebuild itself fails.
+
+        Deliberately NOT gated on text_doc.prefer_planned (the single-doc
+        planned/self-contained switch): under vmap every lane must run one
+        uniform program, and the plan's sort-free structure is what keeps
+        the stacked program uniform across docs of different shapes — the
+        choice here is vmappability, not single-doc kernel speed."""
         import jax
         from ..ops.ingest import (bucket, materialize_codes,
                                   materialize_codes_planned)

@@ -34,6 +34,7 @@ wire to the device, instead of 2k op rows.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -99,6 +100,23 @@ class DeviceTextDoc(CausalDeviceDoc):
     # halves launch/flush overhead for merge->read cycles (the headline
     # bench's shape); costs a wasted materialization when many rounds land
     # between reads, hence opt-in per instance
+
+    # Kernel choice for materialization: the host-PLANNED variant feeds the
+    # device a packed segplan so it skips the structural S-stage. Planned
+    # is the default: it wins ~6% on cpu and produced the round's best
+    # verified on-chip headline (115.5M ops/s). The on-chip A/B was run
+    # TWICE in one night and split — self-contained won the 03:24 run by
+    # 13%, planned won the 03:38 run by 43% (scripts/chip_session.log;
+    # headline-region readings on unchanged code spanned 65-115M ops/s
+    # across that window) — so at WAN-tunnel variance the single-chip
+    # question is OPEN, not settled; docs/MEASUREMENTS.md records both
+    # runs. AMTPU_PLANNED=0 (or the attribute) selects the self-contained
+    # kernels; re-run `profile_bench.py --planned` on a quiet link to
+    # settle it. The mirror is maintained either way (it tightens
+    # _seg_bound and feeds the elem-sharded path, where the plan's
+    # sort-free program is structurally required —
+    # parallel/sharded_planned_materialize).
+    prefer_planned = os.environ.get("AMTPU_PLANNED", "1") == "1"
 
     _TABLE_KEYS = ("parent", "ctr", "actor", "value", "has_value",
                    "win_actor", "win_seq", "win_counter", "chain")
@@ -420,7 +438,8 @@ class DeviceTextDoc(CausalDeviceDoc):
 
         seg_plan_dev = None
         seg_S = 0
-        if (mirror_after is not None and dense and n_res == 0
+        if (self.prefer_planned and mirror_after is not None and dense
+                and n_res == 0
                 and self.eager_materialize and self.use_condensed):
             # same graceful degradation as apply_round above: a corrupted
             # mirror must not abort the whole prepare — the round can still
@@ -604,7 +623,7 @@ class DeviceTextDoc(CausalDeviceDoc):
             n = self._n_elems_dev[1]
         else:
             n = np.int32(self.n_elems)
-        if (self.seg_mirror is not None
+        if (self.prefer_planned and self.seg_mirror is not None
                 and self.seg_mirror.n_segs + 2 <= S):
             # host-planned structure: device skips the structural S-stage
             # (verified against the chain bits at the _scalars sync)

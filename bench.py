@@ -173,6 +173,63 @@ LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 from benchmarks.common import is_chip_platform  # noqa: E402
 
 
+def maybe_refresh_last_good(rec, path=None):
+    """Self-maintaining fallback: a successful ON-CHIP run refreshes the
+    last-good record (committed to the repo by the chip session) so a
+    future tunnel outage degrades to a stale-marked number instead of a
+    failed round. BEST-of-verified-runs semantics: tunnel weather varies
+    run to run (observed 78-115M ops/s across one night's windows on an
+    unchanged engine), and the fallback's job is to report the chip's
+    demonstrated capability, not the weather of the latest window — an
+    unconditional overwrite let a congested re-run silently downgrade
+    the record (round-5 code review). A prior record that is unreadable,
+    for a different metric, or not from a chip platform is replaced.
+
+    Best-of is a claim about the ENGINE AS COMMITTED, so the record
+    carries git_sha provenance: if the engine later regresses, the kept
+    record's sha shows which code earned the number (and the driver's
+    per-round BENCH_r{N}.json — always the live run, never this
+    fallback — is where a regression shows up as a worse fresh
+    measurement)."""
+    path = path or LAST_GOOD_PATH
+    if not is_chip_platform(rec["platform"]):
+        return False
+    rec = dict(rec)
+    rec.setdefault("git_sha", _git_sha())
+    prior_value = -1.0
+    if os.path.exists(path):
+        try:
+            with open(path) as fh:
+                prior = json.load(fh)
+            if (prior.get("metric") == rec["metric"]
+                    and is_chip_platform(prior.get("platform", ""))):
+                prior_value = float(prior.get("value", -1.0))
+        except (ValueError, TypeError, OSError):
+            pass            # unreadable record: replace it
+    if rec["value"] < prior_value:
+        return False
+    # atomic: this file IS the tunnel-outage fallback; a session timeout
+    # killing a mid-rewrite must not destroy it (same pattern as
+    # benchmarks.common.write_record)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(rec, fh, indent=1)
+    os.replace(tmp, path)
+    return True
+
+
+def _git_sha() -> str:
+    import subprocess
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
 def main():
     from benchmarks.common import preflight_device
     # The tunnel to the chip flaps (BENCH_r03 was lost to a single failed
@@ -237,13 +294,7 @@ def main():
         "recorded_at_utc": datetime.now(timezone.utc).isoformat(),
     }
     print(json.dumps(rec))
-    # Self-maintaining fallback: every successful ON-CHIP run refreshes the
-    # last-good record (committed to the repo by the chip session) so a
-    # future tunnel outage degrades to a stale-marked number instead of a
-    # failed round.
-    if is_chip_platform(rec["platform"]):
-        with open(LAST_GOOD_PATH, "w") as fh:
-            json.dump(rec, fh, indent=1)
+    maybe_refresh_last_good(rec)
 
 
 if __name__ == "__main__":

@@ -113,6 +113,47 @@ TRACKING_ONLY = ("tracking-only: platform-dependent absolute rate with no "
                  "same-platform rows across round records")
 
 
+_DEVICE_RTT_MS: list = []   # measured once per process
+
+
+def device_rtt_ms() -> float:
+    """Measured host<->device round-trip latency (min of 3 tiny put+fetch
+    syncs), cached per process. ~0.05-1 ms for cpu or a locally attached
+    chip; ~70+ ms when the chip is reached through this environment's WAN
+    tunnel."""
+    if not _DEVICE_RTT_MS:
+        import jax
+        import numpy as _np
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            int(jax.device_put(_np.int32(1)))          # h2d + d2h sync
+            reps.append(time.perf_counter() - t0)
+        _DEVICE_RTT_MS.append(min(reps) * 1e3)
+    return _DEVICE_RTT_MS[0]
+
+
+def perf_asserts_enforced(threshold_ms: float = 10.0) -> bool:
+    """Whether the configs' latency/ratio bounds are ASSERTED (vs recorded
+    tracking-only). The bounds are calibrated for a device whose round
+    trip is negligible — cpu, or a PCIe-attached chip — and are distorted
+    only when every in-region sync pays a WAN round trip. That is a
+    property of the LINK, not the platform name, so it is measured
+    (device_rtt_ms), not inferred: a future locally attached chip keeps
+    every bound enforced; gating on the platform string would have
+    silently exempted the real deployment target forever."""
+    return device_rtt_ms() < threshold_ms
+
+
+def tracking_only_wan(bound: str) -> str:
+    """Threshold text for a row whose bound is suspended on a WAN-attached
+    device (keep `bound` to one clause; it is what a reader re-asserts)."""
+    return (f"tracking-only on this platform: device reached through a WAN "
+            f"tunnel (measured RTT {device_rtt_ms():.0f} ms; in-region "
+            f"syncs pay it, ~1 ms on PCIe). Bound asserted where RTT is "
+            f"local: {bound}")
+
+
 def emit(metric: str, value: float, unit: str,
          vs_baseline: float | None = None, **extra):
     # vs_baseline None -> json null: an honest "no defined target" instead
@@ -131,12 +172,17 @@ def emit(metric: str, value: float, unit: str,
 def write_record(path: str):
     """One JSON line per emitted config result (BENCH_CONFIGS_r<NN>.json).
 
-    MERGE semantics per platform: rows from an existing record whose
-    platform differs from this run's are preserved (the chip session's
-    axon sweep must not destroy the committed cpu rows the tracking-only
-    regression methodology diffs against, and vice versa); same-platform
-    rows are replaced by this run's."""
-    current = {rec["platform"] for rec in RESULTS}
+    MERGE semantics per (metric, platform): an existing row is replaced
+    only when THIS run re-emitted the same metric on the same platform.
+    Cross-platform rows are always preserved (the chip session's sweep
+    must not destroy the committed cpu rows the tracking-only regression
+    methodology diffs against, and vice versa). Same-platform rows this
+    run has NOT (yet) re-emitted are preserved too: the sweep calls this
+    incrementally after every config, and a re-sweep that drops mid-run
+    must not have already destroyed rows an earlier window captured
+    (replace-whole-platform-on-first-write would leave FEWER rows than
+    before the re-sweep started)."""
+    current = {(rec["metric"], rec["platform"]) for rec in RESULTS}
     kept = []
     if os.path.exists(path):
         with open(path) as fh:
@@ -144,9 +190,21 @@ def write_record(path: str):
                 line = line.strip()
                 if not line:
                     continue
-                rec = json.loads(line)
-                if rec.get("platform") not in current:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    # a kill mid-rewrite (flappy-window timeout) may have
+                    # truncated the final line of a previous record; a
+                    # corrupt row must not wedge every future sweep
+                    print(f"write_record: dropping unparsable line in "
+                          f"{path}: {line[:80]!r}", file=sys.stderr)
+                    continue
+                if (rec.get("metric"), rec.get("platform")) not in current:
                     kept.append(rec)
-    with open(path, "w") as fh:
+    # atomic replace: incremental calls race with session timeouts by
+    # design; a half-written record must never be observable
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
         for rec in kept + RESULTS:
             fh.write(json.dumps(rec) + "\n")
+    os.replace(tmp, path)

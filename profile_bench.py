@@ -161,6 +161,12 @@ def planned_ab(batch):
             doc.eager_materialize = True
             if no_mirror:
                 doc.seg_mirror = None
+                doc.prefer_planned = False
+            else:
+                # both arms pinned explicitly so the A/B compares the real
+                # alternatives regardless of the production default (which
+                # this harness's results decide — text_doc.prefer_planned)
+                doc.prefer_planned = True
             doc.apply_batch(base_batch("bench-text", BASE_LEN))
             doc.text()
             prepared = doc.prepare_batch(batch)

@@ -1,7 +1,21 @@
 #!/bin/bash
-# One-shot TPU chip session: runs every measurement this round still needs,
-# in priority order, appending to scripts/chip_session.log. Safe to re-run;
-# each step has its own timeout so a wedged tunnel can't eat the session.
+# One-shot TPU chip session (v2): runs every measurement this round still
+# needs, in priority order, appending to scripts/chip_session.log. Safe to
+# re-run; each step has its own timeout so a wedged tunnel can't eat the
+# session.
+#
+# v2 restructures for FLAPPY windows (round 5's first window closed 16 min
+# in and the v1 full-pytest smoke gate burned all of it — docs/PROFILE_r5.md):
+#   - the smoke is scripts/chip_smoke.py: the same device-vs-oracle parity
+#     bar, delivered as bulk apply_changes rounds (dozens of dispatches, not
+#     tens of thousands through a 70 ms-RTT tunnel)
+#   - a smoke TIMEOUT is retryable tunnel weather (probe_forever relaunches);
+#     only a deterministic parity failure writes the stop-probing marker
+#   - measurements run highest-value first (headline bench, planned A/B)
+#     and are NON-gating: a failed step logs its rc and the session moves on
+#   - the config sweep writes its record incrementally (benchmarks/run_all
+#     --record), so a mid-sweep drop keeps completed rows
+#   - the full pytest suite is a best-effort TAIL step, never a gate
 set -u
 cd "$(dirname "$0")/.."
 LOG=scripts/chip_session.log
@@ -17,7 +31,9 @@ run() {
   local name="$1"; shift
   echo "--- $name ($(date -u +%T)) ---" >> "$LOG"
   timeout "$1" "${@:2}" >> "$LOG" 2>&1
-  echo "--- $name rc=$? ---" >> "$LOG"
+  local rc=$?
+  echo "--- $name rc=$rc ---" >> "$LOG"
+  return $rc
 }
 
 # shared strict probe: proves a NON-CPU device actually computes — a
@@ -32,38 +48,60 @@ if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   PROBE_ARGS="--allow-cpu"
   echo "DRY RUN (cpu-allowed probe): pipeline validation, not chip data" >> "$LOG"
 fi
-run "probe"            120 python scripts/probe_device.py $PROBE_ARGS
-grep -q "rc=0" <(tail -1 "$LOG") || { echo "tunnel down, aborting" >> "$LOG"; exit 3; }
+run "probe" 120 python scripts/probe_device.py $PROBE_ARGS \
+  || { echo "tunnel down, aborting" >> "$LOG"; exit 3; }
 export AMTPU_SKIP_PREFLIGHT=1   # this session IS the parent probe
 
-# ONE smoke definition for both modes (divergence here is exactly what
-# the dry run exists to prevent); the only difference is the on-TPU test
-# pin, meaningless without a chip
-SMOKE_TESTS="tests/test_segments.py tests/test_engine_parity.py tests/test_fast_local.py"
-SMOKE_ENV=(env AUTOMERGE_TPU_TESTS_ON_TPU=1)
-SMOKE_FAIL="on-chip smoke FAILED"
-if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
-  SMOKE_ENV=(env)
-  # distinct marker: probe_forever stops permanently at the real
-  # "on-chip smoke FAILED" marker; a cpu dry-run flake must not kill
-  # the round's probing
-  SMOKE_FAIL="DRYRUN smoke failed (cpu)"
+# ONE smoke definition for both modes (divergence here is exactly what the
+# dry run exists to prevent): chip_smoke.py runs on whatever platform jax
+# selected — chip in a session, cpu in a dry run.
+run "smoke_batched" 600 python scripts/chip_smoke.py
+SMOKE_RC=$?
+if [ "$SMOKE_RC" = "124" ] || [ "$SMOKE_RC" = "7" ]; then
+  # marker text matters: probe_forever stops permanently at
+  # "on-chip smoke FAILED"; a timeout (124) or an infrastructure
+  # exception inside the smoke (7 — tunnel RPC drop mid-dispatch) is
+  # weather, not a parity verdict, and must NOT match it
+  echo "on-chip smoke TIMEOUT/INFRA rc=$SMOKE_RC (retryable tunnel weather), aborting" >> "$LOG"
+  exit 6
+elif [ "$SMOKE_RC" != "0" ]; then
+  if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
+    # distinct marker: a cpu dry-run flake must not kill the round's probing
+    echo "DRYRUN smoke failed (cpu), not recording benchmarks" >> "$LOG"
+  else
+    echo "on-chip smoke FAILED, not recording benchmarks" >> "$LOG"
+  fi
+  exit 4
 fi
-run "tpu_smoke"        900 "${SMOKE_ENV[@]}" python -m pytest $SMOKE_TESTS -q
-grep -q "rc=0" <(tail -1 "$LOG") || { echo "$SMOKE_FAIL, not recording benchmarks" >> "$LOG"; exit 4; }
-run "bench"            900 python bench.py
-run "planned_ab"       900 python profile_bench.py --planned
-run "trace"            600 python profile_bench.py --trace
-run "pallas_ab"        900 python profile_bench.py --pallas
+
+# Measurements, highest value first, non-gating. configs_record folds the
+# bench.py headline in as its FIRST row and rewrites the record after every
+# config, so each completed step survives a drop.
+run "bench"      900 python bench.py
+run "planned_ab" 900 python profile_bench.py --planned
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu
   # record rows; --quick still validates the run_all invocation
-  run "configs_quick"  1800 python -m benchmarks.run_all --quick
+  run "configs_quick" 1800 python -m benchmarks.run_all --quick
+else
+  run "configs_record" 3600 python -m benchmarks.run_all --record "${AMTPU_ROUND:-5}"
+fi
+run "pallas_ab" 900 python profile_bench.py --pallas
+run "trace"     600 python profile_bench.py --trace
+
+# best-effort tail: full suite on the chip is dispatch-bound through the
+# tunnel (~2 min/test) — worth having if the window holds, never a gate
+if [ "${AMTPU_SESSION_DRYRUN:-0}" != "1" ]; then
+  run "pytest_tail" 1200 env AUTOMERGE_TPU_TESTS_ON_TPU=1 \
+    python -m pytest tests/test_segments.py tests/test_engine_parity.py \
+                     tests/test_fast_local.py -q
+fi
+
+if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # a DIFFERENT marker on purpose: probe_forever stops at the real
   # "chip session done" marker, and a dry run must not stop the probing
   echo "=== chip session DRYRUN-complete $(date -u +%T) ===" >> "$LOG"
 else
-  run "configs_record" 3600 python -m benchmarks.run_all --record "${AMTPU_ROUND:-5}"
   echo "=== chip session done $(date -u +%T) ===" >> "$LOG"
 fi

@@ -1,0 +1,156 @@
+"""RTT-shaped on-chip parity smoke for the chip measurement session.
+
+Round 5's first tunnel window (docs/PROFILE_r5.md) was burned by running a
+51-test pytest selection through a ~70 ms-RTT tunnel: those tests are
+dispatch-bound (thousands of tiny device round trips; ~2 min/test), so the
+smoke gate timed out at 900 s with ZERO failures and the session aborted.
+This script is the replacement: the same device-vs-oracle parity bar as
+tests/test_engine_parity.py, but shaped for the tunnel — each scenario
+delivers its whole concurrent history in ONE (or two, for the causal
+queueing case) bulk ``apply_changes`` round, so the total device dispatch
+count is dozens, not tens of thousands. Comparisons (values, elem ids,
+conflicts) read the materialized mirrors host-side after a single sync.
+
+Scenarios (all compared element-for-element against the oracle backend):
+  merge_fanout      30 actors concurrently splice runs + deletes into a
+                    shared base -> one bulk delivery (~1k ops): RGA sibling
+                    ordering, run expansion, tombstones.
+  conflict_registers  20 actors concurrently ``set`` the same positions ->
+                    LWW winner + full conflict sets.
+  causal_rounds     round 2 depends on round 1 but is delivered FIRST ->
+                    causal queue holds it, round 1 releases it.
+
+Exit codes tell the session how to react:
+  0   every scenario matches
+  1   deterministic parity MISMATCH (probe_forever must stop relaunching —
+      an identical doomed session would hold the chip forever)
+  7   infrastructure error (RPC/connection exception from a dropping
+      tunnel, OOM, ...) — retryable weather, like the wrapper's rc=124
+      timeout; conflating this with rc=1 was v1's window-killing bug
+
+Run on whatever platform jax selects: the chip in a session, cpu under
+``AMTPU_SESSION_DRYRUN`` (rows are never recorded here, so platform only
+affects what the smoke proves — on cpu it validates the harness, on the
+chip it validates the XLA-on-TPU lowering of the same kernels the
+benchmarks time).
+"""
+
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import setup_jax_cache  # noqa: E402
+
+setup_jax_cache()
+
+import automerge_tpu as am  # noqa: E402
+from automerge_tpu import Text  # noqa: E402
+from automerge_tpu.engine import DeviceTextDoc  # noqa: E402
+
+# the ONE op-extraction helper the parity suite uses — a drifted copy here
+# would silently diverge the smoke's parity bar from the test suite's
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+from test_engine_parity import text_changes_of  # noqa: E402
+
+
+def oracle_view(doc, key="t"):
+    text = doc[key]
+    values = [e["value"] for e in text.elems]
+    elem_ids = [e["elemId"] for e in text.elems]
+    conflicts = [{c["actor"]: c["value"] for c in (e.get("conflicts") or [])}
+                 for e in text.elems]
+    return values, elem_ids, conflicts
+
+
+def check(name, doc, eng):
+    o_vals, o_ids, o_confs = oracle_view(doc)
+    e_vals, e_ids = eng.values(), eng.elem_ids()
+    e_confs = [eng.conflicts_at(i) or {} for i in range(len(e_vals))]
+    for what, got, want in (("values", e_vals, o_vals),
+                            ("elem_ids", e_ids, o_ids),
+                            ("conflicts", e_confs, o_confs)):
+        if got != want:
+            k = next(i for i, (g, w) in enumerate(zip(got, want)) if g != w) \
+                if len(got) == len(want) else -1
+            print(f"SMOKE FAIL {name}/{what}: len {len(got)} vs {len(want)}, "
+                  f"first mismatch at {k}: "
+                  f"{got[k] if k >= 0 else ''!r} != "
+                  f"{want[k] if k >= 0 else ''!r}")
+            return False
+    print(f"smoke ok: {name} ({len(e_vals)} elems)")
+    return True
+
+
+def merge_fanout():
+    rng = random.Random(7)
+    base = am.change(am.init("base"),
+                     lambda d: d.__setitem__("t", Text("x" * 200)))
+    merged = base
+    for a in range(30):
+        peer = am.merge(am.init(f"actor-{a:02d}"), base)
+        ins_at = rng.randrange(0, 150)
+        run = f"[{a:02d}:" + "ab" * 13 + "]"
+        del_at = rng.randrange(0, 100)
+
+        def edit(d, ins_at=ins_at, run=run, del_at=del_at):
+            d["t"].insert_at(ins_at, *run)
+            d["t"].delete_at(del_at, 3)
+        peer = am.change(peer, edit)
+        merged = am.merge(merged, peer)
+    changes, obj_id = text_changes_of(merged)
+    eng = DeviceTextDoc(obj_id)
+    eng.apply_changes(changes)            # ONE bulk delivery, ~1k ops
+    return check("merge_fanout", merged, eng)
+
+
+def conflict_registers():
+    base = am.change(am.init("base"),
+                     lambda d: d.__setitem__("t", Text("y" * 60)))
+    merged = base
+    for a in range(20):
+        peer = am.merge(am.init(f"w{a:02d}"), base)
+        peer = am.change(peer, lambda d, a=a: [
+            d["t"].set(i, chr(ord("A") + (a + i) % 26)) for i in range(10)])
+        merged = am.merge(merged, peer)
+    changes, obj_id = text_changes_of(merged)
+    eng = DeviceTextDoc(obj_id)
+    eng.apply_changes(changes)
+    return check("conflict_registers", merged, eng)
+
+
+def causal_rounds():
+    doc = am.change(am.init("r1"),
+                    lambda d: d.__setitem__("t", Text("hello world")))
+    doc = am.change(doc, lambda d: d["t"].insert_at(5, *", dear"))
+    doc = am.change(doc, lambda d: d["t"].delete_at(0, 2))
+    changes, obj_id = text_changes_of(doc)
+    eng = DeviceTextDoc(obj_id)
+    eng.apply_changes(changes[2:])        # depends on round 1 -> queued
+    eng.apply_changes(changes[:2])        # releases the queue
+    return check("causal_rounds", doc, eng)
+
+
+def main() -> int:
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+        print(f"chip_smoke on platform {platform!r}")
+        ok = all([merge_fanout(), conflict_registers(), causal_rounds()])
+    except Exception:
+        # a scenario CRASHING (tunnel RPC error mid-dispatch, OOM) is not
+        # a parity verdict — report retryable, never the stop-probing rc
+        import traceback
+        traceback.print_exc()
+        print("chip_smoke INFRA ERROR (retryable)")
+        return 7
+    if not ok:
+        return 1
+    print("chip_smoke PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,14 @@ from test_engine_parity import text_changes_of
 from test_prepare_commit import typing_change
 
 
+@pytest.fixture(autouse=True)
+def _planned_kernels_enabled(monkeypatch):
+    # production defaults to the self-contained kernels (the chip A/B win,
+    # text_doc.prefer_planned); this module TESTS the planned path, so it
+    # runs with the planned kernels engaged
+    monkeypatch.setattr(DeviceTextDoc, "prefer_planned", True)
+
+
 def mirror_vs_device(doc: DeviceTextDoc):
     """Assert the host mirror equals the device chain-bit structure."""
     assert doc.seg_mirror is not None, "mirror degraded unexpectedly"
