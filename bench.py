@@ -293,6 +293,25 @@ def main():
         "platform": _jax.devices()[0].platform,
         "recorded_at_utc": datetime.now(timezone.utc).isoformat(),
     }
+    # A live on-chip run inherits the tunnel weather of its minute
+    # (observed 65-115M ops/s across one night on unchanged code). The
+    # headline VALUE stays this run's honest measurement; when a better
+    # verified run exists, it rides along as explicit best_verified_*
+    # provenance so one congested window doesn't erase what the chip
+    # demonstrably did (BENCH_LAST_GOOD.json, refreshed best-of below).
+    if is_chip_platform(rec["platform"]) and os.path.exists(LAST_GOOD_PATH):
+        try:
+            with open(LAST_GOOD_PATH) as fh:
+                best = json.load(fh)
+            if (best.get("metric") == rec["metric"]
+                    and is_chip_platform(best.get("platform", ""))
+                    and float(best.get("value", 0)) > rec["value"]):
+                rec["best_verified_value"] = best["value"]
+                rec["best_verified_vs_baseline"] = best.get("vs_baseline")
+                rec["best_verified_at_utc"] = best.get("recorded_at_utc")
+                rec["best_verified_git_sha"] = best.get("git_sha")
+        except (ValueError, TypeError, OSError):
+            pass
     print(json.dumps(rec))
     maybe_refresh_last_good(rec)
 

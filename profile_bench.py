@@ -7,6 +7,9 @@ Modes:
                                     # kernel at bench shapes (real chip)
   python profile_bench.py --planned # A/B: self-contained vs host-planned
                                     # merge+materialize at bench shapes
+  python profile_bench.py --int64   # A/B: int32 vs int64 sort/search/scan
+                                    # at bench scale (the engine's all-int32
+                                    # design assumption, MEASUREMENTS.md)
 
 NOTE (docs/PROFILE_r3.md): on this runtime `block_until_ready` is lazy —
 only a data fetch (np.asarray) reliably flushes and waits, so stage wall
@@ -150,50 +153,114 @@ def pallas_ab():
         print(f"{name}: device total {total / 1e3:.2f} ms")
 
 
-def planned_ab(batch):
+def planned_ab(batch, pairs: int = 4):
     """Timed-region A/B at bench shapes: host-planned segment linearization
-    (the default; engine/segments.py) vs the self-contained kernels (mirror
-    disabled). Both run the same prepare/commit/sync protocol as bench.py."""
-    def run(no_mirror: bool):
-        times = []
-        for rep in range(3):
-            doc = DeviceTextDoc("bench-text")
-            doc.eager_materialize = True
-            if no_mirror:
-                doc.seg_mirror = None
-                doc.prefer_planned = False
-            else:
-                # both arms pinned explicitly so the A/B compares the real
-                # alternatives regardless of the production default (which
-                # this harness's results decide — text_doc.prefer_planned)
-                doc.prefer_planned = True
-            doc.apply_batch(base_batch("bench-text", BASE_LEN))
-            doc.text()
-            prepared = doc.prepare_batch(batch)
-            t0 = t()
-            doc.commit_prepared(prepared)
-            doc._materialize(with_pos=False)
-            scal = doc._scalars()
-            times.append(t() - t0)
-            assert int(scal[0]) == BASE_LEN + N_ACTORS * (OPS_PER_CHANGE // 2)
-            if not no_mirror:
-                # the planned materialization returns the 5-scalar pack
-                # (n_vis, n_segs, chain-count + structural-hash verifiers
-                # — text_doc._scalars); the self-contained kernel returns
-                # 2. (Was ==4 from an older pack layout: the round-5
-                # session dry-run caught it failing before any chip
-                # window could.)
-                assert len(scal) == 5, "planned kernel did not engage"
-        return min(times)
+    (engine/segments.py) vs the self-contained kernels (mirror disabled).
+    Both run the same prepare/commit/sync protocol as bench.py.
 
-    for name, nm in (("self-contained", True), ("host-planned", False)):
-        dt = run(nm)
-        n_ops = batch.n_ops
+    INTERLEAVED pairs (A,B,A,B,...): the two block-measured runs of
+    2026-07-31 SPLIT (self won 03:24 by 13%, planned won 03:38 by 43%)
+    because WAN-tunnel congestion drifts on a seconds timescale — a block
+    design aliases that drift into the arm difference. Pairing puts both
+    arms inside the same weather and reports the per-pair delta
+    distribution alongside min-of-arm, so one harness run says whether
+    the difference is real where a block design could not."""
+    def once(planned: bool):
+        doc = DeviceTextDoc("bench-text")
+        doc.eager_materialize = True
+        if not planned:
+            doc.seg_mirror = None
+            doc.prefer_planned = False
+        else:
+            # both arms pinned explicitly so the A/B compares the real
+            # alternatives regardless of the production default (which
+            # this harness's results decide — text_doc.prefer_planned)
+            doc.prefer_planned = True
+        doc.apply_batch(base_batch("bench-text", BASE_LEN))
+        doc.text()
+        prepared = doc.prepare_batch(batch)
+        t0 = t()
+        doc.commit_prepared(prepared)
+        doc._materialize(with_pos=False)
+        scal = doc._scalars()
+        dt = t() - t0
+        assert int(scal[0]) == BASE_LEN + N_ACTORS * (OPS_PER_CHANGE // 2)
+        if planned:
+            # the planned materialization returns the 5-scalar pack
+            # (n_vis, n_segs, chain-count + structural-hash verifiers
+            # — text_doc._scalars); the self-contained kernel returns
+            # 2. (Was ==4 from an older pack layout: the round-5
+            # session dry-run caught it failing before any chip
+            # window could.)
+            assert len(scal) == 5, "planned kernel did not engage"
+        return dt
+
+    once(True)                   # warm-up: compiles for both arms
+    once(False)
+    self_ts, plan_ts = [], []
+    for _ in range(pairs):
+        self_ts.append(once(False))
+        plan_ts.append(once(True))
+    n_ops = batch.n_ops
+    for name, ts in (("self-contained", self_ts), ("host-planned", plan_ts)):
+        dt = min(ts)
         print(f"{name}: timed region {dt*1e3:8.1f} ms "
-              f"({n_ops/dt/1e6:.1f}M ops/s)")
+              f"({n_ops/dt/1e6:.1f}M ops/s)  "
+              f"[{', '.join(f'{x*1e3:.1f}' for x in ts)}]")
+    deltas = [p - s for s, p in zip(self_ts, plan_ts)]
+    wins = sum(1 for d in deltas if d < 0)
+    print(f"per-pair delta (planned - self) ms: "
+          f"{', '.join(f'{d*1e3:+.1f}' for d in deltas)}  "
+          f"(planned wins {wins}/{len(deltas)})")
+
+
+def int64_ab(n: int = 1 << 23, reps: int = 3):
+    """The engine keeps ALL device state int32 on the stated (round-2,
+    never measured) assumption that 64-bit keys would pay severalfold on
+    the TPU's 32-bit lanes. This measures exactly the primitives the
+    kernels lean on — sort, searchsorted, cumsum — at bench scale (2^23
+    ~ the 10M-op round) in both widths. Requires jax_enable_x64 (set
+    below), or the int64 arm silently degrades to int32 and the A/B
+    measures nothing: guarded by a dtype assert."""
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    base = rng.integers(0, 1 << 30, size=n)
+
+    def bench_dtype(dtype):
+        x = jnp.asarray(base, dtype=dtype)
+        assert x.dtype == dtype, (x.dtype, dtype)   # x64 actually enabled
+        xs = jnp.sort(x).block_until_ready()   # hoisted: timing searchsorted
+        ops = {                                # must not re-measure sort
+            "sort": lambda: jnp.sort(x),
+            "searchsorted": lambda: jnp.searchsorted(xs, x),
+            "cumsum": lambda: jnp.cumsum(x),
+        }
+        out = {}
+        for name, fn in ops.items():
+            fn().block_until_ready()                # compile + warm
+            ts = []
+            for _ in range(reps):
+                t0 = t()
+                np.asarray(fn())                    # fetch = real flush
+                ts.append(t() - t0)
+            out[name] = min(ts)
+        return out
+
+    r32 = bench_dtype(jnp.int32)
+    r64 = bench_dtype(jnp.int64)
+    for name in r32:
+        print(f"{name:>12}: int32 {r32[name]*1e3:8.1f} ms   "
+              f"int64 {r64[name]*1e3:8.1f} ms   "
+              f"ratio {r64[name]/r32[name]:.2f}x")
 
 
 if __name__ == "__main__":
+    if "--int64" in sys.argv:
+        int64_ab()
+        sys.exit(0)
     if "--pallas" in sys.argv:
         pallas_ab()
         sys.exit(0)

@@ -57,14 +57,18 @@ export AMTPU_SKIP_PREFLIGHT=1   # this session IS the parent probe
 # selected — chip in a session, cpu in a dry run.
 run "smoke_batched" 600 python scripts/chip_smoke.py
 SMOKE_RC=$?
-if [ "$SMOKE_RC" = "124" ] || [ "$SMOKE_RC" = "7" ]; then
-  # marker text matters: probe_forever stops permanently at
-  # "on-chip smoke FAILED"; a timeout (124) or an infrastructure
-  # exception inside the smoke (7 — tunnel RPC drop mid-dispatch) is
-  # weather, not a parity verdict, and must NOT match it
+if [ "$SMOKE_RC" != "0" ] && [ "$SMOKE_RC" != "1" ]; then
+  # marker text matters: probe_forever stops permanently at "on-chip
+  # smoke FAILED", so rc=1 (chip_smoke's explicit parity-MISMATCH
+  # verdict) is the ONLY code allowed to write it. Everything else is
+  # weather: 124 = wrapper timeout, 7 = chip_smoke's own caught infra
+  # exception, and 128+N = signal deaths that never reach Python's
+  # except clause (134 C++ CHECK abort on a dropped RPC, 137 OOM-kill,
+  # 139 segfault) — classifying those as deterministic was exactly the
+  # v1 window-killing conflation.
   echo "on-chip smoke TIMEOUT/INFRA rc=$SMOKE_RC (retryable tunnel weather), aborting" >> "$LOG"
   exit 6
-elif [ "$SMOKE_RC" != "0" ]; then
+elif [ "$SMOKE_RC" = "1" ]; then
   if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
     # distinct marker: a cpu dry-run flake must not kill the round's probing
     echo "DRYRUN smoke failed (cpu), not recording benchmarks" >> "$LOG"
@@ -88,6 +92,7 @@ else
   run "configs_record" 3600 python -m benchmarks.run_all --record "${AMTPU_ROUND:-5}"
 fi
 run "pallas_ab" 900 python profile_bench.py --pallas
+run "int64_ab"  600 python profile_bench.py --int64
 run "trace"     600 python profile_bench.py --trace
 
 # best-effort tail: full suite on the chip is dispatch-bound through the

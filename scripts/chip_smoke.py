@@ -49,24 +49,20 @@ import automerge_tpu as am  # noqa: E402
 from automerge_tpu import Text  # noqa: E402
 from automerge_tpu.engine import DeviceTextDoc  # noqa: E402
 
-# the ONE op-extraction helper the parity suite uses — a drifted copy here
-# would silently diverge the smoke's parity bar from the test suite's
+# the parity suite's own extraction helpers — a drifted copy here would
+# silently diverge the smoke's parity bar from the test suite's
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
-from test_engine_parity import text_changes_of  # noqa: E402
-
-
-def oracle_view(doc, key="t"):
-    text = doc[key]
-    values = [e["value"] for e in text.elems]
-    elem_ids = [e["elemId"] for e in text.elems]
-    conflicts = [{c["actor"]: c["value"] for c in (e.get("conflicts") or [])}
-                 for e in text.elems]
-    return values, elem_ids, conflicts
+from test_engine_parity import oracle_view, text_changes_of  # noqa: E402
 
 
 def check(name, doc, eng):
-    o_vals, o_ids, o_confs = oracle_view(doc)
+    # same comparison as test_engine_parity.assert_parity (incl. its
+    # oracle-conflict dict-ification), with first-mismatch diagnostics
+    # for the chip log instead of a bare assert
+    o_vals, o_ids, o_confs_raw = oracle_view(doc)
+    o_confs = [{c["actor"]: c["value"] for c in (oc or [])}
+               for oc in o_confs_raw]
     e_vals, e_ids = eng.values(), eng.elem_ids()
     e_confs = [eng.conflicts_at(i) or {} for i in range(len(e_vals))]
     for what, got, want in (("values", e_vals, o_vals),

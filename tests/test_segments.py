@@ -24,9 +24,10 @@ from test_prepare_commit import typing_change
 
 @pytest.fixture(autouse=True)
 def _planned_kernels_enabled(monkeypatch):
-    # production defaults to the self-contained kernels (the chip A/B win,
-    # text_doc.prefer_planned); this module TESTS the planned path, so it
-    # runs with the planned kernels engaged
+    # this module TESTS the planned path, so it pins the planned kernels
+    # on REGARDLESS of the production default (text_doc.prefer_planned —
+    # currently planned, switchable via AMTPU_PLANNED after the on-chip
+    # A/B split; the pin keeps these tests meaningful either way)
     monkeypatch.setattr(DeviceTextDoc, "prefer_planned", True)
 
 
