@@ -38,6 +38,29 @@ class RoundPlan:
     blob_lt_128: bool
     blob_lt_256: bool
 
+    def rebase(self, delta: int) -> "RoundPlan":
+        """The same partition with inserted-element slots shifted by
+        ``delta``. Only `head_slot`/`res_new_slot` encode the document's
+        pre-round element count (`base_elems`); everything else is a pure
+        function of the op columns — which is what makes the detection
+        cacheable on the (immutable) batch and reusable across documents
+        of different sizes (replica fan-out or replay applying one decoded
+        batch to several docs; the bench re-applies one batch per rep).
+        Arrays the shift does not touch are shared, not copied: every
+        downstream consumer treats the plan as read-only."""
+        if delta == 0:
+            return self
+        return RoundPlan(
+            n_ops=self.n_ops, n_ins=self.n_ins, hpos=self.hpos,
+            run_len=self.run_len,
+            head_slot=self.head_slot + delta,
+            rpos=self.rpos,
+            res_new_slot=np.where(self.res_new_slot >= 0,
+                                  self.res_new_slot + delta,
+                                  self.res_new_slot),
+            blob=self.blob, blob_lt_128=self.blob_lt_128,
+            blob_lt_256=self.blob_lt_256)
+
     @property
     def n_runs(self) -> int:
         return len(self.hpos)

@@ -102,7 +102,12 @@ TIMED_REGION = (
     "The d2h text pull runs outside the timed region and is reported "
     "separately as text_pull_s (tunnel-bandwidth bound; ~2 ms on PCIe). "
     "e2e_* fields time prepare + transfers + commit + sync; "
-    "e2e_with_pull_ops_per_sec additionally includes the text pull.")
+    "e2e_with_pull_ops_per_sec additionally includes the text pull. "
+    "prepare_s and e2e_* reflect the run-detection cache (engine/runs.py "
+    "RoundPlan.rebase: applying one decoded batch to several documents "
+    "detects once); prepare_cold_s / e2e_cold_* are the same batch's "
+    "first-application costs with the cache explicitly cleared — compare "
+    "THOSE against pre-cache rounds' records.")
 
 
 def run_overlapped(halves, expect_vis, *, obj_id="bench-text",
@@ -258,6 +263,15 @@ def main():
     run_once(batch)                 # warm-up: pays jit compiles at full shapes
     runs = [run_once(batch) for _ in range(2)]        # steady state
     elapsed, prepare_s, staged, pull_s = min(runs)
+    # first-application run (run-detection cache cleared): what ONE cold
+    # delivery pays before the per-batch detection amortizes. A full rep,
+    # not just a prepare: its elapsed+prepare is the honest e2e_cold_*
+    # comparable to pre-cache rounds' records (the warm e2e embeds the
+    # cache hit by design — both are reported).
+    if hasattr(batch, "_run_plan_cache"):
+        del batch._run_plan_cache
+    cold_elapsed, prepare_cold_s, _, _ = run_once(batch)
+    e2e_cold = cold_elapsed + prepare_cold_s
     ops_per_sec = n_ops / elapsed
     e2e = min(r[0] + r[1] for r in runs)
     e2e_pull = min(r[0] + r[1] + r[3] for r in runs)
@@ -279,9 +293,12 @@ def main():
         "vs_baseline": round(ops_per_sec / TARGET_OPS_PER_SEC, 4),
         "timed_region": TIMED_REGION,
         "prepare_s": round(prepare_s, 4),
+        "prepare_cold_s": round(prepare_cold_s, 4),
         "staged_h2d_bytes": staged,
         "e2e_s": round(e2e, 4),
         "e2e_ops_per_sec": round(n_ops / e2e),
+        "e2e_cold_s": round(e2e_cold, 4),
+        "e2e_cold_ops_per_sec": round(n_ops / e2e_cold),
         "e2e_overlapped_s": round(e2e_ov, 4),
         "e2e_overlapped_ops_per_sec": round(
             (halves[0].n_ops + halves[1].n_ops) / e2e_ov),

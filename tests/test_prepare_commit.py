@@ -158,3 +158,63 @@ def test_duplicate_delivery_through_prepare():
     assert all(p is None for _, _, _, p in prepared.rounds)
     doc.commit_prepared(prepared)
     assert doc.text() == text
+
+
+def test_run_plan_cache_reuses_and_rebases_across_docs():
+    """Run detection is memoized on the batch object (text_doc._plan_round):
+    DocSet broadcasts ONE delivery to every doc, so the second doc must
+    reuse the first doc's detection — including when its element count
+    differs (slot fields rebase) — and produce exactly what a fresh,
+    uncached batch produces."""
+    import bench as B
+    from automerge_tpu.engine import DeviceTextDoc
+
+    def fresh_doc(extra_round: bool):
+        d = DeviceTextDoc("t")
+        d.apply_batch(B.base_batch("t", 120))
+        if extra_round:                     # shifts base_elems for doc B
+            d.apply_batch(B.merge_batch("t", 3, 10, 120, seed=9,
+                                        actor_prefix="pre"))
+        d.text()
+        return d
+
+    batch = B.merge_batch("t", 20, 12, 120, seed=4)
+    doc_a = fresh_doc(False)
+    doc_a.apply_batch(batch)
+    assert getattr(batch, "_run_plan_cache", None) is not None
+
+    # doc B: different base_elems -> the cached plan must rebase
+    doc_b = fresh_doc(True)
+    doc_b.apply_batch(batch)                # cache HIT (rebased)
+    control = fresh_doc(True)
+    control.apply_batch(B.merge_batch("t", 20, 12, 120, seed=4))  # no cache
+    assert doc_b.text() == control.text()
+    assert doc_b.elem_ids() == control.elem_ids()
+
+    # doc C: same base_elems as A (delta 0, shared-array fast path)
+    doc_c = fresh_doc(False)
+    doc_c.apply_batch(batch)
+    assert doc_c.text() == doc_a.text()
+    assert doc_c.elem_ids() == doc_a.elem_ids()
+
+
+def test_run_plan_cache_does_not_leak_across_batches():
+    """The memo must never leak between DIFFERENT batches: a doc preparing
+    its own distinct batch after another batch was cached must detect
+    fresh (the cache lives on the batch object, not the doc)."""
+    import bench as B
+    from automerge_tpu.engine import DeviceTextDoc
+
+    b1 = B.merge_batch("t", 10, 8, 100, seed=1)
+    b2 = B.merge_batch("t", 10, 8, 100, seed=2, actor_prefix="other")
+    d = DeviceTextDoc("t")
+    d.apply_batch(B.base_batch("t", 100))
+    d.apply_batch(b1)
+    d.apply_batch(b2)               # b2 must not see b1's cached plan
+    control = DeviceTextDoc("t")
+    control.apply_batch(B.base_batch("t", 100))
+    control.apply_batch(B.merge_batch("t", 10, 8, 100, seed=1))
+    control.apply_batch(B.merge_batch("t", 10, 8, 100, seed=2,
+                                      actor_prefix="other"))
+    assert d.text() == control.text()
+    assert d.elem_ids() == control.elem_ids()
