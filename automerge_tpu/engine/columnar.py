@@ -177,9 +177,42 @@ class TextChangeBatch:
         import json as _json
         return cls.from_changes(_json.loads(data), obj_id)
 
+    _NATIVE_MIN_OPS = 20_000   # dumps+C-lex beats the Python walk ~5x at
+    # bulk sizes; below this the dumps overhead isn't worth it
+
     @classmethod
     def from_changes(cls, changes, obj_id: str) -> "TextChangeBatch":
-        """Decode wire-format changes (plain dicts) into columns."""
+        """Decode wire-format changes (plain dicts) into columns.
+
+        Bulk deliveries (initial sync of a whole document to a fresh
+        peer, load replaying a history) re-serialize through the native
+        C++ JSON decoder: the wire schema round-trips losslessly, and
+        one C-speed dumps + native lex is ~5x the per-op Python walk at
+        100k-op scale (measured: the walk was the dominant term of a
+        fresh-peer 100k-char initial sync). Small (interactive) changes
+        and anything outside the native decoder's scope take the Python
+        path unchanged; both produce identical batches
+        (tests/test_native_codec)."""
+        if (isinstance(changes, list)
+                and sum(len(c.get("ops", ())) for c in changes)
+                >= cls._NATIVE_MIN_OPS
+                # the native parser DEFAULTS missing fields where the
+                # Python walk raises (and drops a non-string message);
+                # route only well-formed wire shapes so malformed input
+                # keeps failing loudly on the Python path
+                and all("actor" in c and "seq" in c and "ops" in c
+                        and (c.get("message") is None
+                             or isinstance(c["message"], str))
+                        for c in changes)):
+            from ..native import decode_text_changes
+            try:
+                import json as _json
+                batch = decode_text_changes(
+                    _json.dumps(changes).encode(), obj_id)
+            except (TypeError, ValueError):
+                batch = None     # non-wire values: Python path handles
+            if batch is not None:
+                return batch
         actor_rank: dict = {}
         actor_table: list = []
         value_pool: list = []

@@ -306,3 +306,34 @@ def test_run_detection_parity_parallel_path(seed, monkeypatch):
     np.testing.assert_array_equal(blob, a.blob)
     assert n_ins == a.n_ins
     assert lt128 == a.blob_lt_128 and lt256 == a.blob_lt_256
+
+
+@needs_native
+def test_bulk_from_changes_routes_native_with_identical_batch():
+    """from_changes itself re-serializes BULK dict payloads through the
+    native decoder (columnar.py _NATIVE_MIN_OPS); the result must equal
+    the pure-Python walk bit for bit."""
+    text = "x" * (TextChangeBatch._NATIVE_MIN_OPS // 2 + 10)
+    changes = [typing_change("alice", 1, text, message="bulk")]
+    routed = TextChangeBatch.from_changes(changes, "t")
+    # force the Python walk by staying under the ops floor per call:
+    # decode the same payload with the native path disabled
+    import automerge_tpu.engine.columnar as C
+    orig = TextChangeBatch._NATIVE_MIN_OPS
+    try:
+        TextChangeBatch._NATIVE_MIN_OPS = 10**9
+        slow = TextChangeBatch.from_changes(changes, "t")
+    finally:
+        TextChangeBatch._NATIVE_MIN_OPS = orig
+    assert_batches_equal(routed, slow)
+
+
+def test_bulk_malformed_change_still_raises():
+    """The bulk native route must not LAUNDER malformed wire shapes the
+    Python walk rejects: a change missing "seq" (or with a non-string
+    message) takes the Python path and fails loudly."""
+    text = "x" * (TextChangeBatch._NATIVE_MIN_OPS // 2 + 10)
+    good = typing_change("alice", 1, text)
+    bad = {k: v for k, v in good.items() if k != "seq"}
+    with pytest.raises(KeyError):
+        TextChangeBatch.from_changes([bad], "t")
