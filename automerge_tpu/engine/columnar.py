@@ -175,13 +175,16 @@ class TextChangeBatch:
         if batch is not None:
             return batch
         import json as _json
-        return cls.from_changes(_json.loads(data), obj_id)
+        # the native attempt already ran (and declined); don't dumps+retry
+        return cls.from_changes(_json.loads(data), obj_id,
+                                _try_native=False)
 
     _NATIVE_MIN_OPS = 20_000   # dumps+C-lex beats the Python walk ~5x at
     # bulk sizes; below this the dumps overhead isn't worth it
 
     @classmethod
-    def from_changes(cls, changes, obj_id: str) -> "TextChangeBatch":
+    def from_changes(cls, changes, obj_id: str,
+                     _try_native: bool = True) -> "TextChangeBatch":
         """Decode wire-format changes (plain dicts) into columns.
 
         Bulk deliveries (initial sync of a whole document to a fresh
@@ -191,19 +194,17 @@ class TextChangeBatch:
         100k-op scale (measured: the walk was the dominant term of a
         fresh-peer 100k-char initial sync). Small (interactive) changes
         and anything outside the native decoder's scope take the Python
-        path unchanged; both produce identical batches
-        (tests/test_native_codec)."""
-        if (isinstance(changes, list)
+        path unchanged; both produce identical batches, and malformation
+        the Python walk rejects (missing actor/seq/ops, non-string
+        message) is marked unsupported by the codec itself so it falls
+        back and still fails loudly (tests/test_native_codec).
+        `_try_native=False` is from_json's internal flag: its payload
+        already went through the native decoder once."""
+        from ..native import available as _native_available
+        if (_try_native and isinstance(changes, list)
+                and _native_available()
                 and sum(len(c.get("ops", ())) for c in changes)
-                >= cls._NATIVE_MIN_OPS
-                # the native parser DEFAULTS missing fields where the
-                # Python walk raises (and drops a non-string message);
-                # route only well-formed wire shapes so malformed input
-                # keeps failing loudly on the Python path
-                and all("actor" in c and "seq" in c and "ops" in c
-                        and (c.get("message") is None
-                             or isinstance(c["message"], str))
-                        for c in changes)):
+                >= cls._NATIVE_MIN_OPS):
             from ..native import decode_text_changes
             try:
                 import json as _json

@@ -337,10 +337,15 @@ bool parse_change(Parser& ps, Batch& b) {
     b.messages.emplace_back();
     b.has_message.push_back(0);
     size_t ops_from = b.op_kind.size();
+    // the python decoder raises on changes missing these fields; the
+    // native tier must fall back, never default them (a seq-0 change
+    // would queue forever in causal admission)
+    bool saw_actor = false, saw_seq = false, saw_ops = false;
     if (!ps.peek('}')) do {
         std::string k;
         if (!ps.str(k) || !ps.expect(':')) return false;
         if (k == "actor") {
+            saw_actor = true;
             if (!ps.str(b.actors[row])) return false;
             // actor ids travel '\n'-joined to python; exotic ids fall back
             if (b.actors[row].find('\n') != std::string::npos) {
@@ -348,6 +353,7 @@ bool parse_change(Parser& ps, Batch& b) {
             }
         }
         else if (k == "seq") {
+            saw_seq = true;
             long long s; if (!ps.integer(s)) return false;
             if (s < 0 || s > INT32_MAX) { b.unsupported = true; b.err = "seq out of range"; s = 0; }
             b.seqs[row] = (int32_t)s;
@@ -392,9 +398,18 @@ bool parse_change(Parser& ps, Batch& b) {
                     b.unsupported = true; b.err = "separator in message";
                 }
             }
-            else if (!ps.skip()) return false;
+            else {
+                // null means absent (matches python's None); any other
+                // non-string value the python path PRESERVES, so the
+                // native tier must not silently drop it
+                if (!ps.peek('n')) {
+                    b.unsupported = true; b.err = "non-string message";
+                }
+                if (!ps.skip()) return false;
+            }
         }
         else if (k == "ops") {
+            saw_ops = true;
             if (!ps.expect('[')) return false;
             if (!ps.eat(']')) {
                 do { if (!parse_op(ps, b, b.err_obj, row)) return false; } while (ps.eat(','));
@@ -404,6 +419,9 @@ bool parse_change(Parser& ps, Batch& b) {
         else { if (!ps.skip()) return false; }
     } while (ps.eat(','));
     if (!ps.expect('}')) return false;
+    if (!saw_actor || !saw_seq || !saw_ops) {
+        b.unsupported = true; b.err = "change missing actor/seq/ops";
+    }
     // ins target actor = the change's own actor
     int32_t rank = b.intern(b.actors[row]);
     for (size_t i = ops_from; i < b.op_kind.size(); i++)

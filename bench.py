@@ -235,6 +235,23 @@ def _git_sha() -> str:
         return "unknown"
 
 
+def _serve_stale(reason: str):
+    """Print the last verified on-chip record stale-marked with `reason`.
+    Returns 0 when served, None when no record exists (caller decides the
+    failure mode — both degraded paths must stay in lockstep)."""
+    if not os.path.exists(LAST_GOOD_PATH):
+        return None
+    with open(LAST_GOOD_PATH) as fh:
+        rec = json.load(fh)
+    rec["stale"] = True
+    rec["stale_reason"] = (
+        f"{reason}; this is the last locally recorded on-chip run "
+        "(BENCH_LAST_GOOD.json), from " +
+        str(rec.get("recorded_at_utc", "unknown time")))
+    print(json.dumps(rec))
+    return 0
+
+
 def main():
     from benchmarks.common import preflight_device
     # The tunnel to the chip flaps (BENCH_r03 was lost to a single failed
@@ -243,21 +260,35 @@ def main():
     # the last committed on-chip record, explicitly marked stale.
     budget = float(os.environ.get("AMTPU_PREFLIGHT_BUDGET_S", "420"))
     if not preflight_device(total_budget_s=budget):
-        if os.path.exists(LAST_GOOD_PATH):
-            with open(LAST_GOOD_PATH) as fh:
-                rec = json.load(fh)
-            rec["stale"] = True
-            rec["stale_reason"] = (
-                "no reachable jax device at run time after bounded "
-                f"retry ({budget:.0f}s); this is the last locally "
-                "recorded on-chip run (BENCH_LAST_GOOD.json), from " +
-                str(rec.get("recorded_at_utc", "unknown time")))
-            print(json.dumps(rec))
-            return 0
+        served = _serve_stale("no reachable jax device at run time after "
+                              f"bounded retry ({budget:.0f}s)")
+        if served is not None:
+            return served
         print("bench.py: no reachable jax device (TPU tunnel down?) — "
               "refusing to hang; no last-good on-chip record exists yet",
               file=sys.stderr)
         return 3
+    try:
+        rec = _measure()
+    except Exception as exc:
+        # The tunnel can drop MID-measurement (round-5 windows flapped on
+        # a ~15-55 min cadence): a dead record (rc!=0) serves the driver
+        # nothing, so degrade exactly like a failed preflight — the last
+        # verified on-chip run, stale-marked, with the live failure
+        # spelled out rather than laundered.
+        import traceback
+        traceback.print_exc()
+        served = _serve_stale("live measurement failed mid-run "
+                              f"({type(exc).__name__}: {exc})")
+        if served is not None:
+            return served
+        raise
+    print(json.dumps(rec))
+    maybe_refresh_last_good(rec)
+    return 0
+
+
+def _measure() -> dict:
     batch = merge_batch("bench-text", N_ACTORS, OPS_PER_CHANGE, BASE_LEN)
     n_ops = batch.n_ops
     run_once(batch)                 # warm-up: pays jit compiles at full shapes
@@ -329,8 +360,7 @@ def main():
                 rec["best_verified_git_sha"] = best.get("git_sha")
         except (ValueError, TypeError, OSError):
             pass
-    print(json.dumps(rec))
-    maybe_refresh_last_good(rec)
+    return rec
 
 
 if __name__ == "__main__":

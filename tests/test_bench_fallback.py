@@ -176,3 +176,35 @@ def test_chip_platform_gate_accepts_axon():
     assert is_chip_platform("axon")   # this environment's chip
     assert is_chip_platform("tpu")    # a locally attached chip
     assert not is_chip_platform("cpu")
+
+
+def test_mid_run_failure_serves_stale_last_good(tmp_path, monkeypatch, capsys):
+    """A tunnel drop DURING measurement (not just at preflight) must also
+    degrade to the stale-marked last-good record with the live error
+    spelled out, instead of handing the driver a dead rc."""
+    import bench
+    from benchmarks import common
+
+    path = str(tmp_path / "last_good.json")
+    rec = {"metric": "ops_per_sec_merged_text_10k_actors_1M_doc",
+           "value": 777, "unit": "ops/s", "platform": "tpu",
+           "recorded_at_utc": "2026-07-31T01:04:54Z"}
+    with open(path, "w") as fh:
+        json.dump(rec, fh)
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", path)
+    monkeypatch.setattr(common, "preflight_device",
+                        lambda *a, **k: True)
+    def boom():
+        raise RuntimeError("tunnel RPC dropped mid-commit")
+    monkeypatch.setattr(bench, "_measure", boom)
+
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 777
+    assert out["stale"] is True
+    assert "tunnel RPC dropped mid-commit" in out["stale_reason"]
+
+    # without a last-good record the failure must propagate (rc path)
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "absent"))
+    with pytest.raises(RuntimeError):
+        bench.main()

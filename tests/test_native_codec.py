@@ -337,3 +337,24 @@ def test_bulk_malformed_change_still_raises():
     bad = {k: v for k, v in good.items() if k != "seq"}
     with pytest.raises(KeyError):
         TextChangeBatch.from_changes([bad], "t")
+
+
+@needs_native
+def test_change_level_malformation_marked_unsupported_by_codec():
+    """The codec itself (not a caller-side pre-scan) must decline changes
+    the Python walk treats differently: missing actor/seq/ops, or a
+    non-string message (which the Python path PRESERVES)."""
+    import json as _json
+    from automerge_tpu import native
+
+    good = typing_change("alice", 1, "hi")
+    for strip in ("actor", "seq", "ops"):
+        bad = {k: v for k, v in good.items() if k != strip}
+        assert native.decode_text_changes(
+            _json.dumps([bad]), "t") is None, f"missing {strip} accepted"
+    num_msg = dict(good, message=42)
+    assert native.decode_text_changes(_json.dumps([num_msg]), "t") is None
+    # null message means absent and stays in scope
+    null_msg = dict(good, message=None)
+    batch = native.decode_text_changes(_json.dumps([null_msg]), "t")
+    assert batch is not None and batch.messages == [None]
