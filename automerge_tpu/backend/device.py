@@ -75,34 +75,43 @@ def _graduate_signal(reason: str, detail: str = ""):
 def _in_scope(changes, known_kinds) -> bool:
     """True iff every op stays within the device shape: makes of any kind,
     link/set/del/inc on known objects, ins on known list/text objects.
-    `known_kinds` maps object id -> kind at the target state."""
+    `known_kinds` maps object id -> kind at the target state.
+
+    ONE pass over the delivery (bulk deliveries carry 100k+ op dicts, and
+    this gate runs before every apply): causal admission may apply a make
+    delivered after an op that references it in this same list, so
+    membership checks that fail at walk time are DEFERRED and re-checked
+    against the fully-collected makes at the end. Equivalent to the old
+    collect-makes-first two-pass formulation for every input: membership
+    (`obj in known`) is monotone — keys are never removed, so a walk-time
+    pass can never become a final fail and every walk-time fail gets the
+    full-knowledge re-check — while the KIND predicate on ins targets is
+    NOT monotone (a later make can overwrite the kind), so every ins
+    target is deferred unconditionally and judged only on final kinds."""
     known = dict(known_kinds)
-    # collect the delivery's makes first: causal admission may apply a make
-    # delivered after an op that references it in this same list
-    for change in changes:
-        for op in change.get("ops", ()):
-            if op.get("action") in _MAKE_KIND:
-                known[op["obj"]] = _MAKE_KIND[op["action"]]
+    deferred_objs: set = set()   # must be known once all makes are seen
+    ins_objs: set = set()        # must end up known AND text/list
     for change in changes:
         for op in change.get("ops", ()):
             action = op.get("action")
             obj = op.get("obj")
             if action in _MAKE_KIND:
-                continue
-            if action == "link":
+                known[obj] = _MAKE_KIND[action]
+            elif action == "link":
                 if obj != ROOT_ID and obj not in known:
-                    return False
+                    deferred_objs.add(obj)
                 if op.get("value") not in known:
-                    return False
+                    deferred_objs.add(op.get("value"))
             elif action == "ins":
-                if known.get(obj) not in ("text", "list"):
-                    return False
+                ins_objs.add(obj)
             elif action in ("set", "del", "inc"):
                 if obj != ROOT_ID and obj not in known:
-                    return False
+                    deferred_objs.add(obj)
             else:
                 return False
-    return True
+    return (all(obj in known for obj in deferred_objs)
+            and all(known.get(obj) in ("text", "list")
+                    for obj in ins_objs))
 
 
 _transitive = transitive_deps  # shared closure (see _common.transitive_deps)

@@ -158,3 +158,24 @@ def test_malformed_delivery_is_the_only_graduation_trigger():
     assert am.to_json(doc) == {"x": 1}
     doc2 = am.change(doc, lambda d: d.__setitem__("y", 2))
     assert am.to_json(doc2) == {"x": 1, "y": 2}
+
+
+def test_scope_gate_rejects_kind_overwrite_after_ins():
+    """The one non-monotone predicate in the scope gate: an ins whose
+    target's kind is OVERWRITTEN by a later make in the same delivery
+    must be rejected on the final kind (single-pass regression,
+    round-5 review counterexample), while make-after-use of a fresh
+    text stays admitted."""
+    from automerge_tpu.backend.device import _in_scope
+
+    overwrite = [{"ops": [
+        {"action": "ins", "obj": "o1", "key": "_head", "elem": 1},
+        {"action": "makeMap", "obj": "o1"},
+    ]}]
+    assert _in_scope(overwrite, {"o1": "text"}) is False
+
+    make_after_use = [{"ops": [
+        {"action": "ins", "obj": "o2", "key": "_head", "elem": 1},
+        {"action": "makeText", "obj": "o2"},
+    ]}]
+    assert _in_scope(make_after_use, {}) is True
