@@ -1,7 +1,7 @@
 """Evidence for the elem-axis sharding story: compiled-HLO collective audit
 + 1-vs-N virtual-device scaling of the sharded merge.
 
-Writes docs/SHARDING_r4.md. Run with the scrubbed CPU env:
+Writes docs/SHARDING_r<round>.md (AMTPU_ROUND, default 5). Run with the scrubbed CPU env:
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
       XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python scripts/sharding_evidence.py
@@ -112,7 +112,8 @@ def main():
     mesh_elem_shape = tuple(mesh_elem.shape.items())
     rows = scaling()
 
-    doc = f"""# Sharding evidence — round 4 ({n} virtual CPU devices)
+    rnd = int(os.environ.get("AMTPU_ROUND", "5"))
+    doc = f"""# Sharding evidence — round {rnd} ({n} virtual CPU devices)
 
 Claim under test (parallel/mesh.py): documents shard over the `doc` axis
 with no cross-device traffic; one huge document shards along `elem`, with
@@ -200,7 +201,7 @@ Revisit only with real multi-chip ICI hardware; until then the production
 materialize stays 1-way on the elem axis.
 """
     out = os.path.join(os.path.dirname(__file__), "..", "docs",
-                       "SHARDING_r4.md")
+                       f"SHARDING_r{rnd}.md")
     with open(out, "w") as fh:
         fh.write(doc)
     print(doc)
