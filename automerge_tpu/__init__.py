@@ -23,6 +23,8 @@ from .frontend import (  # noqa: F401
     Counter, Frontend, Table, Text, can_redo, can_undo, get_actor_id,
     get_conflicts, get_object_by_id, get_object_id, set_actor_id,
 )
+from . import resilience  # noqa: F401
+from .resilience import ProtocolError  # noqa: F401
 from .sync import (  # noqa: F401
     ClockMatrix, Connection, DocSet, SyncHub, WatchableDoc,
 )

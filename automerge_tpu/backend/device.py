@@ -50,6 +50,7 @@ from typing import Optional
 import numpy as np
 
 from .._common import ROOT_ID, make_elem_id, transitive_deps
+from ..resilience.validation import prevalidated, validate_changes
 from . import facade as _oracle
 from .facade import BackendState as _OracleState
 
@@ -1333,8 +1334,16 @@ class _DeviceCore:
             setattr(self, slot, getattr(clean, slot))
 
     def graduate(self, version: int) -> _OracleState:
-        """Replay the delivery log into an oracle backend state."""
+        """Replay the delivery log into an oracle backend state.
+
+        Everything in the log was validated at original admission, so the
+        replay skips the per-op validation walk (`prevalidated`)."""
         state = _oracle.init()
+        with prevalidated():
+            return self._graduate_replay(state, version)
+
+    def _graduate_replay(self, state: _OracleState,
+                         version: int) -> _OracleState:
         for cmd in self.commands[:version]:
             if cmd[0] == "apply":
                 state, _ = _oracle.apply_changes(state, cmd[1])
@@ -1414,7 +1423,9 @@ def _device_apply(state: DeviceBackendState, changes, undoable: bool,
         oracle_state = state._core.graduate(state._version)
         if command[0] == "local":
             return _oracle.apply_local_change(oracle_state, command[1])
-        return _oracle.apply_changes(oracle_state, changes)
+        # `changes` was validated by the caller (apply_changes) already
+        with prevalidated():
+            return _oracle.apply_changes(oracle_state, changes)
     core = state.writable_core()
     try:
         diffs = core.apply(changes, undoable,
@@ -1428,8 +1439,12 @@ def _device_apply(state: DeviceBackendState, changes, undoable: bool,
 
 
 def apply_changes(state, changes):
-    changes = list(changes)  # materialize BEFORE logging: iterator inputs
-    # must see identical content in the live apply and the replay log
+    # validation materializes BEFORE logging (iterator inputs must see
+    # identical content in the live apply and the replay log) and rejects
+    # structurally malformed changes with a typed ProtocolError before any
+    # core mutation; unknown op actions still flow to graduation + the
+    # oracle's authoritative rejection (tests/test_graduation.py)
+    changes = validate_changes(changes, strict=False)
     if isinstance(state, _OracleState):
         return _oracle.apply_changes(state, changes)
     return _device_apply(state, changes, False, ("apply", changes, False))
@@ -1545,7 +1560,10 @@ def get_missing_deps(state) -> dict:
 
 def merge(local, remote):
     changes = get_missing_changes(remote, local.clock)
-    return apply_changes(local, changes)
+    # changes come from an admitted lineage: skip the per-op validation
+    # walk (the merge-heavy soak/reconciliation hot path)
+    with prevalidated():
+        return apply_changes(local, changes)
 
 
 def _device_undo_redo(state, request, tag: str):

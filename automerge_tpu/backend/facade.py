@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Optional
 
 from .._common import ROOT_ID, less_or_equal, parse_elem_id
+from ..resilience.validation import prevalidated, validate_changes
 from .op_set import OpSetIndex
 
 
@@ -107,8 +108,12 @@ def _apply(state: BackendState, changes, undoable: bool):
 
 
 def apply_changes(state: BackendState, changes):
-    """Apply remote changes; returns (state', patch) (backend/index.js:166-168)."""
-    return _apply(state, changes, False)
+    """Apply remote changes; returns (state', patch) (backend/index.js:166-168).
+
+    Structurally malformed changes raise ``ProtocolError`` before any index
+    mutation (lenient mode: unknown op *action strings* pass through to the
+    op-set's authoritative ``Unknown operation type`` rejection)."""
+    return _apply(state, validate_changes(changes, strict=False), False)
 
 
 def apply_local_change(state: BackendState, change: dict):
@@ -274,7 +279,10 @@ def get_missing_deps(state: BackendState) -> dict:
 def merge(local: BackendState, remote: BackendState):
     """Apply changes present in `remote` but not `local` (backend/index.js:246-249)."""
     changes = remote._index.get_missing_changes(local.clock, remote.clock)
-    return apply_changes(local, changes)
+    # extracted from an admitted local lineage: already schema-valid, skip
+    # the per-op validation walk on this in-process hot path
+    with prevalidated():
+        return apply_changes(local, changes)
 
 
 class Backend:

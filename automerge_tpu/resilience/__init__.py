@@ -1,0 +1,41 @@
+"""Resilience layer: chaos transport, wire validation, quarantine, retry.
+
+Four pieces (see docs/INTERNALS.md §7):
+
+- ``errors`` / ``validation`` — typed :class:`ProtocolError` rejection of
+  malformed wire messages and changes, shared by the sync tier (strict) and
+  backend change application (lenient on unknown op actions, which keep
+  flowing to the oracle's authoritative rejection via graduation).
+- ``quarantine`` — bounded parking for causally-premature changes with
+  eviction stats.
+- ``inbound`` — the one validated + quarantined gate every remote delivery
+  funnels through (cached per DocSet).
+- ``chaos`` / ``channel`` — a deterministic seed-driven fault-injecting
+  transport and the sequence/ack/retry layer that makes the unchanged
+  ``{docId, clock, changes?}`` protocol survive it.
+"""
+
+from .errors import ProtocolError  # noqa: F401
+from .validation import (  # noqa: F401
+    validate_change, validate_changes, validate_clock, validate_msg,
+    validate_op,
+)
+from .quarantine import DEFAULT_CAPACITY, QuarantineQueue  # noqa: F401
+from .chaos import ChaosLink  # noqa: F401
+from .channel import ResilientChannel, validate_envelope  # noqa: F401
+
+# `inbound` resolves lazily (PEP 562): it imports the frontend, which is
+# mid-initialization when backend/facade.py pulls in the validation layer
+# during package import.
+_LAZY = ("InboundGate", "inbound_gate")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from . import inbound
+        return getattr(inbound, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

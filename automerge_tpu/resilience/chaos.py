@@ -1,0 +1,122 @@
+"""Deterministic, seed-driven fault injection for sync transports.
+
+A ``ChaosLink`` is one *directed* edge between a sender (anything with a
+``send_msg``-shaped callback) and a receiver callback. Every fault decision
+is drawn from one seeded generator in call order, so a session driven by a
+fixed schedule of ``send``/``pump`` calls replays bit-identically from its
+seed — the property the chaos soak harness (scripts/soak.py --chaos) relies
+on to print reproducible failure seeds.
+
+Fault model (per message, in this order):
+
+- **partition**: while partitioned, every send is dropped outright (the
+  TCP-connection-reset model: in-flight and new frames die; recovery is the
+  layer above's job — `ResilientChannel` retransmit or peer reconnect).
+  ``heal()`` restores the link.
+- **drop**: lost with probability ``drop``.
+- **duplicate**: enqueued twice with probability ``dup`` (each copy is an
+  independent decode, so receiver-side aliasing can't mask dedup bugs).
+- **delay**: each enqueued copy is due ``1..max_delay`` pump rounds late
+  with probability ``delay``.
+- **reorder**: with probability ``reorder`` the copy is inserted at a
+  random position in the queue instead of the tail.
+
+Every message is round-tripped through JSON (``codec=True``), which both
+isolates the receiver from sender-side mutation and enforces the wire-format
+invariant that sync messages are plain JSON — a tuple or numpy scalar
+leaking into a message surfaces here, not in production.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+
+class ChaosLink:
+    def __init__(self, deliver, *, seed: int = 0, rng=None,
+                 drop: float = 0.0, dup: float = 0.0, reorder: float = 0.0,
+                 delay: float = 0.0, max_delay: int = 3, codec: bool = True):
+        self._deliver = deliver
+        self._rng = rng if rng is not None else np.random.default_rng(seed)
+        self.drop = drop
+        self.dup = dup
+        self.reorder = reorder
+        self.delay = delay
+        self.max_delay = max_delay
+        self.codec = codec
+        self.partitioned = False
+        self._queue: list = []        # [due_round, payload]
+        self._round = 0
+        self.stats = {"sent": 0, "delivered": 0, "dropped": 0,
+                      "partition_dropped": 0, "duplicated": 0,
+                      "reordered": 0, "delayed": 0}
+
+    # -- fault schedule -------------------------------------------------
+
+    def partition(self):
+        """Sever the link: in-flight frames die, new sends are dropped."""
+        self.partitioned = True
+        self.stats["partition_dropped"] += len(self._queue)
+        self._queue.clear()
+
+    def heal(self):
+        self.partitioned = False
+
+    # -- transport face -------------------------------------------------
+
+    def send(self, msg):
+        self.stats["sent"] += 1
+        wire = json.dumps(msg) if self.codec else msg
+        if self.partitioned:
+            self.stats["partition_dropped"] += 1
+            return
+        if self.drop and self._rng.random() < self.drop:
+            self.stats["dropped"] += 1
+            return
+        copies = 1
+        if self.dup and self._rng.random() < self.dup:
+            copies = 2
+            self.stats["duplicated"] += 1
+        for _ in range(copies):
+            payload = json.loads(wire) if self.codec else msg
+            due = self._round
+            if self.delay and self._rng.random() < self.delay:
+                due += int(self._rng.integers(1, self.max_delay + 1))
+                self.stats["delayed"] += 1
+            entry = [due, payload]
+            if self.reorder and self._queue \
+                    and self._rng.random() < self.reorder:
+                at = int(self._rng.integers(0, len(self._queue)))
+                self._queue.insert(at, entry)
+                self.stats["reordered"] += 1
+            else:
+                self._queue.append(entry)
+
+    def pump(self) -> int:
+        """Advance one round and deliver every due frame; returns the
+        number delivered."""
+        self._round += 1
+        due, held = [], []
+        for entry in self._queue:
+            (due if entry[0] < self._round else held).append(entry)
+        self._queue = held
+        for _, payload in due:
+            self._deliver(payload)
+        self.stats["delivered"] += len(due)
+        return len(due)
+
+    def drain(self, max_rounds: int = 64) -> int:
+        """Pump until the queue is empty (bounded); returns total
+        delivered. Faults still apply to anything sent re-entrantly."""
+        total = 0
+        for _ in range(max_rounds):
+            if not self._queue:
+                break
+            total += self.pump()
+        return total
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue

@@ -1,0 +1,81 @@
+"""Bounded parking lot for causally-premature changes.
+
+A change whose dependencies the local document does not yet cover cannot be
+applied; the backends queue such changes internally, but that queue is
+unbounded — a misbehaving or malicious peer could grow it without limit by
+streaming changes that reference deps it never sends. The inbound gate parks
+premature changes here instead: bounded capacity, FIFO eviction, and
+eviction statistics so operators can see loss happening (an evicted change
+is gone until the transport layer re-requests or re-sends it — the
+`ResilientChannel` retransmit path, or a peer reconnect).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+#: Default per-document bound, sized for real reordering windows (a few
+#: hundred in-flight changes on a lossy multi-path mesh). DocIds are
+#: peer-chosen, so this alone is not the hostile-peer memory bound — the
+#: inbound gate adds an aggregate cap across all docs
+#: (``inbound.GLOBAL_CAPACITY``) with largest-queue-first eviction.
+DEFAULT_CAPACITY = 1024
+
+
+class QuarantineQueue:
+    """FIFO of premature changes keyed ``(actor, seq)``, bounded."""
+
+    __slots__ = ("capacity", "_items", "stats")
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError(f"quarantine capacity must be >= 1, "
+                             f"got {capacity}")
+        self.capacity = capacity
+        self._items: OrderedDict = OrderedDict()   # (actor, seq) -> change
+        self.stats = {"parked": 0, "evicted": 0, "released": 0, "peak": 0}
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def park(self, change: dict, requeue: bool = False):
+        """Admit one premature change; evicts the oldest entry on overflow.
+
+        Returns the evicted change, or None. Re-parking the same
+        ``(actor, seq)`` replaces the stored change in place (redelivered
+        duplicates must not consume capacity). ``requeue`` marks a change
+        coming back after an unsuccessful drain — it re-enters without
+        counting as a fresh park in the stats."""
+        key = (change["actor"], change["seq"])
+        if key in self._items:
+            self._items[key] = change
+            return None
+        evicted = None
+        if len(self._items) >= self.capacity:
+            _, evicted = self._items.popitem(last=False)
+            self.stats["evicted"] += 1
+        self._items[key] = change
+        if not requeue:
+            self.stats["parked"] += 1
+        if len(self._items) > self.stats["peak"]:
+            self.stats["peak"] = len(self._items)
+        return evicted
+
+    def drain_oldest(self):
+        """Evict and return the single oldest entry (the inbound gate's
+        aggregate-bound eviction), or None when empty."""
+        if not self._items:
+            return None
+        _, evicted = self._items.popitem(last=False)
+        self.stats["evicted"] += 1
+        return evicted
+
+    def drain(self) -> list:
+        """Remove and return every parked change (admission order).
+
+        The caller re-parks whatever is still premature; ``released`` is
+        credited by the inbound gate for drained changes that actually
+        applied, so re-parking does not inflate it."""
+        items = list(self._items.values())
+        self._items.clear()
+        return items

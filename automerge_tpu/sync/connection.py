@@ -18,6 +18,8 @@ handing the doc-set a stale snapshot raises (src/connection.js:79-86).
 
 from __future__ import annotations
 
+from ..resilience.inbound import absorb_msg
+from ..resilience.validation import validate_msg
 from .hub import shared_hub
 
 
@@ -67,12 +69,12 @@ class Connection:
         self._closed = True
 
     def receive_msg(self, msg: dict):
+        msg = validate_msg(msg)   # ProtocolError on anything off-schema
         if self._closed:
             # a late in-flight message after close(): absorb inbound
-            # changes, but never rejoin the hub or write to the (likely
-            # torn-down) transport
-            if msg.get("changes"):
-                return self._doc_set.apply_changes(msg["docId"],
-                                                   msg["changes"])
-            return self._doc_set.get_doc(msg["docId"])
-        return self._ensure_peer()._receive(self._peer_id, msg)
+            # changes — through the SAME validated + quarantined gate as
+            # the open path — but never rejoin the hub or write to the
+            # (likely torn-down) transport
+            return absorb_msg(self._doc_set, msg)
+        return self._ensure_peer()._receive(self._peer_id, msg,
+                                            validated=True)

@@ -32,15 +32,37 @@ class DocSet:
             handler(doc_id, doc)
 
     def apply_changes(self, doc_id: str, changes):
+        """Raw application — trusted (in-process) callers only. Network
+        deliveries go through :meth:`deliver`, which validates and
+        quarantines first; this method is what the inbound gate itself
+        calls once a batch is admitted."""
+        doc = self._applied_doc(doc_id, changes)
+        self.set_doc(doc_id, doc)
+        return doc
+
+    def _applied_doc(self, doc_id: str, changes):
+        """The doc with `changes` applied, WITHOUT committing it — the
+        inbound gate uses this to separate backend rejection (state
+        untouched, wrapped as ProtocolError) from exceptions raised by
+        change handlers after the commit (which must propagate as-is:
+        the document did change)."""
         doc = self._docs.get(doc_id)
         if doc is None:
             doc = Frontend.init({"backend": Backend.Backend})
         old_state = Frontend.get_backend_state(doc)
         new_state, patch = Backend.apply_changes(old_state, changes)
         patch["state"] = new_state
-        doc = Frontend.apply_patch(doc, patch)
-        self.set_doc(doc_id, doc)
-        return doc
+        return Frontend.apply_patch(doc, patch)
+
+    def deliver(self, doc_id: str, changes):
+        """Validated + quarantined inbound application (the network path).
+
+        Malformed changes raise ``ProtocolError`` leaving document state
+        and clock untouched; causally-premature changes park in the
+        bounded per-doc quarantine and apply automatically once their
+        deps arrive. Returns the (possibly unchanged) document."""
+        from ..resilience.inbound import inbound_gate
+        return inbound_gate(self).deliver(doc_id, changes)
 
     def register_handler(self, handler):
         if handler not in self._handlers:

@@ -16,6 +16,8 @@ from __future__ import annotations
 from ..backend import default as Backend
 from .. import frontend as Frontend
 from .._common import less_or_equal
+from ..resilience.inbound import absorb_msg, inbound_gate
+from ..resilience.validation import validate_msg
 from .clock_index import ClockMatrix
 
 
@@ -50,7 +52,11 @@ class SyncHub:
         self._matrix = ClockMatrix()
         self._advertised: dict = {}   # (peer, doc) -> clock last advertised
         self._revealed: set = set()   # (peer, doc) pairs that sent us a clock
-        self._had_doc: set = set()    # doc ids this hub ever held locally
+        self._session_docs: set = set()  # (peer, doc): docs this peer's
+        # SESSION has seen us hold — scopes the don't-re-request-removed-
+        # docs guard to one add_peer..remove_peer lifetime (the reference
+        # keeps the equivalent ourClock per Connection instance, so a
+        # reconnected peer starts fresh)
         self._n_auto_ids = 0
 
     # -- lifecycle ------------------------------------------------------
@@ -66,6 +72,7 @@ class SyncHub:
         peer = HubPeer(self, peer_id, send_msg)
         self._peers[peer_id] = peer
         for doc_id in self._doc_set.doc_ids:
+            self._session_docs.add((peer_id, doc_id))
             self._advertise(peer_id, doc_id)
         return peer
 
@@ -76,6 +83,8 @@ class SyncHub:
         self._revealed = {pd for pd in self._revealed if pd[0] != peer_id}
         self._advertised = {pd: c for pd, c in self._advertised.items()
                             if pd[0] != peer_id}
+        self._session_docs = {pd for pd in self._session_docs
+                              if pd[0] != peer_id}
 
     def has_peers(self) -> bool:
         return bool(self._peers)
@@ -102,6 +111,8 @@ class SyncHub:
         return state
 
     def _advertise(self, peer_id: str, doc_id: str):
+        if peer_id not in self._peers:
+            return
         state = self._state(doc_id)
         if state is None:
             return
@@ -115,8 +126,13 @@ class SyncHub:
         state = self._state(doc_id)
         if not less_or_equal(self._matrix.our_clock(doc_id), state.clock):
             raise ValueError("Cannot pass an old state object to a connection")
-        self._had_doc.add(doc_id)
+        for peer_id in self._peers:
+            self._session_docs.add((peer_id, doc_id))
         self._matrix.update_ours(doc_id, state.clock)
+        # quarantined changes whose deps this update satisfied apply now
+        # (the gate's re-entrancy guard makes this a no-op when the update
+        # itself came from a gate drain)
+        inbound_gate(self._doc_set).release(doc_id)
         self.flush()
         # peers that have never revealed a clock for this doc get an
         # advertisement instead of speculative changes (Connection's
@@ -162,23 +178,39 @@ class SyncHub:
 
     # -- inbound --------------------------------------------------------
 
-    def _receive(self, peer_id: str, msg: dict):
+    def _receive(self, peer_id: str, msg: dict, validated: bool = False):
+        if not validated:
+            # typed rejection (ProtocolError) of anything off-schema BEFORE
+            # any state is touched — a malformed message must not advance
+            # believed clocks, document state, or the doc clock
+            msg = validate_msg(msg)
         doc_id = msg["docId"]
+        if peer_id not in self._peers:
+            # late in-flight message for a removed peer (shared contract
+            # with the closed-Connection path)
+            return absorb_msg(self._doc_set, msg)
         if msg.get("clock") is not None:
             # an empty clock still registers the peer for this doc
             self._revealed.add((peer_id, doc_id))
             self._matrix.set_active(peer_id, doc_id)
             self._matrix.update_theirs(peer_id, doc_id, msg["clock"])
         if msg.get("changes"):
-            return self._doc_set.apply_changes(doc_id, msg["changes"])
+            # validated + quarantined application: premature changes park
+            # in the bounded per-doc quarantine; duplicates dedup
+            # idempotently in the backend admission layer
+            return inbound_gate(self._doc_set).deliver(
+                doc_id, msg["changes"], validated=True)
         if self._doc_set.get_doc(doc_id) is not None:
             self._matrix.update_ours(
                 doc_id, Frontend.get_backend_state(
                     self._doc_set.get_doc(doc_id)).clock)
             self.flush()
-        elif doc_id not in self._had_doc and msg.get("clock"):
-            # the peer has a document we never held: request it with an
-            # empty clock (docs we deliberately removed are NOT re-requested
-            # — Connection's `doc_id not in our_clock` guard)
+        elif (peer_id, doc_id) not in self._session_docs \
+                and msg.get("clock"):
+            # the peer has a document this peer session never saw us hold:
+            # request it with an empty clock (docs we deliberately removed
+            # during the session are NOT re-requested — Connection's
+            # `doc_id not in our_clock` guard — but a reconnected peer
+            # starts a fresh session and may re-offer them)
             self._peers[peer_id].send_msg({"docId": doc_id, "clock": {}})
         return self._doc_set.get_doc(doc_id)

@@ -12,9 +12,13 @@ Profiles (each session is deterministic in its seed):
   conflict  same-key / same-element races with partial pairwise sync
   lossy     Connection-protocol sync over a dropping network with churn
   table     concurrent Table row add/update/remove with partial sync
+  chaos     Connection sync over ChaosLink+ResilientChannel (drop/dup/
+            reorder/delay plus one partition/heal cycle) — byte-identical
+            convergence after heal, no reconnects needed
 
 Usage:
   python scripts/soak.py [--profile all] [--sessions 30] [--seed-base 0]
+  python scripts/soak.py --chaos [--sessions 50]     # chaos campaign
 
 Exit 0 iff every session converged; failures print their profile+seed so
 `--profile P --sessions 1 --seed-base SEED` reproduces one exactly.
@@ -282,8 +286,103 @@ def session_table(seed: int) -> None:
     assert ok, f"table seed {seed} diverged: {diff}"
 
 
+def session_chaos(seed: int) -> None:
+    """3-peer Connection sync over a chaotic transport — drop, duplication,
+    reordering, delay, and ONE partition/heal cycle — made survivable by
+    the resilience layer (ResilientChannel seq/ack/retry over ChaosLink).
+
+    Unlike the `lossy` profile, nothing is ever reconnected and no state
+    change is needed for recovery: the channel's retransmit + dedup +
+    in-order release restores the lossless transport the wire protocol
+    assumes, and causally-premature cross-edge arrivals park in the
+    bounded quarantine until their deps land. Convergence is asserted
+    byte-identically: same rendered document AND same serialized change
+    history on every peer."""
+    import json as _json
+
+    am = _am()
+    from automerge_tpu import Connection, DocSet, Text
+    from automerge_tpu.resilience import ChaosLink, ResilientChannel
+
+    rng = np.random.default_rng(seed)
+    n = 3
+    sets = [DocSet() for _ in range(n)]
+    doc0 = am.change(am.init("origin"),
+                     lambda d: d.__setitem__("t", Text("start")))
+    base = am.get_all_changes(doc0)
+    for i, ds in enumerate(sets):
+        ds.set_doc("doc", am.apply_changes(am.init(f"peer-{i}"), base))
+
+    drop = float(rng.uniform(0.05, 0.30))        # ≤ 30% loss
+    dup = float(rng.uniform(0.0, 0.20))          # ≤ 20% duplication
+    reorder = float(rng.uniform(0.05, 0.30))
+    delay = float(rng.uniform(0.0, 0.30))
+    edges = [(a, b) for a in range(n) for b in range(n) if a != b]
+    links, channels, conns = {}, {}, {}
+    for a, b in edges:                            # directed chaos edges
+        links[(a, b)] = ChaosLink(
+            lambda env, a=a, b=b: channels[(b, a)].on_wire(env),
+            rng=rng, drop=drop, dup=dup, reorder=reorder, delay=delay)
+    for a, b in edges:                            # reliability endpoints
+        channels[(a, b)] = ResilientChannel(
+            links[(a, b)].send,
+            lambda msg, a=a, b=b: conns[(a, b)].receive_msg(msg),
+            seed=seed * 7919 + a * 97 + b)
+    for a, b in edges:                            # the UNCHANGED protocol
+        conns[(a, b)] = Connection(sets[a], channels[(a, b)].send)
+        conns[(a, b)].open()
+
+    def pump(rounds: int = 1):
+        for _ in range(rounds):
+            for e in edges:
+                links[e].pump()
+            for e in edges:
+                channels[e].tick()
+
+    n_steps = int(rng.integers(12, 22))
+    part_at = int(rng.integers(2, n_steps - 6))   # one partition/heal cycle
+    part_len = int(rng.integers(2, 6))
+    pa, pb = (int(x) for x in rng.choice(n, 2, replace=False))
+    for step in range(n_steps):
+        if step == part_at:
+            links[(pa, pb)].partition()
+            links[(pb, pa)].partition()
+        if step == part_at + part_len:
+            links[(pa, pb)].heal()
+            links[(pb, pa)].heal()
+        i = int(rng.integers(0, n))
+        sets[i].set_doc("doc", _text_edit(am, sets[i].get_doc("doc"), rng))
+        pump(1)
+    # heal, switch the links lossless, and let retransmission finish the
+    # job — no reconnects, no fresh state changes
+    for e in edges:
+        links[e].heal()
+        links[e].drop = links[e].dup = 0.0
+        links[e].reorder = links[e].delay = 0.0
+    for _ in range(400):
+        pump(1)
+        if all(ch.idle for ch in channels.values()) \
+                and all(ln.idle for ln in links.values()):
+            break
+    else:
+        raise AssertionError(f"chaos seed {seed}: channels never quiesced")
+
+    docs = [ds.get_doc("doc") for ds in sets]
+    ok, diff = _converged(am, docs)
+    assert ok, f"chaos seed {seed} diverged: {diff}"
+    hists = [sorted(_json.dumps(c, sort_keys=True)
+                    for c in am.get_all_changes(d)) for d in docs]
+    assert hists.count(hists[0]) == len(hists), \
+        f"chaos seed {seed}: change histories diverged after heal"
+    for ds in sets:                               # nothing left parked
+        gate = getattr(ds, "_inbound_gate", None)
+        assert not gate or gate.quarantined("doc") == 0, \
+            f"chaos seed {seed}: quarantine not drained"
+
+
 PROFILES = {"general": session_general, "conflict": session_conflict,
-            "lossy": session_lossy, "table": session_table}
+            "lossy": session_lossy, "table": session_table,
+            "chaos": session_chaos}
 
 
 def run(profile: str, sessions: int, seed_base: int) -> int:
@@ -313,10 +412,13 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--profile", default="all",
                     choices=["all"] + list(PROFILES))
+    ap.add_argument("--chaos", action="store_true",
+                    help="shorthand for --profile chaos")
     ap.add_argument("--sessions", type=int, default=30)
     ap.add_argument("--seed-base", type=int, default=0)
     args = ap.parse_args()
-    return run(args.profile, args.sessions, args.seed_base)
+    profile = "chaos" if args.chaos else args.profile
+    return run(profile, args.sessions, args.seed_base)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,718 @@
+"""Resilience layer: validation + quarantine + chaos transport + channel.
+
+The contracts under test (ISSUE 1, docs/INTERNALS.md §7):
+
+- every malformed-message fuzz case raises a typed ``ProtocolError`` (never
+  ``KeyError``/``TypeError``) and leaves document state AND clock
+  bit-identical — so a corrected redelivery is never silently skipped;
+- causally-premature changes park in a BOUNDED quarantine with eviction
+  stats and release automatically when their deps arrive;
+- ``ChaosLink`` is deterministic in its seed; ``ResilientChannel`` restores
+  lossless in-order exactly-once delivery over it;
+- duplicate and reordered redelivery of the same change batch is idempotent
+  at the hub layer on both backends (oracle and device).
+"""
+
+import copy
+import json
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.backend import device as device_backend
+from automerge_tpu.backend import facade as oracle_backend
+from automerge_tpu.resilience import (
+    ChaosLink, ProtocolError, QuarantineQueue, ResilientChannel,
+    validate_msg,
+)
+from automerge_tpu.resilience.inbound import inbound_gate
+from automerge_tpu.sync import Connection, DocSet, SyncHub
+
+
+def _mkdoc(key="x", value=1, actor="alice", backend=None):
+    opts = {"actorId": actor}
+    if backend is not None:
+        opts["backend"] = backend
+    doc = Frontend.init({"backend": am.Backend, **opts}) \
+        if backend is None else Frontend.init(opts)
+    return am.change(doc, lambda d: d.__setitem__(key, value))
+
+
+def _fingerprint(doc_set, doc_id="doc"):
+    """Bit-comparable snapshot of a doc's user state + clock."""
+    doc = doc_set.get_doc(doc_id)
+    if doc is None:
+        return None
+    state = Frontend.get_backend_state(doc)
+    return (json.dumps(am.to_json(doc), sort_keys=True),
+            json.dumps(dict(state.clock), sort_keys=True))
+
+
+# ---------------------------------------------------------------------------
+# wire-message fuzz: typed rejection, untouched state
+# ---------------------------------------------------------------------------
+
+GOOD_CHANGE = {"actor": "bob", "seq": 1, "deps": {},
+               "ops": [{"action": "set", "obj": am.ROOT_ID,
+                        "key": "y", "value": 2}]}
+
+MALFORMED_MSGS = [
+    "not a dict",
+    None,
+    {},                                           # missing docId
+    {"docId": 7, "clock": {}},                    # docId wrong type
+    {"docId": ""},                                # docId empty
+    {"docId": "doc", "clock": "later"},           # clock wrong type
+    {"docId": "doc", "clock": {3: 1}},            # clock key not an actor
+    {"docId": "doc", "clock": {"a": "one"}},      # clock value not an int
+    {"docId": "doc", "clock": {"a": -2}},         # negative seq
+    {"docId": "doc", "changes": {"actor": "a"}},  # changes not an array
+    {"docId": "doc", "changes": ["ch"]},          # change not an object
+    {"docId": "doc", "changes": [{}]},            # change missing actor/seq
+    {"docId": "doc", "changes": [{"actor": "a", "seq": 0, "deps": {},
+                                  "ops": []}]},   # seq < 1
+    {"docId": "doc", "changes": [{"actor": "a", "seq": "1", "deps": {},
+                                  "ops": []}]},   # seq wrong type
+    {"docId": "doc", "changes": [{"actor": "a", "seq": 1,
+                                  "ops": []}]},   # deps missing (strict)
+    {"docId": "doc", "changes": [{"actor": "a", "seq": 1, "deps": [],
+                                  "ops": []}]},   # deps wrong type
+    {"docId": "doc", "changes": [{"actor": "a", "seq": 1,
+                                  "deps": {}}]},  # ops missing
+    {"docId": "doc", "changes": [{"actor": "a", "seq": 1, "deps": {},
+                                  "ops": ["op"]}]},          # op not a dict
+    {"docId": "doc", "changes": [{"actor": "a", "seq": 1, "deps": {},
+                                  "ops": [{"obj": "o"}]}]},  # action missing
+    {"docId": "doc", "changes": [{"actor": "a", "seq": 1, "deps": {},
+                                  "ops": [{"action": "frobnicate",
+                                           "obj": "o", "key": "k"}]}]},
+    {"docId": "doc", "changes": [{"actor": "a", "seq": 1, "deps": {},
+                                  "ops": [{"action": "set",
+                                           "key": "k", "value": 1}]}]},
+    # truncated ops: assigns missing their payload / target
+    {"docId": "doc", "changes": [{"actor": "a", "seq": 1, "deps": {},
+                                  "ops": [{"action": "set",
+                                           "obj": am.ROOT_ID,
+                                           "key": "k"}]}]},
+    {"docId": "doc", "changes": [{"actor": "a", "seq": 1, "deps": {},
+                                  "ops": [{"action": "ins",
+                                           "obj": "o", "key": "_head"}]}]},
+    {"docId": "doc", "changes": [{"actor": "a", "seq": 1, "deps": {},
+                                  "ops": [{"action": "inc",
+                                           "obj": am.ROOT_ID, "key": "k",
+                                           "value": "fast"}]}]},
+    {"docId": "doc", "changes": [{"actor": "a", "seq": 1, "deps": {},
+                                  "ops": [{"action": "link",
+                                           "obj": am.ROOT_ID, "key": "k",
+                                           "value": 9}]}]},
+]
+
+
+class TestMalformedMessageFuzz:
+    @pytest.mark.parametrize("msg", MALFORMED_MSGS,
+                             ids=range(len(MALFORMED_MSGS)))
+    def test_hub_rejects_typed_and_state_untouched(self, msg):
+        ds = DocSet()
+        ds.set_doc("doc", _mkdoc())
+        hub = SyncHub(ds)
+        handle = hub.add_peer("p", lambda m: None)
+        hub.open()
+        before = _fingerprint(ds)
+        with pytest.raises(ProtocolError):
+            handle.receive_msg(msg)
+        assert _fingerprint(ds) == before   # doc + clock bit-identical
+
+    @pytest.mark.parametrize("closed", [False, True])
+    def test_connection_rejects_typed_both_lifecycles(self, closed):
+        ds = DocSet()
+        ds.set_doc("doc", _mkdoc())
+        conn = Connection(ds, lambda m: None)
+        conn.open()
+        if closed:
+            conn.close()
+        before = _fingerprint(ds)
+        for msg in ({"clock": {}},              # missing docId -> KeyError
+                                                # before this layer existed
+                    {"docId": "doc",
+                     "changes": [{"actor": "a", "seq": 1, "deps": {},
+                                  "ops": [{"action": "set",
+                                           "obj": am.ROOT_ID,
+                                           "key": "k"}]}]}):
+            with pytest.raises(ProtocolError):
+                conn.receive_msg(msg)
+        assert _fingerprint(ds) == before
+
+    def test_corrected_redelivery_applies_after_rejection(self):
+        """The acceptance bit: a rejected delivery must not advance the
+        clock, so the corrected redelivery of the same (actor, seq) is
+        NOT skipped as a duplicate."""
+        ds = DocSet()
+        ds.set_doc("doc", _mkdoc())
+        truncated = dict(GOOD_CHANGE,
+                         ops=[{"action": "set", "obj": am.ROOT_ID,
+                               "key": "y"}])
+        with pytest.raises(ProtocolError):
+            ds.deliver("doc", [truncated])
+        ds.deliver("doc", [GOOD_CHANGE])
+        assert am.to_json(ds.get_doc("doc")) == {"x": 1, "y": 2}
+
+    def test_backend_apply_changes_raises_protocol_error(self):
+        """Backend change application shares the validation layer: a
+        structurally malformed change raises ProtocolError (a ValueError),
+        never KeyError/TypeError, on both backends."""
+        for make_state in (oracle_backend.init, device_backend.init):
+            state = make_state()
+            for bad in ([{"actor": "a"}],                 # no seq/ops
+                        [{"actor": "a", "seq": 1, "deps": {},
+                          "ops": [{"action": "set", "key": "k",
+                                   "value": 1}]}],        # op missing obj
+                        # deps-less changes are refused here too: lenient
+                        # admission ships over the wire later, where
+                        # strict peers would reject it — silent divergence
+                        [{"actor": "a", "seq": 1, "ops": []}],
+                        ["nope"], "nope", {"actor": "a"}):
+                with pytest.raises(ProtocolError):
+                    if make_state is oracle_backend.init:
+                        oracle_backend.apply_changes(state, bad)
+                    else:
+                        device_backend.apply_changes(state, bad)
+
+    def test_semantic_rejection_is_wrapped_at_the_gate(self):
+        """A change that passes schema validation but fails mid-apply
+        (unknown object) surfaces as ProtocolError through the wire path,
+        and the backend's restore keeps state + clock bit-identical."""
+        ds = DocSet()
+        ds.set_doc("doc", _mkdoc())
+        before = _fingerprint(ds)
+        ghost = {"actor": "bob", "seq": 1, "deps": {},
+                 "ops": [{"action": "set", "obj": "no-such-object",
+                          "key": "k", "value": 1}]}
+        with pytest.raises(ProtocolError):
+            ds.deliver("doc", [ghost])
+        assert _fingerprint(ds) == before
+
+
+# ---------------------------------------------------------------------------
+# quarantine: bounds, eviction stats, release
+# ---------------------------------------------------------------------------
+
+class TestQuarantine:
+    def test_bounded_with_fifo_eviction_stats(self):
+        q = QuarantineQueue(capacity=3)
+        for seq in range(1, 6):
+            q.park({"actor": "a", "seq": seq, "deps": {}, "ops": []})
+        assert len(q) == 3
+        assert q.stats["parked"] == 5
+        assert q.stats["evicted"] == 2          # seqs 1 and 2 fell out
+        assert q.stats["peak"] == 3
+        assert [c["seq"] for c in q.drain()] == [3, 4, 5]
+
+    def test_reparking_a_duplicate_does_not_consume_capacity(self):
+        q = QuarantineQueue(capacity=2)
+        c = {"actor": "a", "seq": 9, "deps": {}, "ops": []}
+        q.park(c)
+        q.park(dict(c))
+        assert len(q) == 1 and q.stats["parked"] == 1
+
+    def test_premature_changes_park_then_release_in_order(self):
+        """Reordered wire delivery: seq 3 and 2 arrive before seq 1; both
+        park (doc untouched), then one delivery of seq 1 releases the
+        whole chain."""
+        src = am.init("w")
+        for i in range(3):
+            src = am.change(src, lambda d, i=i: d.__setitem__(f"k{i}", i))
+        c1, c2, c3 = am.get_all_changes(src)
+        ds = DocSet()
+        gate = inbound_gate(ds)
+        ds.deliver("doc", [c3])
+        ds.deliver("doc", [c2])
+        assert ds.get_doc("doc") is None        # nothing applied yet
+        assert gate.quarantined("doc") == 2
+        ds.deliver("doc", [c1])
+        assert gate.quarantined("doc") == 0
+        assert am.to_json(ds.get_doc("doc")) == {"k0": 0, "k1": 1, "k2": 2}
+        stats = gate.quarantine_stats("doc")
+        assert stats["released"] == 2 and stats["parked"] == 2
+
+    def test_poisoned_batch_does_not_lose_quarantined_changes(self):
+        """Review regression: a batch that the backend rejects must put
+        previously-quarantined changes BACK — their original delivery was
+        already acked, so nothing upstream would re-send them."""
+        src = am.init("w")
+        src = am.change(src, lambda d: d.__setitem__("a", 1))
+        src = am.change(src, lambda d: d.__setitem__("b", 2))
+        c1, c2 = am.get_all_changes(src)
+        ds = DocSet()
+        gate = inbound_gate(ds)
+        ds.deliver("doc", [c2])                 # parks, awaiting c1
+        assert gate.quarantined("doc") == 1
+        bad = {"actor": "z", "seq": 1, "deps": {},
+               "ops": [{"action": "set", "obj": "no-such-object",
+                        "key": "k", "value": 1}]}
+        with pytest.raises(ProtocolError):
+            ds.deliver("doc", [c1, bad])        # c2 drains into the batch
+        # the poison is isolated: c1 AND the previously-parked c2 both
+        # applied (salvage), only the bad change was rejected
+        assert am.to_json(ds.get_doc("doc")) == {"a": 1, "b": 2}
+        assert gate.quarantined("doc") == 0
+
+    def test_cobatched_poison_does_not_drop_valid_changes(self):
+        """Review regression: one message carrying [valid A, poison B].
+        Transports ack on first delivery and the hub advances believed
+        clocks on send, so A would never be re-sent — the gate must
+        salvage A while rejecting B with the typed error."""
+        src = am.init("w")
+        src = am.change(src, lambda d: d.__setitem__("a", 1))
+        (good,) = am.get_all_changes(src)
+        poison = {"actor": "z", "seq": 1, "deps": {},
+                  "ops": [{"action": "set", "obj": "no-such-object",
+                           "key": "k", "value": 1}]}
+        ds = DocSet()
+        with pytest.raises(ProtocolError):
+            ds.deliver("doc", [copy.deepcopy(good), poison])
+        assert am.to_json(ds.get_doc("doc")) == {"a": 1}   # A survived
+        # a change DEPENDING on the poison parks (premature), not lost
+        dep = {"actor": "y", "seq": 1, "deps": {"z": 1},
+               "ops": [{"action": "set", "obj": am.ROOT_ID,
+                        "key": "d", "value": 4}]}
+        with pytest.raises(ProtocolError):
+            ds.deliver("doc", [copy.deepcopy(dep), copy.deepcopy(poison)])
+        assert inbound_gate(ds).quarantined("doc") == 1
+
+    def test_reentrant_delivery_is_not_stranded(self):
+        """Review regression: a handler relaying a READY change for the
+        same doc back into the gate mid-apply parks it re-entrantly; the
+        outer drain must loop and apply it, not strand it."""
+        src = am.init("w")
+        src = am.change(src, lambda d: d.__setitem__("a", 1))
+        src = am.change(src, lambda d: d.__setitem__("b", 2))
+        c1, c2 = am.get_all_changes(src)
+        ds = DocSet()
+        relayed = []
+
+        def relay(doc_id, doc):
+            if not relayed:                     # once: relay c2 mid-apply
+                relayed.append(True)
+                ds.deliver(doc_id, [c2])
+
+        ds.register_handler(relay)
+        ds.deliver("doc", [c1])
+        assert am.to_json(ds.get_doc("doc")) == {"a": 1, "b": 2}
+        assert inbound_gate(ds).quarantined("doc") == 0
+
+    def test_release_absorbs_remote_poison_without_crashing_local_path(self):
+        """Review regression: a quarantined poison change becoming ready
+        during a LOCAL set_doc must not raise out of the local caller —
+        it is dropped, logged, and counted."""
+        src = am.init("w")
+        src = am.change(src, lambda d: d.__setitem__("a", 1))
+        first = am.get_all_changes(src)
+        ds = DocSet()
+        ds.set_doc("doc", _mkdoc())
+        conn = Connection(ds, lambda m: None)
+        conn.open()
+        poison = {"actor": "z", "seq": 1, "deps": {"w": 1},
+                  "ops": [{"action": "set", "obj": "no-such-object",
+                           "key": "k", "value": 1}]}
+        conn.receive_msg({"docId": "doc", "clock": {"z": 1},
+                          "changes": [poison]})      # premature: parks
+        gate = inbound_gate(ds)
+        assert gate.quarantined("doc") == 1
+        # the local merge makes the poison ready; set_doc must SUCCEED
+        local = am.apply_changes(ds.get_doc("doc"), first)
+        ds.set_doc("doc", local)
+        assert am.to_json(ds.get_doc("doc"))["a"] == 1
+        assert gate.quarantined("doc") == 0          # dropped, not stuck
+        assert gate.stats["parked_rejected"] == 1
+
+    def test_aggregate_quarantine_bound_across_attacker_docids(self):
+        """Review regression: docIds are peer-chosen, so the per-doc
+        bound alone is no bound — the gate caps TOTAL parked changes
+        across all docs and prunes emptied attacker-minted queues."""
+        from automerge_tpu.resilience import inbound as inbound_mod
+
+        ds = DocSet()
+        gate = inbound_mod.InboundGate(ds, capacity=8, global_capacity=32)
+        ds._inbound_gate = gate
+        hub = SyncHub(ds)
+        handle = hub.add_peer("evil", lambda m: None)
+        hub.open()
+        for i in range(200):                # fresh docId per premature change
+            handle.receive_msg({"docId": f"doc-{i}", "clock": {"g": 2},
+                                "changes": [{"actor": "g", "seq": 2,
+                                             "deps": {}, "ops": []}]})
+        assert gate._n_parked <= 32
+        assert sum(gate.quarantined(f"doc-{i}") for i in range(200)) <= 32
+        assert gate.stats["global_evicted"] >= 200 - 32
+        # the tracking dict itself stays bounded too
+        assert len(gate._quarantine) <= 32 + inbound_mod._MAX_IDLE_QUEUES
+
+    def test_parked_poison_not_blamed_on_later_valid_sender(self):
+        """Review regression: peer A's parked poison becoming ready must
+        not raise out of peer B's perfectly valid delivery — it is
+        dropped-and-logged, and B's changes apply."""
+        src = am.change(am.init("w"), lambda d: d.__setitem__("a", 1))
+        first = am.get_all_changes(src)
+        poison = {"actor": "z", "seq": 1, "deps": {"w": 1},
+                  "ops": [{"action": "set", "obj": "no-such-object",
+                           "key": "k", "value": 1}]}
+        ds = DocSet()
+        gate = inbound_gate(ds)
+        ds.deliver("doc", [poison])              # peer A: parks premature
+        assert gate.quarantined("doc") == 1
+        ds.deliver("doc", first)                 # peer B: valid, no raise
+        assert am.to_json(ds.get_doc("doc")) == {"a": 1}
+        assert gate.quarantined("doc") == 0
+        assert gate.stats["parked_rejected"] == 1
+
+    def test_handler_exception_is_not_reported_as_rejection(self):
+        """Review regression: a user change handler raising AFTER the
+        commit must propagate raw (the delivery applied) — wrapping it as
+        a state-untouched ProtocolError would make the sender dedup the
+        corrected redelivery of an already-applied change."""
+        src = am.change(am.init("w"), lambda d: d.__setitem__("a", 1))
+        (c1,) = am.get_all_changes(src)
+        ds = DocSet()
+
+        def angry(doc_id, doc):
+            raise ValueError("handler blew up")
+
+        ds.register_handler(angry)
+        with pytest.raises(ValueError, match="handler blew up") as exc:
+            ds.deliver("doc", [c1])
+        assert not isinstance(exc.value, ProtocolError)
+        assert am.to_json(ds.get_doc("doc")) == {"a": 1}   # committed
+
+    def test_local_merge_releases_parked_changes(self):
+        """Liveness without further network traffic: parked changes whose
+        deps arrive via a LOCAL set_doc (e.g. an am.merge) release through
+        the hub's doc_changed hook."""
+        src = am.init("w")
+        src = am.change(src, lambda d: d.__setitem__("a", 1))
+        first = am.get_all_changes(src)
+        src = am.change(src, lambda d: d.__setitem__("b", 2))
+        second = [c for c in am.get_all_changes(src) if c["seq"] == 2]
+        ds = DocSet()
+        ds.set_doc("doc", _mkdoc())
+        conn = Connection(ds, lambda m: None)
+        conn.open()
+        conn.receive_msg({"docId": "doc", "clock": {"w": 2},
+                          "changes": second})
+        assert am.to_json(ds.get_doc("doc")).get("b") is None  # parked
+        local = am.apply_changes(ds.get_doc("doc"), first)
+        ds.set_doc("doc", local)                # local merge supplies dep
+        assert am.to_json(ds.get_doc("doc")) == {"x": 1, "a": 1, "b": 2}
+        assert inbound_gate(ds).quarantined("doc") == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos transport: determinism + fault injection
+# ---------------------------------------------------------------------------
+
+class TestChaosLink:
+    def _trace(self, seed):
+        got = []
+        link = ChaosLink(got.append, seed=seed, drop=0.3, dup=0.25,
+                         reorder=0.4, delay=0.3)
+        for i in range(80):
+            link.send({"n": i})
+            if i % 3 == 0:
+                link.pump()
+        link.drain()
+        return got, dict(link.stats)
+
+    def test_deterministic_in_seed(self):
+        t1, s1 = self._trace(42)
+        t2, s2 = self._trace(42)
+        t3, s3 = self._trace(43)
+        assert t1 == t2 and s1 == s2
+        assert t1 != t3                     # different seed, different fate
+
+    def test_faults_actually_fire(self):
+        _, stats = self._trace(7)
+        assert stats["dropped"] > 0
+        assert stats["duplicated"] > 0
+        assert stats["reordered"] > 0
+        assert stats["delayed"] > 0
+        assert stats["delivered"] + stats["dropped"] \
+            == stats["sent"] + stats["duplicated"]
+
+    def test_partition_drops_in_flight_and_new_frames(self):
+        got = []
+        link = ChaosLink(got.append, seed=0)
+        link.send({"n": 1})
+        link.partition()
+        link.send({"n": 2})
+        link.drain()
+        assert got == [] and link.stats["partition_dropped"] == 2
+        link.heal()
+        link.send({"n": 3})
+        link.drain()
+        assert got == [{"n": 3}]
+
+    def test_codec_enforces_json_wire_format(self):
+        link = ChaosLink(lambda m: None, seed=0)
+        with pytest.raises(TypeError):
+            link.send({"bad": {1, 2}})      # a set is not wire-JSON
+
+
+# ---------------------------------------------------------------------------
+# resilient channel: retry, dedup, ordering
+# ---------------------------------------------------------------------------
+
+def _duplex(seed, **faults):
+    """Two channel endpoints over two directed chaos links."""
+    parts = {}
+    la = ChaosLink(lambda env: parts["b"].on_wire(env), seed=seed, **faults)
+    lb = ChaosLink(lambda env: parts["a"].on_wire(env), seed=seed + 1,
+                   **faults)
+    got_a, got_b = [], []
+    parts["a"] = ResilientChannel(la.send, got_a.append, seed=seed + 2)
+    parts["b"] = ResilientChannel(lb.send, got_b.append, seed=seed + 3)
+    return parts["a"], parts["b"], la, lb, got_a, got_b
+
+
+class TestResilientChannel:
+    def test_exactly_once_in_order_over_lossy_link(self):
+        for seed in (1, 2, 3):
+            a, b, la, lb, got_a, got_b = _duplex(
+                seed, drop=0.35, dup=0.3, reorder=0.4, delay=0.3)
+            for i in range(30):
+                a.send({"n": i})
+                if i % 2:
+                    b.send({"m": i})
+                la.pump()
+                lb.pump()
+                a.tick()
+                b.tick()
+            for _ in range(200):
+                la.pump()
+                lb.pump()
+                a.tick()
+                b.tick()
+                if a.idle and b.idle and la.idle and lb.idle:
+                    break
+            assert got_b == [{"n": i} for i in range(30)], f"seed {seed}"
+            assert got_a == [{"m": i} for i in range(30) if i % 2], \
+                f"seed {seed}"
+            assert a.idle and b.idle
+
+    def test_retransmits_across_partition(self):
+        a, b, la, lb, got_a, got_b = _duplex(5)
+        la.partition()
+        a.send({"n": 1})
+        for _ in range(8):
+            la.pump()
+            lb.pump()
+            a.tick()
+            b.tick()
+        assert got_b == [] and a.in_flight == 1
+        la.heal()
+        for _ in range(64):
+            la.pump()
+            lb.pump()
+            a.tick()
+            b.tick()
+            if a.idle:
+                break
+        assert got_b == [{"n": 1}]
+        assert a.stats["retransmits"] >= 1
+        assert a.idle
+
+    def test_raising_deliver_keeps_channel_consistent(self):
+        """Review regression: a deliver callback that raises (the shipped
+        wiring propagates ProtocolError from the sync layer) must not
+        corrupt channel state — the ack still goes out, later payloads
+        still release, and the error surfaces to the caller."""
+        wire = []
+        got = []
+
+        def picky(payload):
+            if payload.get("n") == 1:
+                raise ProtocolError("rejected payload")
+            got.append(payload)
+
+        ch = ResilientChannel(wire.append, picky)
+        with pytest.raises(ProtocolError):
+            ch.on_wire({"kind": "data", "seq": 1, "ack": 0,
+                        "payload": {"n": 1}})
+        acks = [e for e in wire if e["kind"] == "ack"]
+        assert acks and acks[-1]["ack"] == 1        # still acked
+        # a retransmit of the rejected frame is a plain dup now
+        ch.on_wire({"kind": "data", "seq": 1, "ack": 0, "payload": {"n": 1}})
+        assert ch.stats["dup_dropped"] == 1
+        # and the stream continues in order past the rejection
+        ch.on_wire({"kind": "data", "seq": 2, "ack": 0, "payload": {"n": 2}})
+        assert got == [{"n": 2}]
+        assert ch.stats["deliver_errors"] == 1
+        assert ch.idle
+
+    def test_synchronous_loopback_retransmit_does_not_crash_tick(self):
+        """Review regression: with a SYNCHRONOUS transport, a retransmit
+        that fills the receiver's gap triggers an inline cumulative ack
+        that mutates _unacked while tick() iterates it — must not
+        KeyError."""
+        parts = {}
+        got = []
+        drop_first = [True]
+
+        def a_to_b(env):
+            if env["kind"] == "data" and env["seq"] == 1 and drop_first[0]:
+                drop_first[0] = False       # lose seq 1 exactly once
+                return
+            parts["b"].on_wire(env)
+
+        parts["a"] = ResilientChannel(a_to_b, lambda m: None, seed=1)
+        parts["b"] = ResilientChannel(
+            lambda env: parts["a"].on_wire(env), got.append, seed=2)
+        for i in range(1, 4):
+            parts["a"].send({"n": i})       # 2, 3 buffer behind the gap
+        for _ in range(8):                  # retransmit of 1 releases all
+            parts["a"].tick()               # synchronously acking 1..3
+            if parts["a"].idle:
+                break
+        assert got == [{"n": 1}, {"n": 2}, {"n": 3}]
+        assert parts["a"].idle
+
+    def test_receive_window_bounds_reorder_buffer(self):
+        """Review regression: a peer streaming frames past an unfilled
+        gap must not grow the reorder buffer without bound — frames
+        beyond the window drop un-acked and redeliver later."""
+        got = []
+        ch = ResilientChannel(lambda e: None, got.append, recv_window=4)
+        for seq in range(2, 50):          # withhold seq 1
+            ch.on_wire({"kind": "data", "seq": seq, "ack": 0,
+                        "payload": {"n": seq}})
+        assert len(ch._recv_buf) <= 4
+        assert ch.stats["window_dropped"] == 45     # seqs 5..49 dropped
+        ch.on_wire({"kind": "data", "seq": 1, "ack": 0, "payload": {"n": 1}})
+        assert got == [{"n": n} for n in range(1, 5)]   # window released
+
+    def test_malformed_envelope_raises_protocol_error(self):
+        ch = ResilientChannel(lambda e: None, lambda m: None)
+        for env in ("x", {}, {"kind": "data", "seq": 1},           # no ack
+                    {"kind": "data", "seq": 1, "ack": 0},          # no payload
+                    {"kind": "warp", "seq": 1, "ack": 0},
+                    {"kind": "data", "seq": "1", "ack": 0, "payload": {}}):
+            with pytest.raises(ProtocolError):
+                ch.on_wire(env)
+
+
+# ---------------------------------------------------------------------------
+# hub idempotency under duplicate + reordered redelivery (both backends)
+# ---------------------------------------------------------------------------
+
+def _backend_doc(kind, actor):
+    if kind == "oracle":
+        return Frontend.init({"actorId": actor,
+                              "backend": oracle_backend.Backend})
+    return Frontend.init({"actorId": actor,
+                          "backend": device_backend.DeviceBackend})
+
+
+@pytest.mark.parametrize("kind", ["oracle", "device"])
+class TestHubRedeliveryIdempotency:
+    def _hub_with_doc(self, kind):
+        ds = DocSet()
+        ds.set_doc("doc", _backend_doc(kind, "h"))
+        hub = SyncHub(ds)
+        box = []
+        handle = hub.add_peer("p", box.append)
+        hub.open()
+        return ds, hub, handle, box
+
+    def _batches(self, kind):
+        src = _backend_doc(kind, "w")
+        src = am.change(src, lambda d: d.__setitem__("a", 1))
+        b1 = am.get_all_changes(src)
+        src = am.change(src, lambda d: d.__setitem__("b", 2))
+        b2 = [c for c in am.get_all_changes(src) if c["seq"] == 2]
+        return b1, b2
+
+    def test_duplicate_batch_is_idempotent(self, kind):
+        ds, hub, handle, _ = self._hub_with_doc(kind)
+        b1, _ = self._batches(kind)
+        msg = {"docId": "doc", "clock": {"w": 1}, "changes": b1}
+        handle.receive_msg(copy.deepcopy(msg))
+        first = _fingerprint(ds)
+        assert json.loads(first[1]) == {"w": 1}
+        for _ in range(3):                  # exact redeliveries: no-ops
+            handle.receive_msg(copy.deepcopy(msg))
+        assert _fingerprint(ds) == first
+
+    def test_reordered_batches_converge(self, kind):
+        ds, hub, handle, _ = self._hub_with_doc(kind)
+        b1, b2 = self._batches(kind)
+        handle.receive_msg({"docId": "doc", "clock": {"w": 2},
+                            "changes": copy.deepcopy(b2)})
+        assert "b" not in am.to_json(ds.get_doc("doc"))   # parked, not lost
+        handle.receive_msg({"docId": "doc", "clock": {"w": 2},
+                            "changes": copy.deepcopy(b1)})
+        snap = am.to_json(ds.get_doc("doc"))
+        assert snap["a"] == 1 and snap["b"] == 2
+        # and a duplicate of the ALREADY-parked-then-applied batch is inert
+        final = _fingerprint(ds)
+        handle.receive_msg({"docId": "doc", "clock": {"w": 2},
+                            "changes": copy.deepcopy(b2)})
+        assert _fingerprint(ds) == final
+
+    def test_inconsistent_seq_reuse_is_protocol_error(self, kind):
+        ds, hub, handle, _ = self._hub_with_doc(kind)
+        b1, _ = self._batches(kind)
+        handle.receive_msg({"docId": "doc", "clock": {"w": 1},
+                            "changes": copy.deepcopy(b1)})
+        before = _fingerprint(ds)
+        forged = copy.deepcopy(b1)
+        forged[0]["ops"][0]["value"] = 999   # same (actor, seq), new body
+        with pytest.raises(ProtocolError):
+            handle.receive_msg({"docId": "doc", "clock": {"w": 1},
+                                "changes": forged})
+        assert _fingerprint(ds) == before
+
+
+class TestGraduationParityUnderRedelivery:
+    def test_wire_path_rejects_unknown_actions_before_graduation(self):
+        """The wire grammar is closed at the sync layer: an unknown op
+        action is a cheap typed rejection at validation time — the device
+        tier never pays the O(history) oracle replay a hostile peer could
+        otherwise trigger at will. (The direct backend API keeps the
+        graduate-then-reject contract: tests/test_graduation.py.)"""
+        device_backend.GRADUATION_STATS.clear()
+        ds = DocSet()
+        ds.set_doc("doc", _backend_doc("device", "h"))
+        b1, _ = TestHubRedeliveryIdempotency()._batches("device")
+        ds.deliver("doc", copy.deepcopy(b1))
+        before = _fingerprint(ds)
+        bad = [{"actor": "z", "seq": 1, "deps": {},
+                "ops": [{"action": "frobnicate", "obj": am.ROOT_ID,
+                         "key": "k"}]}]
+        for _ in range(2):                  # redelivery of the bad batch
+            with pytest.raises(ProtocolError):
+                ds.deliver("doc", copy.deepcopy(bad))
+            assert _fingerprint(ds) == before
+        assert device_backend.GRADUATION_STATS == {}   # never replayed
+        # the document lineage is still device-tier and still usable
+        state = Frontend.get_backend_state(ds.get_doc("doc"))
+        assert isinstance(state, device_backend.DeviceBackendState)
+        ds.deliver("doc", copy.deepcopy(b1))      # dup of the good batch
+        assert _fingerprint(ds) == before
+
+    def test_direct_api_graduation_is_idempotent_under_redelivery(self):
+        """Graduation-path parity: replaying the SAME out-of-scope
+        delivery through the direct backend API graduates each time,
+        rejects each time, and leaves the device lineage byte-identical
+        and usable each time."""
+        device_backend.GRADUATION_STATS.clear()
+        doc = _backend_doc("device", "h")
+        doc = am.change(doc, lambda d: d.__setitem__("x", 1))
+        bad = [{"actor": "z", "seq": 1, "deps": {},
+                "ops": [{"action": "frobnicate", "obj": am.ROOT_ID,
+                         "key": "k"}]}]
+        for n in (1, 2):
+            with pytest.raises(ValueError, match="Unknown operation type"):
+                am.apply_changes(doc, copy.deepcopy(bad))
+            assert device_backend.GRADUATION_STATS == {"out_of_scope": n}
+            assert am.to_json(doc) == {"x": 1}
+        doc = am.change(doc, lambda d: d.__setitem__("y", 2))
+        assert am.to_json(doc) == {"x": 1, "y": 2}

@@ -25,3 +25,12 @@ def test_soak_profile_smoke(profile):
 
 def test_runner_reports_and_exits_cleanly():
     assert soak.run("general", sessions=2, seed_base=100) == 0
+
+
+@pytest.mark.slow
+def test_chaos_campaign_50_sessions():
+    """The ISSUE-1 acceptance bar, runnable on demand (excluded from the
+    tier-1 slice by the registered `slow` marker): 50 seeded 3-peer chaos
+    sessions — drop/dup/reorder/delay plus one partition/heal cycle each —
+    all converge byte-identically."""
+    assert soak.run("chaos", sessions=50, seed_base=0) == 0

@@ -207,6 +207,74 @@ def test_readded_peer_syncs_fresh():
     assert any(m.get("changes") for m in box2), box2
 
 
+def test_readded_peer_rerequests_doc_from_prior_session():
+    """Churn regression (add -> remove -> re-add mid-sync): the
+    don't-re-request-removed-docs guard is scoped to one peer SESSION.
+    A doc the hub held during an old peer session (then removed locally)
+    must be re-requested when the RE-ADDED peer offers it — the old
+    hub-global `_had_doc` suppressed this forever."""
+    ds = DocSet()
+    hub = SyncHub(ds)
+    box = []
+    h = hub.add_peer("q", box.append)
+    hub.open()
+    # session 1: the peer syncs doc D to us mid-sync
+    src = am.change(am.init("w"), lambda d: d.__setitem__("x", 1))
+    h.receive_msg({"docId": "D", "clock": {"w": 1},
+                   "changes": am.get_all_changes(src)})
+    assert am.to_json(ds.get_doc("D")) == {"x": 1}
+    # we drop the doc locally, and the peer churns
+    ds.remove_doc("D")
+    hub.remove_peer("q")
+    box2 = []
+    h2 = hub.add_peer("q", box2.append)
+    # session 2: the same-id peer re-offers D -> must be re-requested
+    h2.receive_msg({"docId": "D", "clock": {"w": 1}})
+    requests = [m for m in box2 if m["docId"] == "D" and m["clock"] == {}]
+    assert requests, f"re-add suppressed the re-request: {box2}"
+    # and the peer's answer resurrects the doc for the new session
+    h2.receive_msg({"docId": "D", "clock": {"w": 1},
+                    "changes": am.get_all_changes(src)})
+    assert am.to_json(ds.get_doc("D")) == {"x": 1}
+
+
+def test_same_session_removed_doc_still_not_rerequested():
+    """The counterpart: WITHIN one peer session the guard still holds
+    (mirrors test_removed_doc_neither_crashes_nor_resurrects, pinned here
+    against the session-scoped rewrite)."""
+    ds = DocSet()
+    hub = SyncHub(ds)
+    box = []
+    h = hub.add_peer("p", box.append)
+    hub.open()
+    src = am.change(am.init("w"), lambda d: d.__setitem__("x", 1))
+    h.receive_msg({"docId": "D", "clock": {"w": 1},
+                   "changes": am.get_all_changes(src)})
+    ds.remove_doc("D")
+    box.clear()
+    h.receive_msg({"docId": "D", "clock": {"w": 1}})
+    assert [m for m in box if m["docId"] == "D"] == [], box
+
+
+def test_late_message_for_removed_peer_absorbed_without_send():
+    """A message in flight when remove_peer ran must neither KeyError nor
+    write to the dead transport; change-bearing frames are still absorbed
+    (the hub-side mirror of the closed-Connection contract)."""
+    ds = DocSet()
+    hub = SyncHub(ds)
+    box = []
+    h = hub.add_peer("p", box.append)
+    hub.open()
+    hub.remove_peer("p")
+    box.clear()
+    src = am.change(am.init("w"), lambda d: d.__setitem__("x", 1))
+    h.receive_msg({"docId": "D", "clock": {"w": 1},
+                   "changes": am.get_all_changes(src)})   # absorbed
+    h.receive_msg({"docId": "D", "clock": {"w": 1}})      # no re-request
+    assert box == []
+    assert am.to_json(ds.get_doc("D")) == {"x": 1}
+
+
 def test_removed_doc_neither_crashes_nor_resurrects():
     ds = DocSet()
     hub = SyncHub(ds)
