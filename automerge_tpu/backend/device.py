@@ -97,6 +97,12 @@ def _in_scope(changes, known_kinds) -> bool:
             action = op.get("action")
             obj = op.get("obj")
             if action in _MAKE_KIND:
+                if obj is None:
+                    # an obj-less make must NOT register known[None]: a
+                    # later obj-less set/del would then pass the scope
+                    # gate on a nonsense pairing — out of scope instead,
+                    # so the oracle tier rejects it properly (ADVICE r5)
+                    return False
                 known[obj] = _MAKE_KIND[action]
             elif action == "link":
                 if obj != ROOT_ID and obj not in known:

@@ -23,7 +23,7 @@ interning order change).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 import numpy as np
@@ -40,15 +40,27 @@ class PreparedBatch:
     round's staged device inputs, so the commit path is pure bookkeeping +
     kernel dispatch — all host->device byte movement already happened.
     This is the engine's ingestion pipelining seam: prepare batch k+1
-    (host planning + transfers) while the device still executes batch k."""
+    (host planning + transfers) while the device still executes batch k.
 
-    gen: int                  # document generation the plan is valid for
+    A plan prepared with `after=` (a pending, not-yet-committed base plan)
+    is CHAINED: it was planned against the base plan's post-commit shadow
+    state, carries `after`, and commits only when the base plan committed
+    and nothing else mutated the document since (committed_gen check) —
+    the seam `engine/pipeline.PipelinedIngestor` uses to plan batch k+1
+    on a background thread while batch k commits."""
+
+    gen: Optional[int]        # document generation the plan is valid for
     rounds: list              # [(batch, rows_arr, book, exec_plan), ...]
     #   book = ([(actor, seq), ...], [allDeps closure, ...]) per round group
     queue_after: list         # queue state once the batch is admitted
     prior_queue: list         # queue state to restore on failure
     memo_overlay: dict        # closure-memo entries minted while planning
     n_staged_bytes: int       # total bytes shipped host->device at prepare
+    after: Optional["PreparedBatch"] = None  # chained base plan (pending)
+    final_shadow: Optional[tuple] = None     # shadow state post this plan
+    clock_after: dict = field(default_factory=dict)  # clock post this plan
+    deps_overlay: dict = field(default_factory=dict)  # (actor, seq)->closure
+    committed_gen: Optional[int] = None      # _gen right after commit
 
 
 def transitive_closure(all_deps: dict, actor: str, seq: int,
@@ -70,6 +82,12 @@ def transitive_closure(all_deps: dict, actor: str, seq: int,
                     out[a] = s
         out[dep_actor] = dep_seq
     return out
+
+
+# batches below this use the per-change admission loop: the numpy column
+# setup costs more than the walk at small sizes (tests monkeypatch it to
+# force either path for parity pinning)
+_BULK_SCHEDULE_MIN = 64
 
 
 class CausalDeviceDoc:
@@ -116,6 +134,25 @@ class CausalDeviceDoc:
             for op in ops:
                 op["actor_rank"] = int(remap[op["actor_rank"]])
         self._invalidate()
+
+    def _intern_actors_append(self, new_actors):
+        """Intern actors WITHOUT ever remapping existing ranks — the only
+        interning a chained prepare may perform, because a remap would
+        invalidate the pending base plan's staged actor columns. Raises
+        ValueError when the new actors would not all rank after the
+        current table (the caller falls back to a fresh, unchained
+        prepare once the base commit lands)."""
+        missing = sorted(set(a for a in new_actors
+                             if a not in self._actor_rank))
+        if not missing:
+            return
+        if self.actor_table and missing[0] < self.actor_table[-1]:
+            raise ValueError(
+                "actor interning would reorder existing ranks; cannot "
+                "chain this prepare onto a pending plan")
+        for a in missing:
+            self._actor_rank[a] = len(self.actor_table)
+            self.actor_table.append(a)
 
     # ------------------------------------------------------------------
     # causality
@@ -173,13 +210,16 @@ class CausalDeviceDoc:
         return self.apply_batch(
             type(self).batch_type.from_changes(changes, self.obj_id))
 
-    def _schedule(self, batch):
+    def _schedule(self, batch, clock=None, prior_queue=None):
         """Admission scheduling: partition the batch + queued items into
         causally-ready rounds over a host clock (no state mutation).
-        Returns (rounds, queue_after, prior_queue)."""
-        prior_queue = list(self.queue)
-        pending = list(range(batch.n_changes)) + self.queue
-        clock = dict(self.clock)
+        Returns (rounds, queue_after, prior_queue). `clock`/`prior_queue`
+        default to the document's live state; a chained prepare passes the
+        pending base plan's post-commit snapshots instead."""
+        prior_queue = list(self.queue if prior_queue is None
+                           else prior_queue)
+        pending = list(range(batch.n_changes)) + prior_queue
+        clock = dict(self.clock if clock is None else clock)
         scheduled: set = set()  # (actor, seq) admitted in this call
         rounds: list = []
         queue_after: list = []
@@ -202,6 +242,14 @@ class CausalDeviceDoc:
                         and not (actor_set & clock.keys())):
                     return ([[(batch, r) for r in range(batch.n_changes)]],
                             [], prior_queue)
+        # bulk path — any other large batch with an empty queue: admission
+        # becomes numpy round passes over (actor rank, seq, deps group)
+        # columns instead of a per-change Python walk per round (the
+        # multi-round causal shapes, cfg5c, paid O(rounds x changes) dict
+        # work here). Bit-equivalent to the loop below by construction;
+        # pinned by tests/test_pipeline.py::test_schedule_bulk_parity.
+        if not prior_queue and batch.n_changes >= _BULK_SCHEDULE_MIN:
+            return self._schedule_bulk(batch, clock, prior_queue)
         while pending:
             ready, not_ready = [], []
             for item in pending:
@@ -235,6 +283,106 @@ class CausalDeviceDoc:
             pending = not_ready
         return rounds, queue_after, prior_queue
 
+    def _schedule_bulk(self, batch, clock0: dict, prior_queue: list):
+        """Vectorized admission for a whole batch (empty prior queue).
+
+        One numpy pass per causal ROUND instead of one Python iteration
+        per change per round: rows carry dense local actor ids and a deps
+        GROUP id (dep dicts interned by identity at batch construction,
+        then deduplicated by content), so the per-round readiness test is
+        a handful of boolean column ops plus one small loop over unique
+        dep groups. Semantics are the loop path's exactly: idempotent
+        duplicate skips, the implicit self-dep override, first-occurrence
+        wins for same-(actor, seq) rows inside one round."""
+        n = batch.n_changes
+        actors = batch.actors
+        seqs = np.asarray(batch.seqs, np.int64)
+
+        aid: dict = {}
+        aidx = np.empty(n, np.int64)
+        for i, a in enumerate(actors):
+            j = aid.get(a)
+            if j is None:
+                j = aid[a] = len(aid)
+            aidx[i] = j
+
+        # deps groups: identity first (intern_deps collapses equal dicts
+        # at batch construction), then content-dedup the handful of
+        # distinct objects so hand-built batches group too
+        gid_by_id: dict = {}
+        group_deps: list = []
+        dgid = np.empty(n, np.int64)
+        for i, d in enumerate(batch.deps):
+            g = gid_by_id.get(id(d))
+            if g is None:
+                g = gid_by_id[id(d)] = len(group_deps)
+                group_deps.append(d)
+            dgid[i] = g
+        by_content: dict = {}
+        remap_g = np.empty(len(group_deps), np.int64)
+        for g, d in enumerate(group_deps):
+            remap_g[g] = by_content.setdefault(
+                tuple(sorted(d.items())), g)
+        dgid = remap_g[dgid]
+
+        for d in group_deps:         # dep-referenced actors need clock rows
+            for a in d:
+                if a not in aid:
+                    aid[a] = len(aid)
+        clock = np.zeros(len(aid), np.int64)
+        for a, j in aid.items():
+            clock[j] = clock0.get(a, 0)
+        g_actor = [np.asarray([aid[a] for a in d], np.int64)
+                   for d in group_deps]
+        g_seq = [np.asarray([s for _, s in d.items()], np.int64)
+                 for d in group_deps]
+
+        rounds: list = []
+        remaining = np.ones(n, bool)
+        while True:
+            idxs = np.flatnonzero(remaining)
+            if not len(idxs):
+                break
+            a_i = aidx[idxs]
+            s_i = seqs[idxs]
+            dup = s_i <= clock[a_i]
+            if dup.any():            # idempotent skips leave pending for good
+                remaining[idxs[dup]] = False
+                idxs = idxs[~dup]
+                a_i, s_i = a_i[~dup], s_i[~dup]
+                if not len(idxs):
+                    continue
+            seq_ready = (s_i <= 1) | (clock[a_i] >= s_i - 1)
+            # per-group dep check; a group's SINGLE failing entry is
+            # forgiven for rows whose own actor it names (the implicit
+            # self-dep override)
+            gs = np.unique(dgid[idxs])
+            n_fail = np.zeros(len(group_deps), np.int64)
+            fail_one = np.full(len(group_deps), -1, np.int64)
+            for g in gs:
+                fa, fs = g_actor[g], g_seq[g]
+                fails = fa[clock[fa] < fs]
+                n_fail[g] = len(fails)
+                if len(fails) == 1:
+                    fail_one[g] = fails[0]
+            gr = dgid[idxs]
+            dep_ok = (n_fail[gr] == 0) | ((n_fail[gr] == 1)
+                                          & (fail_one[gr] == a_i))
+            ready = seq_ready & dep_ok
+            r_idx = idxs[ready]
+            if not len(r_idx):
+                break
+            # same-round same-(actor, seq) rows: first occurrence wins
+            pairk = (aidx[r_idx] << np.int64(32)) | seqs[r_idx]
+            _, first = np.unique(pairk, return_index=True)
+            if len(first) != len(r_idx):
+                r_idx = r_idx[np.sort(first)]
+            remaining[r_idx] = False
+            np.maximum.at(clock, aidx[r_idx], seqs[r_idx])
+            rounds.append([(batch, int(r)) for r in r_idx])
+        queue_after = [(batch, int(r)) for r in np.flatnonzero(remaining)]
+        return rounds, queue_after, prior_queue
+
     def apply_batch(self, batch):
         """Merge a columnar change batch (causally gated, idempotent)."""
         rounds, queue_after, prior_queue = self._schedule(batch)
@@ -256,6 +404,7 @@ class CausalDeviceDoc:
                 it for it in prior_queue
                 if (it[0].actors[it[1]], int(it[0].seqs[it[1]])) not in applied]
             self._gen += 1  # queue changed: invalidate outstanding plans
+            self._plan_failed()
             raise
         self._invalidate()
         return self
@@ -308,15 +457,20 @@ class CausalDeviceDoc:
             clock.update(dict.fromkeys(row_actors, 1))
             return prev_clock, prev_deps
 
+        # mixed round: closures computed grouped by shared deps dict
+        # (rows of one round are causally independent, so computing every
+        # closure against the PRE-round maps is equivalent to the old
+        # insert-as-you-go walk), then committed as bulk dict updates
+        pairs, closures = self._bulk_closures(rows, actors, seqs,
+                                              deps_list, all_deps,
+                                              self._closure_memo)
         prev_clock = {}
         prev_deps = {}
-        for row in rows:
-            actor, seq = actors[row], seqs[row]
+        for (actor, seq), hit in zip(pairs, closures):
             if actor not in prev_clock:
                 prev_clock[actor] = clock.get(actor)
             prev_deps[(actor, seq)] = all_deps.get((actor, seq))
-            all_deps[(actor, seq)] = self._compute_all_deps(
-                actor, seq, deps_list[row])
+            all_deps[(actor, seq)] = hit
             clock[actor] = seq
         return prev_clock, prev_deps
 
@@ -365,7 +519,38 @@ class CausalDeviceDoc:
     # two-phase ingestion (pipelining seam)
     # ------------------------------------------------------------------
 
-    def prepare_batch(self, batch) -> PreparedBatch:
+    def _bulk_closures(self, rows_l, actors, seqs_l, deps_list, all_map,
+                       memo_map):
+        """allDeps closures for one round group's rows, grouped by shared
+        deps OBJECT: seq-1 rows sharing one deps dict share one closure
+        (their memo key is actor-independent), so mixed rounds pay
+        per-distinct-frontier work instead of per-row closure walks.
+        Returns (pairs, closures) aligned with `rows_l`'s order."""
+        pairs: list = [None] * len(rows_l)
+        closures: list = [None] * len(rows_l)
+        by_dep: dict = {}
+        for i, row in enumerate(rows_l):
+            by_dep.setdefault(id(deps_list[row]), []).append(i)
+        for idxs in by_dep.values():
+            d = deps_list[rows_l[idxs[0]]]
+            shared = None
+            for i in idxs:
+                row = rows_l[i]
+                actor, seq = actors[row], seqs_l[row]
+                if seq == 1:
+                    if shared is None:
+                        shared = self._compute_all_deps(
+                            actor, 1, d, all_deps=all_map, memo=memo_map)
+                    hit = shared
+                else:
+                    hit = self._compute_all_deps(
+                        actor, seq, d, all_deps=all_map, memo=memo_map)
+                pairs[i] = (actor, seq)
+                closures[i] = hit
+        return pairs, closures
+
+    def prepare_batch(self, batch, after: Optional[PreparedBatch] = None
+                      ) -> PreparedBatch:
         """Plan + stage a batch without mutating document content.
 
         Runs admission scheduling, per-round host planning (run detection,
@@ -378,32 +563,71 @@ class CausalDeviceDoc:
         mutation between prepare and commit invalidates it (commit raises
         ValueError, document unharmed). Use it to pipeline ingestion —
         prepare batch k+1 while the device executes batch k — or to move
-        transfer latency off the merge critical path."""
-        remap = self._intern_actors(batch.actor_table)
-        if remap is not None:
-            self._apply_remap(remap)
-        rounds, queue_after, prior_queue = self._schedule(batch)
-        # intern queued batches' actors too, BEFORE planning: a remap after
-        # a round was planned would invalidate its staged actor ranks
-        for ready in rounds:
-            for b, _ in ready:
-                if b is not batch:
-                    remap = self._intern_actors(b.actor_table)
-                    if remap is not None:
-                        self._apply_remap(remap)
-        gen = self._gen
-        shadow = self._plan_shadow()
+        transfer latency off the merge critical path.
+
+        `after=` chains this plan onto a PENDING (prepared, uncommitted)
+        base plan: planning runs against the base plan's post-commit
+        shadow/clock/closure state, so a background thread can prepare
+        batch k+1 while the caller thread still commits batch k
+        (engine/pipeline.PipelinedIngestor). A chained plan commits only
+        directly after its base (commit re-checks via the base's
+        committed generation). Chaining never remaps actor ranks — if the
+        batch's actors would reorder the interning table, this raises
+        ValueError and the caller falls back to an unchained prepare."""
+        from collections import ChainMap
+        chain: list = []
+        if after is not None:
+            if after.final_shadow is None:
+                raise ValueError(
+                    "cannot chain prepare onto a plan without shadow state")
+            # append-only interning (raises on reorder) — a remap would
+            # invalidate the pending base plan's staged actor columns
+            self._intern_actors_append(batch.actor_table)
+            p: Optional[PreparedBatch] = after
+            while p is not None:
+                chain.append(p)
+                p = p.after
+            rounds, queue_after, prior_queue = self._schedule(
+                batch, clock=after.clock_after,
+                prior_queue=after.queue_after)
+            for ready in rounds:
+                for b, _ in ready:
+                    if b is not batch:
+                        self._intern_actors_append(b.actor_table)
+            gen = None
+            shadow = after.final_shadow
+            base_clock = after.clock_after
+        else:
+            remap = self._intern_actors(batch.actor_table)
+            if remap is not None:
+                self._apply_remap(remap)
+            rounds, queue_after, prior_queue = self._schedule(batch)
+            # intern queued batches' actors too, BEFORE planning: a remap
+            # after a round was planned would invalidate its staged ranks
+            for ready in rounds:
+                for b, _ in ready:
+                    if b is not batch:
+                        remap = self._intern_actors(b.actor_table)
+                        if remap is not None:
+                            self._apply_remap(remap)
+            gen = self._gen
+            shadow = self._plan_shadow()
+            base_clock = self.clock
         planned_rounds = []
         staged_bytes = 0
         # precompute each round's clock/deps bookkeeping (the allDeps
         # closures) so commit is dict updates only. Later rounds may depend
-        # on closures of earlier rounds of this same plan, which are not in
-        # self._all_deps yet — thread them through overlay maps.
-        from collections import ChainMap
+        # on closures of earlier rounds of this same plan — or of a pending
+        # chained base plan — which are not in self._all_deps yet; thread
+        # them through overlay maps.
         deps_overlay: dict = {}
         memo_overlay: dict = {}
-        all_map = ChainMap(deps_overlay, self._all_deps)
-        memo_map = ChainMap(memo_overlay, self._closure_memo)
+        all_map = ChainMap(deps_overlay,
+                           *[p.deps_overlay for p in chain], self._all_deps)
+        memo_map = ChainMap(memo_overlay,
+                            *[p.memo_overlay for p in chain],
+                            self._closure_memo)
+        clock_after = dict(base_clock)
         for ready in rounds:
             for b, rows_arr, mask in self._group_round(ready):
                 actors, deps_list = b.actors, b.deps
@@ -419,15 +643,11 @@ class CausalDeviceDoc:
                     closures = [hit] * len(rows_l)
                     deps_overlay.update(dict.fromkeys(pairs, hit))
                 else:
-                    pairs, closures = [], []
-                    for row in rows_l:
-                        actor, seq = actors[row], seqs_l[row]
-                        hit = self._compute_all_deps(
-                            actor, seq, deps_list[row], all_deps=all_map,
-                            memo=memo_map)
-                        deps_overlay[(actor, seq)] = hit
-                        pairs.append((actor, seq))
-                        closures.append(hit)
+                    pairs, closures = self._bulk_closures(
+                        rows_l, actors, seqs_l, deps_list, all_map,
+                        memo_map)
+                    deps_overlay.update(zip(pairs, closures))
+                clock_after.update(pairs)
                 exec_plan = None
                 if b.n_ops:
                     exec_plan, shadow = self._plan_round(b, mask, shadow)
@@ -446,13 +666,25 @@ class CausalDeviceDoc:
                              queue_after=queue_after,
                              prior_queue=prior_queue,
                              memo_overlay=memo_overlay,
-                             n_staged_bytes=staged_bytes)
+                             n_staged_bytes=staged_bytes,
+                             after=after, final_shadow=shadow,
+                             clock_after=clock_after,
+                             deps_overlay=deps_overlay)
 
     def commit_prepared(self, prepared: PreparedBatch):
         """Commit a `prepare_batch` plan: clock/deps bookkeeping + staged
         kernel dispatch. Raises ValueError (document untouched) if the
-        document mutated since the plan was prepared."""
-        if prepared.gen != self._gen:
+        document mutated since the plan was prepared — for a chained plan,
+        if its base plan has not committed or anything mutated since."""
+        if prepared.committed_gen is not None:
+            raise ValueError("prepared batch already committed; re-prepare")
+        if prepared.after is not None:
+            base = prepared.after
+            if base.committed_gen is None or base.committed_gen != self._gen:
+                raise ValueError(
+                    "document changed since prepare_batch; re-prepare the "
+                    "batch")
+        elif prepared.gen != self._gen:
             raise ValueError(
                 "document changed since prepare_batch; re-prepare the batch")
         self.queue = prepared.queue_after
@@ -478,9 +710,23 @@ class CausalDeviceDoc:
                 it for it in prepared.prior_queue
                 if (it[0].actors[it[1]], int(it[0].seqs[it[1]])) not in applied]
             self._gen += 1  # queue changed: invalidate outstanding plans
+            self._plan_failed()
             raise
         self._invalidate()
+        # stamp AFTER the final invalidation: a chained follow-up plan
+        # commits iff _gen still equals this value (nothing else mutated)
+        prepared.committed_gen = self._gen
+        # sever consumed state: the rounds' staged device buffers are
+        # spent, and the base link's committed_gen check has passed — a
+        # long pipelined session must not retain every plan (and its
+        # device arrays) back to session start through the after-chain
+        prepared.rounds = []
+        prepared.after = None
         return self
+
+    def _plan_failed(self):
+        """Hook: a batch application raised after partial device work.
+        Subclasses drop host caches that can no longer be trusted."""
 
     def _plan_shadow(self):
         raise NotImplementedError(

@@ -67,11 +67,27 @@ class ElemRangeIndex:
         applyInsert)."""
         if len(starts) == 0:
             return self
-        starts = np.concatenate([self.starts, starts.astype(np.int64)])
-        lens = np.concatenate([self.lens, lens.astype(np.int64)])
-        slots = np.concatenate([self.slots, slots.astype(np.int64)])
-        order = np.argsort(starts, kind="stable")
-        starts, lens, slots = starts[order], lens[order], slots[order]
+        # sort only the NEW ranges (K log K), then place them into the
+        # already-sorted index with one searchsorted + insert (O(R + K))
+        # instead of re-argsorting all R + K ranges per round — the index
+        # grows with document lifetime, the round's minted ranges do not.
+        # Equal-start collisions order new-before-old; both orders raise
+        # DuplicateElemId below (every range has len >= 1).
+        new_starts = starts.astype(np.int64)
+        new_lens = lens.astype(np.int64)
+        new_slots = slots.astype(np.int64)
+        if len(new_starts) > 1:
+            order = np.argsort(new_starts, kind="stable")
+            new_starts = new_starts[order]
+            new_lens = new_lens[order]
+            new_slots = new_slots[order]
+        if self.n_ranges == 0:
+            starts, lens, slots = new_starts, new_lens, new_slots
+        else:
+            pos = np.searchsorted(self.starts, new_starts, side="left")
+            starts = np.insert(self.starts, pos, new_starts)
+            lens = np.insert(self.lens, pos, new_lens)
+            slots = np.insert(self.slots, pos, new_slots)
         ends = starts + lens
         if len(starts) > 1:
             bad = np.flatnonzero(ends[:-1] > starts[1:])

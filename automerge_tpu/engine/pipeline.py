@@ -1,0 +1,290 @@
+"""Host-side planning parallelism + the pipelined ingestion driver.
+
+Round-5 profiling (docs/PROFILE_r5.md, BENCH_LAST_GOOD.json) put the
+device commit region at ~87 ms while end-to-end trailed 3.5x behind it:
+host planning (`prepare_s` 0.215 s) and the d2h text pull each outweigh
+the commit, and the in-process overlap schedule LOST to serial even
+though the same seam paid 1.697x on separate processors (cfg5d on-chip).
+This module closes the planning half of that gap:
+
+- `planner_pool()` — one small shared ThreadPoolExecutor. Every heavy
+  planning pass (the native run-detection walker, numpy column passes)
+  releases the GIL, so sharding one batch's planning across a few
+  threads runs at real parallelism on multicore hosts and costs nothing
+  on one core (`AMTPU_PLAN_WORKERS=1` disables sharding).
+- `stage_h2d()` — chunked, asynchronous host->device staging via
+  `jax.device_put`. Large value blobs split into chunks so transfers
+  start flowing while later planning still runs, instead of one
+  monolithic copy at the end; the prepare-side completion barrier
+  (engine/base.py prepare_batch) is unchanged and still guarantees the
+  plan's buffers are resident before commit.
+- `PipelinedIngestor` — the double-buffered background planner: a worker
+  thread prepares batch k+1 *chained onto* batch k's still-uncommitted
+  plan (engine/base.py `prepare_batch(after=...)`) while the caller
+  thread commits batch k and the device executes its kernels. Two
+  PreparedBatch slots bound the speculation; every commit is
+  generation-checked, and a mismatch (the document mutated outside the
+  pipeline) falls back to a fresh inline prepare instead of corrupting
+  state. This is what makes `bench.py run_overlapped` a true pipeline in
+  ONE process: host planning of round k+1, host bookkeeping of round k,
+  and device execution of round k genuinely overlap.
+
+Jiffy's batch-update/snapshot split and PAM's bulk-parallel map
+construction (PAPERS.md) are the shape being reproduced: bulk-plan on
+the host in parallel, commit as pure dispatch.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+
+import numpy as np
+
+_POOL = None
+_POOL_LOCK = threading.Lock()
+
+
+def plan_workers() -> int:
+    """Worker count for sharded planning. 1 disables sharding."""
+    try:
+        w = int(os.environ.get("AMTPU_PLAN_WORKERS", "0"))
+    except ValueError:
+        w = 0
+    if w <= 0:
+        w = min(4, os.cpu_count() or 1)
+    return max(1, w)
+
+
+def planner_pool():
+    """The ONE shared planning pool (lazy; None when workers == 1)."""
+    global _POOL
+    if plan_workers() == 1:
+        return None
+    with _POOL_LOCK:
+        if _POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _POOL = ThreadPoolExecutor(
+                max_workers=plan_workers(),
+                thread_name_prefix="amtpu-plan")
+    return _POOL
+
+
+def _chunk_elems(arr: np.ndarray) -> int:
+    """Elements per staging chunk (env-tunable byte budget)."""
+    try:
+        mb = float(os.environ.get("AMTPU_STAGE_CHUNK_MB", "4"))
+    except ValueError:
+        mb = 4.0
+    if mb <= 0:
+        return 0
+    return max(1, int(mb * (1 << 20)) // max(1, arr.dtype.itemsize))
+
+
+def stage_h2d(arr: np.ndarray):
+    """Asynchronously stage a host array to the default device.
+
+    1-D arrays above the chunk budget ship as several `jax.device_put`
+    calls reassembled with one device-side concatenate: each chunk's
+    transfer is enqueued immediately (device_put does not block), so
+    byte movement overlaps the remaining host planning instead of
+    serializing after it. Small arrays and matrices ship whole. The
+    caller still owns the completion barrier."""
+    import jax
+    import jax.numpy as jnp
+    ce = _chunk_elems(arr)
+    if arr.ndim != 1 or ce == 0 or len(arr) <= ce:
+        return jax.device_put(arr)
+    parts = [jax.device_put(arr[i: i + ce])
+             for i in range(0, len(arr), ce)]
+    return jnp.concatenate(parts)
+
+
+class PipelineError(RuntimeError):
+    """A background prepare failed; the original exception chains."""
+
+
+_SERIAL = object()   # worker marker: batch not chainable, prepare inline
+
+
+class PipelinedIngestor:
+    """Double-buffered background planner for one CausalDeviceDoc.
+
+    Contract: while a pipeline session is open, the document is mutated
+    ONLY through it. The worker thread prepares each fed batch chained
+    onto the previous (still pending) plan's shadow state
+    (`prepare_batch(after=...)`), so planning of batch k+1 overlaps both
+    the caller's commit bookkeeping for batch k and the device's kernel
+    execution; `slots` bounds the speculation depth (2 = classic double
+    buffering). Commits stay generation-checked: if the document moved
+    under a pending plan (outside mutation, or a chained base that
+    failed), `flush()` degrades that batch to a fresh inline
+    prepare+commit — semantics are always exactly apply_batch's.
+
+    Batches whose actor interning would reorder existing ranks cannot be
+    planned concurrently with an uncommitted base (the remap would
+    invalidate the base plan's staged columns — see
+    engine/base.py prepare_batch); the worker marks those and the caller
+    prepares them serially after the preceding commit. Wide merge loads
+    intern fresh actors in lexicographic append position, so the chained
+    path is the common case.
+    """
+
+    def __init__(self, doc, slots: int = 2):
+        self.doc = doc
+        self._n_slots = max(1, slots)
+        self._slots = threading.Semaphore(self._n_slots)
+        self._in: "queue.Queue" = queue.Queue()
+        self._out: "queue.Queue" = queue.Queue()
+        self._n_fed = 0
+        self._total_fed = 0
+        self._cv = threading.Condition()
+        self._n_committed = 0
+        self._fallbacks = 0     # commits that degraded to a fresh prepare
+        self._closing = False
+        # serializes prepare_batch calls between the worker and the
+        # caller's degraded-path inline re-prepares (commit_next): two
+        # concurrent UNCHAINED prepares could race actor interning
+        self._prep_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._worker, name="amtpu-pipeline", daemon=True)
+        self._started = False
+
+    # -- context manager -------------------------------------------------
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # a clean exit commits everything still in flight — silently
+        # dropping fed batches would violate the apply_batch-equivalence
+        # contract; an exceptional exit just tears the worker down
+        try:
+            if exc_type is None:
+                self.flush()
+        finally:
+            self.close()
+        return False
+
+    def close(self):
+        """Terminal: a closed ingestor cannot be fed again (its worker
+        thread is joined; start a new instance for a new session)."""
+        with self._cv:
+            self._closing = True
+            self._cv.notify_all()       # unpark a quiescence wait
+        if self._started:
+            self._in.put(None)
+            self._thread.join()
+            self._started = False
+
+    # -- feeding / committing --------------------------------------------
+    def feed(self, batch):
+        """Queue a batch for background planning. At the `slots` bound,
+        feed COMMITS the oldest in-flight batch inline instead of
+        blocking — commits happen on the caller thread only, so waiting
+        on the semaphore with a full pipeline would deadlock (nobody
+        else can drain it)."""
+        if self._closing:
+            raise RuntimeError("PipelinedIngestor is closed")
+        if not self._started:
+            self._thread.start()
+            self._started = True
+        while not self._slots.acquire(blocking=False):
+            self.commit_next()
+        self._in.put((self._total_fed, batch))
+        self._total_fed += 1
+        self._n_fed += 1
+
+    def commit_next(self):
+        """Commit the oldest fed batch (blocking on its prepare)."""
+        if self._n_fed <= 0:
+            raise RuntimeError("commit_next with no batch fed")
+        self._n_fed -= 1
+        batch, plan, err = self._out.get()
+        try:
+            if err is not None:
+                raise PipelineError(
+                    "background prepare failed") from err
+            if plan is _SERIAL:
+                with self._prep_lock:
+                    plan = self.doc.prepare_batch(batch)
+            try:
+                self.doc.commit_prepared(plan)
+            except ValueError:
+                # generation mismatch: the document moved under the
+                # pending plan — re-plan against live state and commit
+                # (the documented degraded path, never silent corruption).
+                # Bump the fallback epoch so the worker abandons the now-
+                # dead chain base instead of chaining onto it forever.
+                with self._cv:
+                    self._fallbacks += 1
+                with self._prep_lock:
+                    plan = self.doc.prepare_batch(batch)
+                self.doc.commit_prepared(plan)
+        finally:
+            with self._cv:
+                self._n_committed += 1
+                self._cv.notify_all()
+            self._slots.release()
+
+    def flush(self):
+        """Commit every batch still in flight; returns the document."""
+        while self._n_fed:
+            self.commit_next()
+        return self.doc
+
+    def run(self, batches):
+        """Pipeline a whole sequence: feed + commit with `slots` lag."""
+        for b in batches:
+            self.feed(b)
+            # drain down to (slots - 1) speculative plans so the worker
+            # keeps its lookahead while feed() can never block on an
+            # exhausted semaphore (slots=1 degrades to a serial schedule)
+            while self._n_fed >= self._n_slots:
+                self.commit_next()
+        return self.flush()
+
+    # -- worker ----------------------------------------------------------
+    def _worker(self):
+        base = None       # the previous (possibly uncommitted) plan
+        seen_fallbacks = 0
+        while True:
+            item = self._in.get()
+            if item is None:
+                return
+            k, batch = item
+            plan = err = None
+            try:
+                with self._cv:
+                    if self._fallbacks != seen_fallbacks:
+                        # a commit degraded to a fresh inline prepare:
+                        # any pending chain base is dead (its
+                        # committed_gen will never match) — drop it and
+                        # re-enter via the quiescence path
+                        seen_fallbacks = self._fallbacks
+                        base = None
+                if base is None:
+                    # no pending plan to chain onto: a live-state prepare
+                    # must not race a commit still mutating the document,
+                    # so wait until every earlier batch has committed
+                    with self._cv:
+                        self._cv.wait_for(
+                            lambda: self._n_committed >= k
+                            or self._closing)
+                    if self._closing and self._n_committed < k:
+                        # abandoned session: hand the batch back serial
+                        self._out.put((batch, _SERIAL, None))
+                        continue
+                try:
+                    with self._prep_lock:
+                        plan = self.doc.prepare_batch(batch, after=base)
+                except ValueError:
+                    # not chainable (actor remap / missing shadow):
+                    # the caller prepares this one inline after the
+                    # preceding commit lands
+                    plan = _SERIAL
+            except BaseException as e:   # pragma: no cover - defensive
+                err = e
+                plan = None
+            self._out.put((batch, plan, err))
+            base = plan if plan not in (None, _SERIAL) else None

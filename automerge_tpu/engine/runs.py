@@ -83,7 +83,31 @@ def detect_runs(kind, ta, tc, pa, pc, val64, op_row, base_elems: int
     """Partition one round's op columns into runs and residual ops.
 
     `base_elems` is the document's live element count before this round;
-    inserted elements take slots base_elems+1.. in op order."""
+    inserted elements take slots base_elems+1.. in op order.
+
+    Batches above `_SHARD_MIN_OPS` shard across the planning worker pool
+    (engine/pipeline.planner_pool): the walk is embarrassingly parallel
+    once split at change boundaries — every pair/continuation predicate
+    compares adjacent ops of EQUAL change row, so no run or pair spans a
+    boundary where the change row differs, and per-shard detection with a
+    slot base offset by the preceding shards' insert counts concatenates
+    into the exact unsharded partition (pinned bit-identical by
+    tests/test_pipeline.py). The native walker and the numpy passes both
+    release the GIL, so shards run at real parallelism on multicore
+    hosts; one worker (AMTPU_PLAN_WORKERS=1) short-circuits to the
+    single-shard path."""
+    n_ops = len(kind)
+    if n_ops >= _SHARD_MIN_OPS:
+        plan = _detect_runs_sharded(kind, ta, tc, pa, pc, val64, op_row,
+                                    base_elems)
+        if plan is not None:
+            return plan
+    return _detect_runs_single(kind, ta, tc, pa, pc, val64, op_row,
+                               base_elems)
+
+
+def _detect_runs_single(kind, ta, tc, pa, pc, val64, op_row,
+                        base_elems: int) -> RoundPlan:
     n_ops = len(kind)
     from ..native import detect_runs_native
     native = detect_runs_native(kind, ta, tc, pa, pc, val64, op_row,
@@ -97,6 +121,58 @@ def detect_runs(kind, ta, tc, pa, pc, val64, op_row, base_elems: int
                          blob_lt_128=lt128, blob_lt_256=lt256)
     return _detect_runs_numpy(kind, ta, tc, pa, pc, val64, op_row,
                               base_elems)
+
+
+_SHARD_MIN_OPS = 1 << 18     # below this, thread fan-out costs more than
+                             # the walk itself
+
+
+def _detect_runs_sharded(kind, ta, tc, pa, pc, val64, op_row,
+                         base_elems: int):
+    """Parallel shard-and-concatenate form of `_detect_runs_single`;
+    None when sharding is unavailable (one worker, or no usable change
+    boundary to split at)."""
+    from .pipeline import plan_workers, planner_pool
+    pool = planner_pool()
+    if pool is None:
+        return None
+    w = plan_workers()
+    n_ops = len(kind)
+    bounds = np.flatnonzero(op_row[1:] != op_row[:-1]) + 1
+    if not len(bounds):
+        return None
+    targets = np.arange(1, w) * (n_ops // w)
+    cuts = np.unique(bounds[np.clip(
+        np.searchsorted(bounds, targets), 0, len(bounds) - 1)])
+    # bounds lie in [1, n_ops-1], so the endpoints stay sorted-unique
+    cuts = np.concatenate(([0], cuts, [n_ops]))
+    if len(cuts) < 3:
+        return None
+
+    is_ins = kind == KIND_INS
+    shard_ins = np.add.reduceat(is_ins.astype(np.int64), cuts[:-1])
+    shard_base = base_elems + np.concatenate(
+        ([0], np.cumsum(shard_ins)[:-1]))
+
+    def one(i):
+        s, e = int(cuts[i]), int(cuts[i + 1])
+        return _detect_runs_single(
+            kind[s:e], ta[s:e], tc[s:e], pa[s:e], pc[s:e], val64[s:e],
+            op_row[s:e], int(shard_base[i]))
+
+    plans = list(pool.map(one, range(len(cuts) - 1)))
+    offs = cuts[:-1]
+    return RoundPlan(
+        n_ops=n_ops,
+        n_ins=int(shard_ins.sum()),
+        hpos=np.concatenate([p.hpos + o for p, o in zip(plans, offs)]),
+        run_len=np.concatenate([p.run_len for p in plans]),
+        head_slot=np.concatenate([p.head_slot for p in plans]),
+        rpos=np.concatenate([p.rpos + o for p, o in zip(plans, offs)]),
+        res_new_slot=np.concatenate([p.res_new_slot for p in plans]),
+        blob=np.concatenate([p.blob for p in plans]),
+        blob_lt_128=all(p.blob_lt_128 for p in plans),
+        blob_lt_256=all(p.blob_lt_256 for p in plans))
 
 
 def _detect_runs_numpy(kind, ta, tc, pa, pc, val64, op_row,

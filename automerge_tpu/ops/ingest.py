@@ -784,6 +784,38 @@ def _slice_live(cols, L):
     return tuple(c[:L] for c in cols)
 
 
+@partial(jax.jit, static_argnames=("S", "L"))
+def segment_visible_counts(has_value, n_elems, segplan,
+                           *, S: int, L: int = None):
+    """Per-segment VISIBLE character counts — the dirty-span descriptor
+    feed for the incremental text pull (engine/text_doc.DeviceTextDoc
+    `_text_incremental`).
+
+    The host mirror knows the segment structure exactly (heads, order,
+    positions: engine/segments.SegmentMirror) but visibility is data the
+    device owns, so an incremental pull fetches this one S-sized row —
+    tens of KB — instead of the whole O(doc) codes buffer, and the host
+    derives every changed span's [visible start, length) from it. Same
+    seg_vis formulation as `_materialize_core_planned`; `segplan` is the
+    mirror's packed plan (row 0 = head slots, row 3 meta[0] = n_segs)."""
+    hv = _slice_live((has_value,), L)[0]
+    C = hv.shape[0]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    vis = hv & (idx >= 1) & (idx <= n_elems)
+    cumvis = jnp.cumsum(vis.astype(jnp.int32))
+    heads_raw = segplan[0]
+    n_segs = segplan[3, 0]
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    live_seg = (sidx >= 1) & (sidx <= n_segs)
+    heads = jnp.clip(heads_raw, 0, C - 1)
+    next_head = jnp.where((sidx + 1 <= n_segs) & (sidx + 1 < S),
+                          heads_raw[jnp.clip(sidx + 1, 0, S - 1)],
+                          n_elems + 1)
+    head_pre = cumvis[heads] - vis[heads].astype(jnp.int32)
+    last = jnp.clip(next_head - 1, 0, C - 1)
+    return jnp.where(live_seg, cumvis[last] - head_pre, 0)
+
+
 @partial(jax.jit, static_argnames=("S", "as_u8", "L"))
 def materialize_text(parent, ctr, actor, value, has_value, chain, n_elems,
                      *, S: int, as_u8: bool = False, L: int = None):

@@ -21,11 +21,39 @@ Everything is static-shape and jittable; total work O(n log n), depth O(log n).
 from __future__ import annotations
 
 import math
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 
 HEAD = 0  # index 0 is the virtual head of the list
+
+
+@partial(jax.jit, static_argnames=("P",))
+def gather_spans(codes, spans, *, P: int):
+    """Gather arbitrary [start, start+len) spans of `codes` into ONE dense
+    buffer of bucketed static length `P` — the device half of the
+    incremental text pull: D changed spans ship d2h as a single transfer
+    of O(edits) bytes instead of the whole O(doc) codes buffer (or D
+    separate RTT-bound fetches).
+
+    `spans` is a packed (2, D) int32 matrix [starts, lens] (padding rows:
+    len 0). Output element j belongs to the span whose cumulative-length
+    interval contains j (a searchsorted over the running ends — zero-
+    length padding collapses to duplicate ends, which side='right' skips);
+    positions past the live total return 0."""
+    starts, lens = spans[0], spans[1]
+    D = starts.shape[0]
+    ends = jnp.cumsum(lens)
+    total = ends[D - 1]
+    begins = ends - lens
+    j = jnp.arange(P, dtype=jnp.int32)
+    span_of = jnp.clip(jnp.searchsorted(ends, j, side="right"), 0, D - 1)
+    pos = starts[span_of] + (j - begins[span_of])
+    C = codes.shape[0]
+    pos = jnp.clip(jnp.where(j < total, pos, 0), 0, C - 1)
+    out = codes[pos]
+    return jnp.where(j < total, out, jnp.zeros((), codes.dtype))
 
 
 def _doubling_steps(n: int) -> int:

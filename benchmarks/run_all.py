@@ -417,6 +417,51 @@ def config5d_overlap(n_actors: int = 10_000, quick: bool = False):
                     tracking_only_wan("overlapped <= 1.15x serial")))
 
 
+def config5e_incremental_pull(n_base: int = 1_000_000, n_actors: int = 20,
+                              ops_per_change: int = 100,
+                              quick: bool = False):
+    """Incremental text pull: a SMALL merge into a large warm document,
+    then `text()`. The host string cache + dirty-span reconciliation
+    (engine/text_doc._text_incremental) must ship O(edits) bytes d2h —
+    asserted on the ENGINE-REPORTED span bytes, not wall clock, so the
+    row gates identically on cpu and through the tunnel. Reports the
+    bytes a full pull would have moved for scale."""
+    import bench as B
+    from automerge_tpu.engine import DeviceTextDoc
+
+    if quick:
+        n_base = 100_000
+    doc = DeviceTextDoc("t")
+    doc.eager_materialize = True
+    doc.apply_batch(B.base_batch("t", n_base))
+    doc.text()                         # warm pull seeds the host cache
+    assert doc._text_cache is not None, "text cache failed to seed"
+    batch = B.merge_batch("t", n_actors, ops_per_change, n_base, seed=11,
+                          actor_prefix="inc")
+    doc.apply_batch(batch)
+    t0 = time.time()
+    text = doc.text()
+    pull_s = time.time() - t0
+    edit_chars = n_actors * (ops_per_change // 2)
+    assert len(text) == n_base + edit_chars
+    stats = doc.pull_stats
+    assert stats["mode"] == "incremental", stats
+    # O(edits): the merge inserted edit_chars visible chars; allow slack
+    # for the S-sized seg-info row but nothing close to the doc itself
+    budget = 4 * edit_chars + stats.get("info_bytes", 0) + 4096
+    assert stats["span_bytes"] <= budget, (stats, budget)
+    emit(f"cfg5e_incremental_pull_{n_base // 1000}k_doc",
+         stats["span_bytes"], "bytes_pulled",
+         pull_s=round(pull_s, 4),
+         n_spans=stats["n_spans"],
+         info_bytes=stats.get("info_bytes", 0),
+         full_pull_bytes=n_base + edit_chars,
+         edit_chars=edit_chars,
+         threshold="asserted in code: span_bytes <= 4x edit chars + "
+                   "seg-info row (O(edits), not O(doc)); byte-count "
+                   "gate, platform-independent")
+
+
 def config5c_two_causal_rounds(n_actors: int = 10_000, quick: bool = False):
     """Adversarial headline shape: every actor delivers TWO causally
     chained changes (seq 2 depends on seq 1), so the merge cannot be one
@@ -874,6 +919,15 @@ def main():
                   f"({out.stdout[-120:]!r}); continuing with configs",
                   file=sys.stderr)
             return
+        if rec.get("stale"):
+            # a stale record is the BEST-OF fallback from some earlier
+            # chip session, not a measurement of this sweep — folding it
+            # in would stamp it with this sweep's platform/round and
+            # launder best-of semantics into a fresh row (ADVICE r5)
+            print("# headline bench served a stale last-good record "
+                  f"({rec.get('stale_reason', '')!r:.120}); not folding "
+                  "it into this sweep's record", file=sys.stderr)
+            return
         from benchmarks.common import RESULTS, _platform
         # stamp provenance on the folded-in headline row too (bench.py
         # emits raw JSON; the subprocess shares this process's platform)
@@ -889,6 +943,7 @@ def main():
         lambda: config5b_residual_heavy(quick=quick),
         lambda: config5c_two_causal_rounds(quick=quick),
         lambda: config5d_overlap(quick=quick),
+        lambda: config5e_incremental_pull(quick=quick),
         config6_conflict_heavy,
         lambda: config7_interactive_latency(n_changes=20 if quick else 60),
         lambda: config7b_nested_under_large_root(

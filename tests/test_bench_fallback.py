@@ -88,7 +88,21 @@ def test_no_device_serves_stale_last_good(stash_last_good):
     line = json.loads(out.stdout.strip().splitlines()[-1])
     assert line["value"] == 123
     assert line["stale"] is True
-    assert "last locally recorded on-chip run" in line["stale_reason"]
+    # best-of semantics stated as such, with provenance — NOT presented
+    # as "the latest run" (ADVICE r5)
+    assert "best verified on-chip run" in line["stale_reason"]
+    assert "git_sha" in line["stale_reason"]
+
+
+def test_corrupt_last_good_degrades_not_crashes(stash_last_good):
+    """A truncated/corrupt BENCH_LAST_GOOD.json must behave exactly like
+    a missing one (rc=3 refusing to hang), not crash the fallback
+    (ADVICE r5)."""
+    with open(LAST_GOOD, "w") as fh:
+        fh.write('{"metric": "ops_per_sec_merged')     # torn mid-write
+    out = _run_bench({})
+    assert out.returncode == 3, (out.stdout, out.stderr)
+    assert "unreadable" in out.stderr or "no last-good" in out.stderr
 
 
 def test_preflight_hang_path(monkeypatch):

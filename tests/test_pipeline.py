@@ -1,0 +1,296 @@
+"""The pipelined ingestion seam (engine/pipeline + chained prepare):
+equivalence with serial application, the overlap-never-loses invariant,
+generation-checked aborts of background-planned batches, and bit-parity
+of the sharded planning passes with their single-threaded forms."""
+
+import time
+
+import numpy as np
+import pytest
+
+import bench as B
+from automerge_tpu.engine import (DeviceTextDoc, PipelinedIngestor,
+                                  TextChangeBatch)
+from automerge_tpu.engine import base as eb
+from automerge_tpu.engine import runs as er
+
+from test_prepare_commit import typing_change
+
+
+def fresh_doc(n=4000):
+    d = DeviceTextDoc("t")
+    d.eager_materialize = True
+    d.apply_batch(B.base_batch("t", n))
+    d.text()
+    return d
+
+
+def halves(n=4000, k=3):
+    return [B.merge_batch("t", 40, 30, n, seed=s + 1,
+                          actor_prefix=f"p{s}")
+            for s in range(k)]
+
+
+def test_pipelined_matches_serial():
+    """Pipelined ingestion produces byte-identical state to serial
+    prepare/commit of the same batches."""
+    hs = halves()
+    serial = fresh_doc()
+    for h in hs:
+        serial.commit_prepared(serial.prepare_batch(h))
+    piped = fresh_doc()
+    with PipelinedIngestor(piped) as pipe:
+        pipe.run(list(hs))
+    assert piped.text() == serial.text()
+    assert piped.elem_ids() == serial.elem_ids()
+    assert piped.clock == serial.clock
+
+
+def test_overlap_never_loses():
+    """The in-process overlapped schedule must not lose to serial (the
+    acceptance bound of ISSUE 2): byte-identical always; wall clock
+    overlapped <= serial, with cfg5d's noise margin as the hard
+    backstop on a contended one-core box."""
+    n = 30_000
+    hs = [B.merge_batch("t", 300, 200, n, seed=s, actor_prefix=p)
+          for s, p in ((1, "alpha"), (2, "beta"))]
+    expect = n + sum(h.n_ops for h in hs) // 2
+    B.run_overlapped(hs, expect, obj_id="t", base_n=n)       # warm-up
+    B.run_overlapped(hs, expect, obj_id="t", base_n=n, barrier=True)
+    for attempt in range(3):
+        ser = min(B.run_overlapped(hs, expect, obj_id="t", base_n=n,
+                                   barrier=True) for _ in range(2))
+        ov = min(B.run_overlapped(hs, expect, obj_id="t", base_n=n)
+                 for _ in range(2))
+        if ov <= ser:
+            break
+        time.sleep(2)            # escape a transient contention burst
+    assert ov <= ser * 1.15, (
+        f"overlapped {ov:.4f}s vs serial {ser:.4f}s")
+
+
+def test_chained_prepare_matches_apply():
+    """prepare(b2, after=p1) plans against p1's pending shadow and the
+    pair commits to exactly the serial result."""
+    hs = halves(k=2)
+    direct = fresh_doc()
+    direct.apply_batch(hs[0])
+    direct.apply_batch(hs[1])
+    doc = fresh_doc()
+    p1 = doc.prepare_batch(hs[0])
+    p2 = doc.prepare_batch(hs[1], after=p1)
+    doc.commit_prepared(p1)
+    doc.commit_prepared(p2)
+    assert doc.text() == direct.text()
+    assert doc.elem_ids() == direct.elem_ids()
+
+
+def test_generation_mismatch_aborts_chained_plan():
+    """A chained plan whose base committed but whose document then moved
+    must abort with ValueError, document unharmed."""
+    hs = halves(k=2)
+    doc = fresh_doc()
+    p1 = doc.prepare_batch(hs[0])
+    p2 = doc.prepare_batch(hs[1], after=p1)
+    doc.commit_prepared(p1)
+    doc.apply_batch(B.merge_batch("t", 5, 10, 4000, seed=9,
+                                  actor_prefix="zz"))   # outside mutation
+    with pytest.raises(ValueError, match="re-prepare"):
+        doc.commit_prepared(p2)
+    # recovery: a fresh prepare commits fine
+    doc.commit_prepared(doc.prepare_batch(hs[1]))
+
+
+def test_commit_severs_chain_and_staged_buffers():
+    """A committed plan drops its staged device buffers and its base
+    link — a long pipelined session must not retain every plan (and its
+    device arrays) back to session start (review finding)."""
+    hs = halves(k=2)
+    doc = fresh_doc()
+    p1 = doc.prepare_batch(hs[0])
+    p2 = doc.prepare_batch(hs[1], after=p1)
+    doc.commit_prepared(p1)
+    assert p1.rounds == [] and p1.after is None
+    doc.commit_prepared(p2)
+    assert p2.rounds == [] and p2.after is None
+
+
+def test_chained_plan_requires_base_commit():
+    """Committing a chained plan BEFORE its base is a ValueError."""
+    hs = halves(k=2)
+    doc = fresh_doc()
+    p1 = doc.prepare_batch(hs[0])
+    p2 = doc.prepare_batch(hs[1], after=p1)
+    with pytest.raises(ValueError, match="re-prepare"):
+        doc.commit_prepared(p2)
+    doc.commit_prepared(p1)
+    doc.commit_prepared(p2)
+
+
+def test_pipeline_recovers_from_outside_mutation():
+    """The documented degraded path: a mutation violating the pipeline
+    contract costs a re-prepare, never corruption."""
+    hs = halves(k=2)
+    extra = B.merge_batch("t", 5, 10, 4000, seed=9, actor_prefix="zz")
+    doc = fresh_doc()
+    with PipelinedIngestor(doc) as pipe:
+        pipe.feed(hs[0])
+        pipe.commit_next()
+        doc.apply_batch(extra)          # outside the pipeline
+        pipe.feed(hs[1])
+        pipe.flush()
+    control = fresh_doc()
+    control.apply_batch(hs[0])
+    control.apply_batch(extra)
+    control.apply_batch(hs[1])
+    assert doc.text() == control.text()
+
+
+def test_context_exit_flushes_fed_batches():
+    """Exiting the context cleanly must COMMIT fed-but-unflushed batches,
+    not silently drop them (apply_batch-equivalence contract) — and
+    feeding PAST the slot bound self-drains instead of deadlocking on
+    the exhausted semaphore (4 feeds into 2 slots, no explicit flush)."""
+    hs = halves(k=4)
+    doc = fresh_doc()
+    with PipelinedIngestor(doc) as pipe:
+        for h in hs:
+            pipe.feed(h)           # no explicit flush, no drain calls
+    control = fresh_doc()
+    for h in hs:
+        control.apply_batch(h)
+    assert doc.text() == control.text()
+
+
+def test_pipeline_rechains_after_fallback():
+    """One outside mutation must not degrade the pipeline permanently:
+    the worker drops the dead chain base and later batches chain again
+    (bounded fallback count)."""
+    hs = halves(k=5)
+    extra = B.merge_batch("t", 5, 10, 4000, seed=9, actor_prefix="zz")
+    doc = fresh_doc()
+    with PipelinedIngestor(doc) as pipe:
+        pipe.feed(hs[0])
+        pipe.commit_next()
+        doc.apply_batch(extra)          # the one violation
+        for h in hs[1:]:
+            pipe.feed(h)
+            pipe.commit_next()
+        n_fallbacks = pipe._fallbacks
+    control = fresh_doc()
+    control.apply_batch(hs[0])
+    control.apply_batch(extra)
+    for h in hs[1:]:
+        control.apply_batch(h)
+    assert doc.text() == control.text()
+    assert n_fallbacks <= 2, (
+        f"pipeline kept falling back ({n_fallbacks} times) instead of "
+        "re-chaining")
+
+
+def test_single_slot_pipeline_degrades_serial():
+    """slots=1 must degrade to a serial schedule, not deadlock in
+    run()'s drain loop (review finding: the drain threshold was
+    hardcoded to 2)."""
+    hs = halves(k=3)
+    doc = fresh_doc()
+    with PipelinedIngestor(doc, slots=1) as pipe:
+        pipe.run(list(hs))
+    control = fresh_doc()
+    for h in hs:
+        control.apply_batch(h)
+    assert doc.text() == control.text()
+
+
+def test_closed_pipeline_rejects_feed():
+    """close() is terminal: feeding after it raises instead of
+    restarting the joined worker thread."""
+    doc = fresh_doc()
+    pipe = PipelinedIngestor(doc)
+    pipe.feed(halves(k=1)[0])
+    pipe.flush()
+    pipe.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        pipe.feed(halves(k=1)[0])
+
+
+def test_chained_prepare_refuses_remap():
+    """Actors sorting below the existing table cannot chain (the remap
+    would invalidate the pending base plan's staged ranks)."""
+    doc = fresh_doc()
+    p1 = doc.prepare_batch(B.merge_batch("t", 4, 10, 4000, seed=1,
+                                         actor_prefix="m"))
+    low = B.merge_batch("t", 4, 10, 4000, seed=2, actor_prefix="aa")
+    with pytest.raises(ValueError, match="chain"):
+        doc.prepare_batch(low, after=p1)
+    doc.commit_prepared(p1)             # base plan still commits fine
+    doc.apply_batch(low)
+
+
+def causal_batch(n_actors=80):
+    """Multi-round shape: seq-2 changes depending on the batch's own
+    seq-1 changes, plus duplicates and an unsatisfiable straggler."""
+    changes = []
+    for a in range(n_actors):
+        actor = f"ac{a:03d}"
+        changes.append(typing_change(actor, 1, {"base": 1}, "xy",
+                                     100, "base:5"))
+        changes.append(typing_change(actor, 2, {}, "z", 200,
+                                     f"{actor}:101"))
+    changes.append(typing_change("ac000", 1, {"base": 1}, "xy", 100,
+                                 "base:5"))          # duplicate row
+    changes.append(typing_change("ghost", 3, {}, "g", 300, "ghost:299"))
+    return TextChangeBatch.from_changes(changes, "t")
+
+
+def seed_small():
+    d = DeviceTextDoc("t")
+    d.apply_changes([typing_change("base", 1, {}, "hello world", 1,
+                                   "_head")])
+    return d
+
+
+def test_schedule_bulk_parity(monkeypatch):
+    """The vectorized admission path partitions EXACTLY like the
+    per-change loop: same rounds, same row order, same queue."""
+    batch = causal_batch()
+    doc = seed_small()
+    bulk = doc._schedule(batch)                      # n >= threshold: bulk
+    monkeypatch.setattr(eb, "_BULK_SCHEDULE_MIN", 10**9)
+    loop = doc._schedule(batch)                      # forced loop
+    assert [[r for _, r in rnd] for rnd in bulk[0]] == \
+        [[r for _, r in rnd] for rnd in loop[0]]
+    assert [r for _, r in bulk[1]] == [r for _, r in loop[1]]
+    # and the applied documents agree end to end
+    d_bulk = seed_small()
+    d_bulk.apply_batch(batch)
+    monkeypatch.setattr(eb, "_BULK_SCHEDULE_MIN", 10**9)
+    d_loop = seed_small()
+    d_loop.apply_batch(causal_batch())
+    assert d_bulk.text() == d_loop.text()
+    assert d_bulk.clock == d_loop.clock
+    assert len(d_bulk.queue) == len(d_loop.queue) == 1
+
+
+def test_sharded_detect_runs_bit_identical(monkeypatch):
+    """Sharded run detection concatenates to EXACTLY the single-shard
+    partition on a mixed runs+residuals batch."""
+    monkeypatch.setenv("AMTPU_PLAN_WORKERS", "3")
+    monkeypatch.setattr(er, "_SHARD_MIN_OPS", 64)
+    monkeypatch.setattr("automerge_tpu.engine.pipeline._POOL", None)
+    batch = B.merge_batch("t", 50, 40, 1000, seed=5)
+    # splice residuals (bare deletes) into some changes
+    kind = batch.op_kind.copy()
+    from automerge_tpu.engine.columnar import KIND_DEL
+    kind[21::97] = KIND_DEL
+    cols = (kind, batch.op_target_actor, batch.op_target_ctr,
+            batch.op_parent_actor, batch.op_parent_ctr, batch.op_value,
+            batch.op_change)
+    sharded = er.detect_runs(*cols, 1000)
+    single = er._detect_runs_single(*cols, 1000)
+    for f in ("n_ops", "n_ins", "blob_lt_128", "blob_lt_256"):
+        assert getattr(sharded, f) == getattr(single, f), f
+    for f in ("hpos", "run_len", "head_slot", "rpos", "res_new_slot",
+              "blob"):
+        np.testing.assert_array_equal(getattr(sharded, f),
+                                      getattr(single, f), err_msg=f)
