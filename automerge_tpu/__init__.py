@@ -14,8 +14,8 @@ from ._common import ROOT_ID  # noqa: F401
 from ._uuid import uuid  # noqa: F401
 from .api import (  # noqa: F401
     apply_changes, change, diff, empty_change, equals, from_, get_all_changes,
-    get_changes, get_history, get_missing_deps, init, load, merge, redo, save,
-    to_json, undo,
+    get_changes, get_history, get_missing_deps, init, load, merge, redo,
+    restore, save, to_json, undo,
 )
 from . import types  # noqa: F401
 from .backend import Backend  # noqa: F401
@@ -24,7 +24,11 @@ from .frontend import (  # noqa: F401
     get_conflicts, get_object_by_id, get_object_id, set_actor_id,
 )
 from . import resilience  # noqa: F401
-from .resilience import ProtocolError  # noqa: F401
+from .resilience import CheckpointError, ProtocolError  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    AsyncCheckpointer, Checkpoint, checkpoint_doc,
+)
 from .sync import (  # noqa: F401
     ClockMatrix, Connection, DocSet, SyncHub, WatchableDoc,
 )

@@ -15,6 +15,7 @@ from .backend import default as Backend
 from ._common import ROOT_ID
 from ._uuid import uuid  # noqa: F401  (re-exported, like the reference)
 from .frontend import Counter, Table, Text  # noqa: F401
+from .resilience.validation import validate_save_payload
 
 _SAVE_FORMAT = "automerge-tpu-v1"
 
@@ -63,17 +64,45 @@ def redo(doc, options=None):
     return new_doc
 
 
-def save(doc) -> str:
+def save(doc, checkpoint=None) -> str:
+    """Serialize a document's change history as plain JSON.
+
+    With ``checkpoint=`` (a :class:`~.checkpoint.Checkpoint` or bundle
+    bytes from :func:`~.checkpoint.checkpoint_doc`), the save is
+    DELTA-COMPACTED: the
+    change prefix the checkpoint's clock frontier covers is dropped and
+    only the op-log tail is written; ``load`` then needs the same base
+    checkpoint back (checkpoint/__init__.py, INTERNALS §8)."""
     state = Frontend.get_backend_state(doc)
+    if checkpoint is not None:
+        from .checkpoint import save_delta
+        return save_delta(state, checkpoint)
     changes = state.history() + list(state.queue)
     return json.dumps({"format": _SAVE_FORMAT, "changes": changes})
 
 
-def load(data: str, options=None):
+def load(data: str, options=None, checkpoint=None):
+    from .checkpoint import DELTA_FORMAT, load_delta
     payload = json.loads(data)
-    if payload.get("format") != _SAVE_FORMAT:
-        raise ValueError(f"Unsupported save format: {payload.get('format')!r}")
+    # envelope validation (resilience.validation): non-dict payloads and a
+    # missing/non-array `changes` raise a typed ProtocolError (a
+    # ValueError) instead of leaking AttributeError/KeyError
+    validate_save_payload(payload, require_changes=False)
+    fmt = payload["format"]
+    if fmt == DELTA_FORMAT:
+        return load_delta(payload, checkpoint, options)
+    if fmt != _SAVE_FORMAT:
+        raise ValueError(f"Unsupported save format: {fmt!r}")
+    validate_save_payload(payload, require_changes=True)
     return _doc_from_changes(options, payload["changes"])
+
+
+def restore(checkpoint, options=None):
+    """A document restored directly from a checkpoint bundle. Raises
+    :class:`~.resilience.errors.CheckpointError` if the bundle is corrupt
+    or truncated (every array is content-hashed)."""
+    from .checkpoint import restore_doc
+    return restore_doc(checkpoint, options)
 
 
 def merge(local_doc, remote_doc):

@@ -1,0 +1,268 @@
+"""Engine-level checkpoint codec: columnar device docs <-> bundle pieces.
+
+This is the layer where checkpointing actually beats replay: a
+``DeviceTextDoc``/``DeviceMapDoc`` is captured as its padded columnar
+element tables (trimmed to the live prefix), the compressed host range
+index, and the small host-side causal state (clock, allDeps closures,
+conflict registers, value pool) — and restored by staging those arrays
+straight back to the device. No causal admission, no run detection, no
+ingest kernels: restore cost is one h2d of the live tables plus O(ranges)
+host dict work, instead of replaying the whole op history through the
+round protocol (bench.py ``restore_snapshot_s`` vs
+``restore_full_replay_s``).
+
+Capture is split in two phases so the async writer
+(:mod:`.writer`) can overlap the heavy half with ingestion:
+
+- ``grab()`` — a generation-stamped consistent snapshot of the doc's
+  mutable host state plus *references* to its device tables. Device
+  arrays are immutable (the ingest kernels replace, never donate or
+  mutate), so a grabbed reference stays valid forever; host dicts are
+  copied. Microseconds, no device traffic. Raises
+  :class:`CaptureConflict` when the doc's generation moved mid-grab.
+- ``encode_grab()`` — the d2h fetch, trimming, and hashing. Safe on any
+  thread at any later time; it touches only the grab.
+
+The segment mirror and closure memo are rebuilt/dropped on restore (both
+are derivable caches, and the mirror is self-verifying against the device
+chain bits at the next ``_scalars`` sync anyway).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..resilience.errors import CheckpointError
+
+
+class CaptureConflict(RuntimeError):
+    """The document mutated while its state was being grabbed."""
+
+
+_TEXT_KEYS = ("parent", "ctr", "actor", "value", "has_value",
+              "win_actor", "win_seq", "win_counter", "chain")
+_MAP_KEYS = ("value", "has_value", "win_actor", "win_seq", "win_counter")
+_BOOL_KEYS = frozenset(("has_value", "win_counter", "chain"))
+_FILLS = {"win_actor": -1}
+_TEXT_MIRROR = ("parent", "ctr", "actor", "value", "has_value")
+_MAP_MIRROR = ("value", "has_value", "win_counter")
+
+
+def _copy_conflicts(conflicts: dict) -> list:
+    """Deterministic, deep-enough copy: the slow register path mutates
+    conflict op dicts in place (counter inc folds), so each op is copied."""
+    return [[int(slot), [dict(op) for op in ops]]
+            for slot, ops in sorted(conflicts.items())]
+
+
+def _copy_all_deps(all_deps: dict) -> list:
+    return [[a, int(s), dict(cl)] for (a, s), cl in
+            sorted(all_deps.items(), key=lambda kv: (kv[0][0], kv[0][1]))]
+
+
+def grab(doc) -> dict:
+    """Generation-stamped consistent snapshot of one engine doc.
+
+    Cheap (no device traffic). The caller either owns the mutation thread
+    (no race possible) or retries on :class:`CaptureConflict` — see
+    :class:`~.writer.AsyncCheckpointer`."""
+    from ..engine.map_doc import DeviceMapDoc
+    from ..engine.text_doc import DeviceTextDoc
+
+    if doc.queue:
+        raise CheckpointError(
+            f"cannot checkpoint {doc.obj_id!r}: it holds causally-unready "
+            "queued changes (drain or drop them first)")
+    if getattr(doc, "_busy", 0):
+        # a mutation is in flight: gen stamps alone can't expose one that
+        # spans this whole grab (the bump lands at mutation end)
+        raise CaptureConflict(doc.obj_id)
+    gen0 = doc._gen
+    dev = dict(doc._dev) if doc._dev is not None else None
+    g = {
+        "gen": gen0,
+        "obj_id": doc.obj_id,
+        "actor_table": list(doc.actor_table),
+        "clock": dict(doc.clock),
+        "all_deps": _copy_all_deps(doc._all_deps),
+        "conflicts": _copy_conflicts(doc.conflicts),
+        "value_pool": [dict(e) for e in doc.value_pool],
+        "dev": dev,
+    }
+    if isinstance(doc, DeviceTextDoc):
+        g["type"] = "text"
+        g["n_elems"] = doc.n_elems
+        g["all_ascii"] = doc.all_ascii
+        idx = doc.index
+        g["index"] = (idx.starts, idx.lens, idx.slots)  # immutable post-merge
+    elif isinstance(doc, DeviceMapDoc):
+        g["type"] = "map"
+        g["key_table"] = list(doc.key_table)
+    else:
+        raise CheckpointError(
+            f"cannot checkpoint engine doc of type {type(doc).__name__}")
+    if doc._gen != gen0 or getattr(doc, "_busy", 0) \
+            or (doc._dev is not None and dev is not None
+                and dev.keys() != doc._dev.keys()):
+        raise CaptureConflict(doc.obj_id)
+    return g
+
+
+def encode_grab(g: dict, prefix: str = ""):
+    """A grab -> (manifest fragment, {array name: np.ndarray}).
+
+    The d2h half of capture: fetches the device tables the grab
+    references, trims them to the live prefix, and emits the bundle
+    pieces. Deterministic for a given grab."""
+    frag = {
+        "type": g["type"],
+        "obj_id": g["obj_id"],
+        "actor_table": g["actor_table"],
+        "clock": g["clock"],
+        "all_deps": g["all_deps"],
+        "conflicts": g["conflicts"],
+        "value_pool": g["value_pool"],
+    }
+    arrays = {}
+    if g["type"] == "text":
+        n_live = g["n_elems"] + 1
+        frag["n_elems"] = g["n_elems"]
+        frag["all_ascii"] = g["all_ascii"]
+        starts, lens, slots = g["index"]
+        arrays[prefix + "idx_starts"] = np.asarray(starts, np.int64)
+        arrays[prefix + "idx_lens"] = np.asarray(lens, np.int64)
+        arrays[prefix + "idx_slots"] = np.asarray(slots, np.int64)
+        keys = _TEXT_KEYS if g["n_elems"] else ()
+    else:
+        frag["key_table"] = g["key_table"]
+        n_live = len(g["key_table"])
+        keys = _MAP_KEYS if n_live else ()
+    for key in keys:
+        col = np.asarray(g["dev"][key])[:n_live]
+        if key in _BOOL_KEYS:
+            col = col.astype(bool)
+        else:
+            col = col.astype(np.int32)
+        arrays[prefix + "tbl_" + key] = col
+    return frag, arrays
+
+
+def capture_engine_doc(doc, prefix: str = ""):
+    """One-shot synchronous capture (grab + encode on this thread)."""
+    return encode_grab(grab(doc), prefix)
+
+
+def _require(arrays: dict, name: str) -> np.ndarray:
+    try:
+        return arrays[name]
+    except KeyError:
+        raise CheckpointError(
+            f"checkpoint bundle is missing array {name!r}") from None
+
+
+def _padded_tables(arrays: dict, prefix: str, keys, n_live: int, cap: int):
+    """-> (host dict, device dict) of tables padded to `cap`."""
+    import jax.numpy as jnp
+    host, dev = {}, {}
+    for key in keys:
+        col = _require(arrays, prefix + "tbl_" + key)
+        want_bool = key in _BOOL_KEYS
+        if len(col) < n_live or col.ndim != 1 \
+                or (want_bool and col.dtype != np.bool_) \
+                or (not want_bool and col.dtype != np.int32):
+            raise CheckpointError(
+                f"checkpoint table {key!r} has wrong shape/dtype")
+        fill = _FILLS.get(key, 0)
+        out = np.full(cap, fill,
+                      np.bool_ if want_bool else np.int32)
+        out[:n_live] = col[:n_live]
+        host[key] = out
+        dev[key] = jnp.asarray(out)
+    return host, dev
+
+
+def restore_engine_doc(frag: dict, arrays: dict, prefix: str = "",
+                       shared_all_deps: dict = None):
+    """Rebuild a DeviceTextDoc/DeviceMapDoc from bundle pieces.
+
+    ``shared_all_deps``: backend-level restores pass the closure map
+    rebuilt once from the core history (per-doc closure maps all converge
+    to the same content); engine-level bundles carry their own."""
+    from ..engine.host_index import ElemRangeIndex
+    from ..engine.map_doc import DeviceMapDoc
+    from ..engine.segments import SegmentMirror
+    from ..engine.text_doc import DeviceTextDoc
+    from ..ops.ingest import bucket
+
+    try:
+        typ = frag["type"]
+        obj_id = frag["obj_id"]
+        actor_table = list(frag["actor_table"])
+        clock = dict(frag["clock"])
+        conflicts = {int(slot): [dict(op) for op in ops]
+                     for slot, ops in frag["conflicts"]}
+        value_pool = [dict(e) for e in frag["value_pool"]]
+    except (KeyError, TypeError, ValueError) as exc:
+        raise CheckpointError(
+            f"malformed engine-doc checkpoint fragment: {exc}") from None
+    if shared_all_deps is not None:
+        all_deps = dict(shared_all_deps)
+    else:
+        all_deps = {(a, int(s)): dict(cl)
+                    for a, s, cl in frag.get("all_deps", [])}
+
+    if typ == "text":
+        n_elems = int(frag["n_elems"])
+        doc = DeviceTextDoc(obj_id, capacity=max(n_elems + 1, 16))
+        doc.all_ascii = bool(frag["all_ascii"])
+        doc.n_elems = n_elems
+        idx = ElemRangeIndex()
+        idx.starts = np.asarray(
+            _require(arrays, prefix + "idx_starts"), np.int64)
+        idx.lens = np.asarray(_require(arrays, prefix + "idx_lens"), np.int64)
+        idx.slots = np.asarray(
+            _require(arrays, prefix + "idx_slots"), np.int64)
+        doc.index = idx
+        if n_elems:
+            n_live = n_elems + 1
+            cap = max(bucket(n_live), doc._cap)
+            host, dev = _padded_tables(arrays, prefix, _TEXT_KEYS,
+                                       n_live, cap)
+            doc._dev = dev
+            doc._host = {k: host[k] for k in _TEXT_MIRROR}
+            doc._cap = cap
+            try:
+                doc.seg_mirror = SegmentMirror.rebuild(
+                    host["chain"], host["parent"], n_elems, idx.slot_to_key)
+                doc._seg_bound = max(doc.seg_mirror.n_segs, 1)
+            except Exception:
+                # degraded-but-correct: the self-contained materialize
+                # kernels take over (same contract as the heal path)
+                doc.seg_mirror = None
+                doc._seg_bound = n_elems + 2
+        else:
+            doc.seg_mirror = SegmentMirror.empty()
+    elif typ == "map":
+        key_table = list(frag["key_table"])
+        doc = DeviceMapDoc(obj_id, capacity=max(len(key_table), 16))
+        doc.key_table = key_table
+        doc._key_slot = {k: i for i, k in enumerate(key_table)}
+        if key_table:
+            n_live = len(key_table)
+            cap = max(bucket(n_live, 16), doc._cap)
+            host, dev = _padded_tables(arrays, prefix, _MAP_KEYS,
+                                       n_live, cap)
+            doc._dev = dev
+            doc._host = {k: host[k] for k in _MAP_MIRROR}
+            doc._cap = cap
+    else:
+        raise CheckpointError(f"unknown engine doc type {typ!r} in "
+                              "checkpoint fragment")
+
+    doc.actor_table = actor_table
+    doc._actor_rank = {a: i for i, a in enumerate(actor_table)}
+    doc.clock = clock
+    doc._all_deps = all_deps
+    doc.conflicts = conflicts
+    doc.value_pool = value_pool
+    return doc

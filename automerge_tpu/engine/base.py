@@ -108,6 +108,12 @@ class CausalDeviceDoc:
         self._dev: Optional[dict] = None      # device arrays (lazy)
         self._host: Optional[dict] = None     # numpy mirrors (lazy)
         self._gen = 0                         # bumps on every state mutation
+        self._busy = 0                        # >0 while a mutation is in
+        # flight: generation stamps alone cannot expose a mutation that
+        # SPANS an observer's whole read (the gen bump lands at the end),
+        # so content-mutating entry points raise this first and drop it
+        # last — the checkpoint writer's optimistic grab treats any
+        # nonzero observation as a conflict (checkpoint/engine_codec)
 
     # ------------------------------------------------------------------
     # actor interning (order-preserving: rank order == lexicographic order)
@@ -129,11 +135,15 @@ class CausalDeviceDoc:
         return remap
 
     def _apply_remap(self, remap: np.ndarray):
-        self._remap_device(remap)
-        for ops in self.conflicts.values():
-            for op in ops:
-                op["actor_rank"] = int(remap[op["actor_rank"]])
-        self._invalidate()
+        self._busy += 1   # device/index/conflict columns move together
+        try:
+            self._remap_device(remap)
+            for ops in self.conflicts.values():
+                for op in ops:
+                    op["actor_rank"] = int(remap[op["actor_rank"]])
+            self._invalidate()
+        finally:
+            self._busy -= 1
 
     def _intern_actors_append(self, new_actors):
         """Intern actors WITHOUT ever remapping existing ranks — the only
@@ -385,6 +395,13 @@ class CausalDeviceDoc:
 
     def apply_batch(self, batch):
         """Merge a columnar change batch (causally gated, idempotent)."""
+        self._busy += 1
+        try:
+            return self._apply_batch(batch)
+        finally:
+            self._busy -= 1
+
+    def _apply_batch(self, batch):
         rounds, queue_after, prior_queue = self._schedule(batch)
         self.queue = queue_after
         applied: set = set()
@@ -676,6 +693,13 @@ class CausalDeviceDoc:
         kernel dispatch. Raises ValueError (document untouched) if the
         document mutated since the plan was prepared — for a chained plan,
         if its base plan has not committed or anything mutated since."""
+        self._busy += 1
+        try:
+            return self._commit_prepared(prepared)
+        finally:
+            self._busy -= 1
+
+    def _commit_prepared(self, prepared: PreparedBatch):
         if prepared.committed_gen is not None:
             raise ValueError("prepared batch already committed; re-prepare")
         if prepared.after is not None:

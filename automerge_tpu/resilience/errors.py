@@ -23,3 +23,17 @@ class ProtocolError(ValueError):
     Subclasses ``ValueError`` so pre-existing callers that catch
     ``ValueError`` around apply paths keep working unchanged.
     """
+
+
+class CheckpointError(ProtocolError):
+    """A checkpoint bundle failed structural or integrity validation.
+
+    Raised by the checkpoint codec (``automerge_tpu.checkpoint``) when a
+    bundle is truncated, has a bad magic/format-version, or any per-array
+    content hash mismatches — always BEFORE any restored state is handed
+    out, so a consumer never sees a partially-restored document. Sync-layer
+    consumers treat it like any other protocol violation: the snapshot
+    bootstrap path falls back to full log replay
+    (``DocSet.bootstrap_doc(fallback_changes=...)``, the hub's
+    ``noSnapshot`` re-request).
+    """

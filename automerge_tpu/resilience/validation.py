@@ -175,7 +175,12 @@ def validate_changes(changes, strict: bool = True) -> list:
 
 
 def validate_msg(msg) -> dict:
-    """Validate one ``{docId, clock, changes?}`` sync message (strict)."""
+    """Validate one ``{docId, clock, changes?, checkpoint?, noSnapshot?}``
+    sync message (strict). ``checkpoint`` (a base64 checkpoint bundle, the
+    snapshot-bootstrap path) and ``noSnapshot`` (the receiver's typed
+    fallback request after a corrupt bundle) are optional extensions; the
+    bundle's own integrity is verified by the checkpoint codec at restore
+    time, not here."""
     if not isinstance(msg, dict):
         raise ProtocolError(f"sync message must be an object, got "
                             f"{type(msg).__name__}")
@@ -193,4 +198,32 @@ def validate_msg(msg) -> dict:
                                 f"{type(changes).__name__}")
         for change in changes:
             validate_change(change, strict=True)
+    ckpt = msg.get("checkpoint")
+    if ckpt is not None and not isinstance(ckpt, str):
+        raise ProtocolError(f"message `checkpoint` must be a base64 string, "
+                            f"got {type(ckpt).__name__}")
+    if "noSnapshot" in msg and not isinstance(msg["noSnapshot"], bool):
+        raise ProtocolError("message `noSnapshot` must be a boolean, got "
+                            f"{msg['noSnapshot']!r}")
     return msg
+
+
+def validate_save_payload(payload, require_changes: bool = True) -> dict:
+    """Validate a deserialized ``api.save`` payload envelope.
+
+    ``api.load`` historically leaked raw ``AttributeError`` on non-dict
+    JSON (``load("[1]")``) and ``KeyError`` on a missing ``changes`` key;
+    everything off-schema now raises :class:`ProtocolError` (a
+    ``ValueError``) instead. Per-change validation stays with the backend
+    apply path (lenient mode) — this checks the envelope only."""
+    if not isinstance(payload, dict):
+        raise ProtocolError(f"save payload must be an object, got "
+                            f"{type(payload).__name__}")
+    if not isinstance(payload.get("format"), str):
+        raise ProtocolError(f"save payload requires a string `format`, got "
+                            f"{payload.get('format')!r}")
+    if require_changes and not isinstance(payload.get("changes"),
+                                          (list, tuple)):
+        raise ProtocolError(f"save payload requires a `changes` array, got "
+                            f"{type(payload.get('changes')).__name__}")
+    return payload

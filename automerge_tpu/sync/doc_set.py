@@ -64,6 +64,35 @@ class DocSet:
         from ..resilience.inbound import inbound_gate
         return inbound_gate(self).deliver(doc_id, changes)
 
+    def checkpoint_doc(self, doc_id: str):
+        """An integrity-checked columnar snapshot bundle of one document
+        (``automerge_tpu.checkpoint.Checkpoint``) — what the snapshot
+        bootstrap hands a joining peer instead of full history."""
+        from ..checkpoint import checkpoint_doc
+        doc = self._docs.get(doc_id)
+        if doc is None:
+            raise KeyError(f"no document {doc_id!r} in this doc set")
+        return checkpoint_doc(doc)
+
+    def bootstrap_doc(self, doc_id: str, checkpoint, changes=None,
+                      fallback_changes=None, validated: bool = False):
+        """Install a document from a checkpoint + op-log tail (snapshot
+        bootstrap). The bundle is integrity-verified before any state is
+        installed; a corrupt bundle raises ``CheckpointError`` — or,
+        when ``fallback_changes`` carries the full log, degrades to full
+        log replay instead. The tail then applies through the validated
+        + quarantined inbound gate like any network delivery."""
+        from ..checkpoint import restore_doc_or_replay
+        from ..resilience.inbound import inbound_gate
+        doc = restore_doc_or_replay(checkpoint, fallback_changes)
+        self.set_doc(doc_id, doc)
+        gate = inbound_gate(self)
+        if changes:
+            gate.deliver(doc_id, changes, validated=validated)
+        else:
+            gate.release(doc_id)   # parked changes the snapshot satisfied
+        return self.get_doc(doc_id)
+
     def register_handler(self, handler):
         if handler not in self._handlers:
             self._handlers.append(handler)

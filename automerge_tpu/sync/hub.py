@@ -13,12 +13,17 @@ plain `Connection` (or another hub) on the far side.
 
 from __future__ import annotations
 
+import logging
+import os
+
 from ..backend import default as Backend
 from .. import frontend as Frontend
 from .._common import less_or_equal
 from ..resilience.inbound import absorb_msg, inbound_gate
 from ..resilience.validation import validate_msg
 from .clock_index import ClockMatrix
+
+logger = logging.getLogger("automerge_tpu.sync")
 
 
 class HubPeer:
@@ -46,6 +51,16 @@ def shared_hub(doc_set) -> "SyncHub":
 
 
 class SyncHub:
+    #: A joining peer whose believed clock is empty and who is missing at
+    #: least this many changes gets a checkpoint bundle + op-log tail
+    #: instead of the full change history (snapshot bootstrap,
+    #: INTERNALS §8). 0 disables snapshot bootstrap entirely.
+    try:
+        snapshot_min_changes = int(
+            os.environ.get("AMTPU_SNAPSHOT_MIN_CHANGES", "64") or 0)
+    except ValueError:   # malformed env must not break `import automerge_tpu`
+        snapshot_min_changes = 64
+
     def __init__(self, doc_set):
         self._doc_set = doc_set
         self._peers: dict = {}
@@ -58,6 +73,10 @@ class SyncHub:
         # keeps the equivalent ourClock per Connection instance, so a
         # reconnected peer starts fresh)
         self._n_auto_ids = 0
+        self._ckpt_cache: dict = {}   # doc -> (Checkpoint, history_len)
+        self._no_snapshot: set = set()   # (peer, doc): peer declined a
+        # bundle this session (corrupt restore or policy) — serve plain
+        # changes for the rest of the add_peer..remove_peer lifetime
 
     # -- lifecycle ------------------------------------------------------
 
@@ -85,6 +104,8 @@ class SyncHub:
                             if pd[0] != peer_id}
         self._session_docs = {pd for pd in self._session_docs
                               if pd[0] != peer_id}
+        self._no_snapshot = {pd for pd in self._no_snapshot
+                             if pd[0] != peer_id}
 
     def has_peers(self) -> bool:
         return bool(self._peers)
@@ -155,7 +176,10 @@ class SyncHub:
                 continue  # never send changes unsolicited (advertise path)
             state = self._state(doc_id)
             if state is None:
-                continue  # doc removed locally; clocks remain for history
+                # doc removed locally; clocks remain for history, but a
+                # cached checkpoint bundle (megabytes) must not outlive it
+                self._ckpt_cache.pop(doc_id, None)
+                continue
             their = self._matrix.their_clock(peer_id, doc_id)
             key = (doc_id, tuple(sorted(their.items())))
             if key in extracted:
@@ -173,8 +197,47 @@ class SyncHub:
                 continue
             self._matrix.update_theirs(peer_id, doc_id, clock)
             self._advertised[(peer_id, doc_id)] = clock
-            self._peers[peer_id].send_msg(
-                {"docId": doc_id, "clock": clock, "changes": changes})
+            msg = {"docId": doc_id, "clock": clock, "changes": changes}
+            if (self.snapshot_min_changes and not their
+                    and len(changes) >= self.snapshot_min_changes
+                    and (peer_id, doc_id) not in self._no_snapshot):
+                # snapshot bootstrap: a joining peer (empty believed
+                # clock) missing a long history gets a checkpoint bundle
+                # + the op-log tail past its frontier instead of the
+                # whole log. A failed capture just serves plain changes.
+                snap = self._doc_checkpoint(doc_id, state)
+                if snap is not None:
+                    ck, tail = snap
+                    msg = {"docId": doc_id, "clock": clock,
+                           "checkpoint": ck.to_base64(), "changes": tail}
+            self._peers[peer_id].send_msg(msg)
+
+    def _doc_checkpoint(self, doc_id: str, state):
+        """(Checkpoint, tail changes) for a doc, cached per doc and
+        recaptured once the tail past the cached frontier itself exceeds
+        the snapshot threshold. None when capture fails (the caller falls
+        back to plain change extraction)."""
+        from ..checkpoint import Checkpoint, capture_state
+        cached = self._ckpt_cache.get(doc_id)
+        if cached is not None:
+            ck, cap_len = cached
+            stale = (state.history_len - cap_len >= self.snapshot_min_changes
+                     or not less_or_equal(ck.clock, dict(state.clock)))
+            if stale:
+                cached = None
+        if cached is None:
+            try:
+                ck = Checkpoint(capture_state(state))
+            except Exception:
+                logger.warning("checkpoint capture failed for doc %r; "
+                               "serving plain changes", doc_id,
+                               exc_info=True)
+                return None
+            cached = (ck, state.history_len)
+            self._ckpt_cache[doc_id] = cached
+        ck = cached[0]
+        tail = Backend.get_missing_changes(state, ck.clock)
+        return ck, tail
 
     # -- inbound --------------------------------------------------------
 
@@ -194,6 +257,26 @@ class SyncHub:
             self._revealed.add((peer_id, doc_id))
             self._matrix.set_active(peer_id, doc_id)
             self._matrix.update_theirs(peer_id, doc_id, msg["clock"])
+        if msg.get("noSnapshot"):
+            # the peer could not use our checkpoint bundle (corrupt in
+            # transit, or a policy refusal): our believed clock for it was
+            # already advanced optimistically at send time, so re-extract
+            # from the TRUE clock it just told us and resend plain changes
+            self._no_snapshot.add((peer_id, doc_id))
+            state = self._state(doc_id)
+            if state is not None:
+                changes = Backend.get_missing_changes(
+                    state, msg.get("clock") or {})
+                clock = dict(state.clock)
+                self._matrix.update_theirs(peer_id, doc_id, clock)
+                self._advertised[(peer_id, doc_id)] = clock
+                if changes:
+                    self._peers[peer_id].send_msg(
+                        {"docId": doc_id, "clock": clock,
+                         "changes": changes})
+            return self._doc_set.get_doc(doc_id)
+        if msg.get("checkpoint") is not None:
+            return self._receive_snapshot(peer_id, doc_id, msg)
         if msg.get("changes"):
             # validated + quarantined application: premature changes park
             # in the bounded per-doc quarantine; duplicates dedup
@@ -213,4 +296,32 @@ class SyncHub:
             # `doc_id not in our_clock` guard — but a reconnected peer
             # starts a fresh session and may re-offer them)
             self._peers[peer_id].send_msg({"docId": doc_id, "clock": {}})
+        return self._doc_set.get_doc(doc_id)
+
+    def _receive_snapshot(self, peer_id: str, doc_id: str, msg: dict):
+        """An inbound checkpoint bundle + tail (snapshot bootstrap).
+
+        A verified bundle installs the document directly (no history
+        replay); a corrupt or hash-mismatched one raises the typed
+        ``CheckpointError`` inside, is logged, and degrades to a
+        ``noSnapshot`` re-request — the peer then serves the full log,
+        i.e. the full-replay fallback."""
+        from ..checkpoint import Checkpoint, CheckpointError
+        if self._doc_set.get_doc(doc_id) is not None:
+            # we already hold state for this doc (a race with another
+            # peer's bootstrap): take only the tail, through the gate
+            if msg.get("changes"):
+                return inbound_gate(self._doc_set).deliver(
+                    doc_id, msg["changes"], validated=True)
+            return self._doc_set.get_doc(doc_id)
+        try:
+            ck = Checkpoint.from_base64(msg["checkpoint"])
+            return self._doc_set.bootstrap_doc(
+                doc_id, ck, msg.get("changes") or [], validated=True)
+        except CheckpointError as exc:
+            logger.warning("snapshot bootstrap for doc %r failed (%s); "
+                           "requesting full history", doc_id, exc)
+        if peer_id in self._peers:
+            self._peers[peer_id].send_msg(
+                {"docId": doc_id, "clock": {}, "noSnapshot": True})
         return self._doc_set.get_doc(doc_id)

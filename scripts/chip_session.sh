@@ -9,7 +9,7 @@
 #   - the smoke is scripts/chip_smoke.py: the same device-vs-oracle parity
 #     bar, delivered as bulk apply_changes rounds (dozens of dispatches, not
 #     tens of thousands through a 70 ms-RTT tunnel)
-#   - a smoke TIMEOUT is retryable tunnel weather (probe_forever relaunches);
+#   - a smoke TIMEOUT is retryable tunnel weather (probe.sh --forever relaunches);
 #     only a deterministic parity failure writes the stop-probing marker
 #   - measurements run highest-value first (headline bench, planned A/B)
 #     and are NON-gating: a failed step logs its rc and the session moves on
@@ -58,7 +58,7 @@ export AMTPU_SKIP_PREFLIGHT=1   # this session IS the parent probe
 run "smoke_batched" 600 python scripts/chip_smoke.py
 SMOKE_RC=$?
 if [ "$SMOKE_RC" != "0" ] && [ "$SMOKE_RC" != "1" ]; then
-  # marker text matters: probe_forever stops permanently at "on-chip
+  # marker text matters: probe.sh --forever stops permanently at "on-chip
   # smoke FAILED", so rc=1 (chip_smoke's explicit parity-MISMATCH
   # verdict) is the ONLY code allowed to write it. Everything else is
   # weather: 124 = wrapper timeout, 7 = chip_smoke's own caught infra
@@ -104,7 +104,7 @@ if [ "${AMTPU_SESSION_DRYRUN:-0}" != "1" ]; then
 fi
 
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
-  # a DIFFERENT marker on purpose: probe_forever stops at the real
+  # a DIFFERENT marker on purpose: probe.sh --forever stops at the real
   # "chip session done" marker, and a dry run must not stop the probing
   echo "=== chip session DRYRUN-complete $(date -u +%T) ===" >> "$LOG"
 else

@@ -22,7 +22,7 @@ Scenarios (all compared element-for-element against the oracle backend):
 
 Exit codes tell the session how to react:
   0   every scenario matches
-  1   deterministic parity MISMATCH (probe_forever must stop relaunching —
+  1   deterministic parity MISMATCH (probe.sh --forever must stop relaunching —
       an identical doomed session would hold the chip forever)
   7   infrastructure error (RPC/connection exception from a dropping
       tunnel, OOM, ...) — retryable weather, like the wrapper's rc=124

@@ -1,0 +1,76 @@
+#!/bin/bash
+# TPU tunnel probe — the ONE probe entry point (consolidates the former
+# probe_loop.sh / probe_forever.sh pair).
+#
+#   bash scripts/probe.sh            # one bounded probe loop (~9.5 min):
+#                                    # on tunnel-up, launch chip_session.sh
+#                                    # DETACHED and exit
+#   bash scripts/probe.sh --forever  # keep probing for the whole round;
+#                                    # launch DETACHED so the harness's
+#                                    # background-task cap can't kill it:
+#                                    #   setsid nohup bash scripts/probe.sh \
+#                                    #     --forever > /tmp/probe.log 2>&1 &
+#
+# Forever mode stops when, SINCE LAUNCH (chip_session.log is append-only
+# across rounds, so markers are counted relative to launch):
+#   - a chip session COMPLETED (endless relaunching would hold the chip), or
+#   - a session failed its on-chip smoke (deterministic failure: relaunching
+#     the identical doomed session would hold the chip forever; a
+#     human/agent must look at the log first).
+# A session that dies mid-run from a tunnel drop leaves neither marker and
+# is retried.
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOG="$REPO/scripts/chip_session.log"
+STATUS=/tmp/tpu_probe_status.txt
+DONE_MARK="=== chip session done"
+FAIL_MARK="on-chip smoke FAILED"
+
+probe_once() {
+  # the chip admits ONE client and the probe IS a client: hold the session
+  # lock for the whole loop (a session in flight -> don't probe; our lock
+  # also keeps a session from starting mid-probe)
+  exec 9> /tmp/chip_session.lock
+  if ! flock -n 9; then
+    echo "chip session in flight; not probing ($(date +%H:%M:%S))" >> "$STATUS"
+    return 0
+  fi
+  for i in $(seq 1 6); do
+    echo "probe $i at $(date +%H:%M:%S)" >> "$STATUS"
+    # shared strict probe (real computation, non-cpu platform) — see
+    # scripts/probe_device.py for why the rule lives in exactly one file
+    if timeout 80 python "$REPO/scripts/probe_device.py" >> "$STATUS" 2>&1; then
+      echo "TUNNEL_UP at $(date +%H:%M:%S) — launching chip session" >> "$STATUS"
+      exec 9>&-   # child takes its own lock; ours must be closed
+      setsid nohup bash "$REPO/scripts/chip_session.sh" </dev/null \
+        > /tmp/chip_session_nohup.log 2>&1 &
+      return 0
+    fi
+    sleep 10
+  done
+  echo "TUNNEL_DOWN after 6 probes at $(date +%H:%M:%S)" >> "$STATUS"
+  return 1
+}
+
+count() {  # occurrences of $1 in the session log (0 if no log yet)
+  if [ -f "$LOG" ]; then grep -c "$1" "$LOG" || true; else echo 0; fi
+}
+
+if [ "$1" != "--forever" ]; then
+  probe_once
+  exit $?
+fi
+
+done0=$(count "$DONE_MARK")
+fail0=$(count "$FAIL_MARK")
+while true; do
+  if [ "$(count "$DONE_MARK")" -gt "$done0" ]; then
+    echo "chip session completed; probe --forever exiting ($(date +%H:%M:%S))"
+    exit 0
+  fi
+  if [ "$(count "$FAIL_MARK")" -gt "$fail0" ]; then
+    echo "on-chip smoke FAILED (deterministic); not relaunching — inspect $LOG ($(date +%H:%M:%S))"
+    exit 4
+  fi
+  ( probe_once )
+  sleep 45
+done

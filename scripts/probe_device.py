@@ -2,12 +2,12 @@
 
 Exits 0 iff a jax device actually performs a computation on an acceptable
 platform (non-cpu unless ``--allow-cpu``). Round 4 was lost to gate drift
-across probe sites (`probe_loop.sh` asserted ``platform == 'tpu'`` while
+across probe sites (`probe.sh` (then probe_loop.sh) asserted ``platform == 'tpu'`` while
 the chip stamps ``'axon'`` — VERDICT r4 Weak #1); the acceptance rule
 itself lives in ``benchmarks.common.is_chip_platform`` so every gate
 shares one definition. Callers:
 
-  scripts/probe_loop.sh      (tunnel watch -> auto-launch chip session)
+  scripts/probe.sh           (tunnel watch -> auto-launch chip session)
   scripts/chip_session.sh    (session entry gate)
   benchmarks/common.py       (preflight_device, via subprocess)
 

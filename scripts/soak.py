@@ -15,10 +15,15 @@ Profiles (each session is deterministic in its seed):
   chaos     Connection sync over ChaosLink+ResilientChannel (drop/dup/
             reorder/delay plus one partition/heal cycle) — byte-identical
             convergence after heal, no reconnects needed
+  checkpoint chaos sync with periodic async snapshots of one peer and a
+            mid-run RESTART of that peer from its latest checkpoint
+            bundle (automerge_tpu.checkpoint) — byte-identical
+            convergence after catch-up
 
 Usage:
   python scripts/soak.py [--profile all] [--sessions 30] [--seed-base 0]
   python scripts/soak.py --chaos [--sessions 50]     # chaos campaign
+  python scripts/soak.py --checkpoint [--sessions 10]
 
 Exit 0 iff every session converged; failures print their profile+seed so
 `--profile P --sessions 1 --seed-base SEED` reproduces one exactly.
@@ -380,9 +385,121 @@ def session_chaos(seed: int) -> None:
             f"chaos seed {seed}: quarantine not drained"
 
 
+def session_checkpoint(seed: int) -> None:
+    """Chaos sync with mid-run checkpointing and a peer RESTART: one peer
+    periodically captures its document through the async checkpoint
+    writer (automerge_tpu.checkpoint.AsyncCheckpointer), then mid-chaos
+    its whole DocSet is torn down and rebuilt from the LAST completed
+    checkpoint bundle — in-flight frames die, edits made after the
+    capture are forgotten locally — and the sync protocol must pull the
+    restarted peer back to byte-identical convergence over the still-
+    chaotic links. Exercises capture-under-ingestion, bundle integrity
+    verification, snapshot-bootstrapped rejoin, and tail catch-up in one
+    scenario."""
+    import json as _json
+
+    am = _am()
+    from automerge_tpu import Connection, DocSet, Text
+    from automerge_tpu.checkpoint import AsyncCheckpointer
+    from automerge_tpu.resilience import ChaosLink, ResilientChannel
+
+    rng = np.random.default_rng(seed)
+    n = 3
+    sets = [DocSet() for _ in range(n)]
+    doc0 = am.change(am.init("origin"),
+                     lambda d: d.__setitem__("t", Text("start")))
+    base = am.get_all_changes(doc0)
+    for i, ds in enumerate(sets):
+        ds.set_doc("doc", am.apply_changes(am.init(f"peer-{i}"), base))
+
+    drop = float(rng.uniform(0.05, 0.25))
+    reorder = float(rng.uniform(0.05, 0.25))
+    links, channels, conns = {}, {}, {}
+
+    def wire_edge(a, b):
+        links[(a, b)] = ChaosLink(
+            lambda env, a=a, b=b: channels[(b, a)].on_wire(env),
+            rng=rng, drop=drop, dup=0.05, reorder=reorder, delay=0.1)
+        channels[(a, b)] = ResilientChannel(
+            links[(a, b)].send,
+            lambda msg, a=a, b=b: conns[(a, b)].receive_msg(msg),
+            seed=seed * 7919 + a * 97 + b)
+        conns[(a, b)] = Connection(sets[a], channels[(a, b)].send)
+
+    edges = [(a, b) for a in range(n) for b in range(n) if a != b]
+    for a, b in edges:
+        wire_edge(a, b)
+    for e in edges:
+        conns[e].open()
+
+    def pump(rounds: int = 1):
+        for _ in range(rounds):
+            for e in edges:
+                links[e].pump()
+            for e in edges:
+                channels[e].tick()
+
+    victim = int(rng.integers(0, n))
+    writer = AsyncCheckpointer()
+    handles: list = []
+    bundle = None
+    n_steps = int(rng.integers(14, 22))
+    restart_at = int(rng.integers(6, n_steps - 4))
+    restarted = False
+    try:
+        for step in range(n_steps):
+            i = int(rng.integers(0, n))
+            sets[i].set_doc("doc",
+                            _text_edit(am, sets[i].get_doc("doc"), rng))
+            if step % 3 == 0:        # periodic async snapshot of the victim
+                from automerge_tpu import Frontend
+                state = Frontend.get_backend_state(
+                    sets[victim].get_doc("doc"))
+                handles.append(writer.capture_async(state))
+            if step == restart_at:
+                for h in handles:    # latest completed capture wins
+                    bundle = h.result(30)
+                assert bundle is not None, "no checkpoint completed"
+                # RESTART: the victim loses everything since its last
+                # checkpoint; a fresh DocSet bootstraps from the bundle
+                # and fresh links/channels/conns rejoin the mesh
+                for a, b in edges:
+                    if victim in (a, b):
+                        conns[(a, b)].close()
+                sets[victim] = DocSet()
+                sets[victim].bootstrap_doc("doc", bundle)
+                for a, b in edges:
+                    if victim in (a, b):
+                        wire_edge(a, b)
+                        conns[(a, b)].open()
+                restarted = True
+            pump(1)
+    finally:
+        writer.close()
+    assert restarted
+    for e in edges:                  # heal: lossless from here on
+        links[e].heal()
+        links[e].drop = links[e].dup = 0.0
+        links[e].reorder = links[e].delay = 0.0
+    for _ in range(400):
+        pump(1)
+        if all(ch.idle for ch in channels.values()) \
+                and all(ln.idle for ln in links.values()):
+            break
+    else:
+        raise AssertionError(f"checkpoint seed {seed}: never quiesced")
+    docs = [ds.get_doc("doc") for ds in sets]
+    ok, diff = _converged(am, docs)
+    assert ok, f"checkpoint seed {seed} diverged after restart: {diff}"
+    hists = [sorted(_json.dumps(c, sort_keys=True)
+                    for c in am.get_all_changes(d)) for d in docs]
+    assert hists.count(hists[0]) == len(hists), \
+        f"checkpoint seed {seed}: change histories diverged after restart"
+
+
 PROFILES = {"general": session_general, "conflict": session_conflict,
             "lossy": session_lossy, "table": session_table,
-            "chaos": session_chaos}
+            "chaos": session_chaos, "checkpoint": session_checkpoint}
 
 
 def run(profile: str, sessions: int, seed_base: int) -> int:
@@ -414,10 +531,14 @@ def main():
                     choices=["all"] + list(PROFILES))
     ap.add_argument("--chaos", action="store_true",
                     help="shorthand for --profile chaos")
+    ap.add_argument("--checkpoint", action="store_true",
+                    help="shorthand for --profile checkpoint (snapshot "
+                         "mid-chaos + restart one peer from its bundle)")
     ap.add_argument("--sessions", type=int, default=30)
     ap.add_argument("--seed-base", type=int, default=0)
     args = ap.parse_args()
-    profile = "chaos" if args.chaos else args.profile
+    profile = ("chaos" if args.chaos
+               else "checkpoint" if args.checkpoint else args.profile)
     return run(profile, args.sessions, args.seed_base)
 
 
