@@ -18,6 +18,34 @@ ROOT_ID = "00000000-0000-0000-0000-000000000000"
 KIND_INS, KIND_SET, KIND_DEL, KIND_INC = 0, 1, 2, 3
 HEAD_PARENT = -1  # parent-actor encoding for the virtual list head ('_head')
 
+# The device tier's numeric envelope. Every device column is int32 (the
+# TPU emulates int64; docs/MEASUREMENTS.md), elemId keys pack as
+# (actor_rank << 32 | ctr) into int64 (engine/host_index.py), and actor
+# ranks reproduce the reference's string ordering (op_set.js:432-436) as
+# int32 comparisons — so counters, seqs, and ranks past 2^31-1 would
+# silently wrap into WRONG ORDERING, not crash. check_int32_envelope is
+# the one loud gate every packing/encoding site calls.
+INT32_MAX = 2**31 - 1
+
+
+def check_int32_envelope(name: str, arr, lo: int = 0):
+    """Raise OverflowError when any value of `arr` (numpy array or int)
+    falls outside [lo, INT32_MAX]. O(n) vectorized; the guarded sites are
+    already O(n) column passes."""
+    import numpy as _np
+    arr = _np.asarray(arr)
+    if arr.size == 0:
+        return
+    mx, mn = arr.max(), arr.min()
+    if mx > INT32_MAX or mn < lo:
+        bad = int(mx if mx > INT32_MAX else mn)
+        raise OverflowError(
+            f"{name} value {bad} outside the device int32 envelope "
+            f"[{lo}, {INT32_MAX}]: the columnar tier packs elemId "
+            "counters, seqs, and actor ranks as int32/int64-keys and a "
+            "wrap would silently reorder elements (op_set.js:432-436 "
+            "ordering); shard or re-key the document instead")
+
 # elemId = "<actorId>:<counter>" — counter is a Lamport timestamp unique per list.
 
 

@@ -60,12 +60,23 @@ def _copy_all_deps(all_deps: dict) -> list:
             sorted(all_deps.items(), key=lambda kv: (kv[0][0], kv[0][1]))]
 
 
-def grab(doc) -> dict:
+def grab(doc, inline: bool = False) -> dict:
     """Generation-stamped consistent snapshot of one engine doc.
 
     Cheap (no device traffic). The caller either owns the mutation thread
     (no race possible) or retries on :class:`CaptureConflict` — see
-    :class:`~.writer.AsyncCheckpointer`."""
+    :class:`~.writer.AsyncCheckpointer`.
+
+    The zero-copy contract — grabbed device-table REFERENCES stay valid
+    while ingestion advances — holds because the ingest kernels replace
+    tables, never mutate them. A document running the streaming tier's
+    donated kernels (``doc.donate_buffers``, INTERNALS §9) breaks exactly
+    that: the next commit consumes the grabbed buffers in place. Such
+    docs refuse the deferred grab (:class:`CaptureConflict`, so the
+    async writer degrades to its commit-boundary sync path) unless
+    ``inline=True`` — the caller's promise that the grab is ENCODED
+    before any further commit can run (writer.result() / the synchronous
+    capture path)."""
     from ..engine.map_doc import DeviceMapDoc
     from ..engine.text_doc import DeviceTextDoc
 
@@ -73,6 +84,8 @@ def grab(doc) -> dict:
         raise CheckpointError(
             f"cannot checkpoint {doc.obj_id!r}: it holds causally-unready "
             "queued changes (drain or drop them first)")
+    if getattr(doc, "donate_buffers", False) and not inline:
+        raise CaptureConflict(doc.obj_id)
     if getattr(doc, "_busy", 0):
         # a mutation is in flight: gen stamps alone can't expose one that
         # spans this whole grab (the bump lands at mutation end)
@@ -148,8 +161,10 @@ def encode_grab(g: dict, prefix: str = ""):
 
 
 def capture_engine_doc(doc, prefix: str = ""):
-    """One-shot synchronous capture (grab + encode on this thread)."""
-    return encode_grab(grab(doc), prefix)
+    """One-shot synchronous capture (grab + encode on this thread) —
+    encodes before returning, so donation-enabled docs are safe
+    (inline contract)."""
+    return encode_grab(grab(doc, inline=True), prefix)
 
 
 def _require(arrays: dict, name: str) -> np.ndarray:

@@ -64,8 +64,10 @@ class CheckpointHandle:
             raise TimeoutError("checkpoint capture still in flight")
         if self._needs_sync:
             # degraded path: the caller owns quiescence here (commit
-            # boundary), so a synchronous grab cannot conflict
-            self._data = encode_engine_grab(grab(self._doc))
+            # boundary), so a synchronous grab cannot conflict — and the
+            # grab is encoded before any further (possibly donating)
+            # commit can consume its buffers, hence inline=True
+            self._data = encode_engine_grab(grab(self._doc, inline=True))
             self._needs_sync = False
             self._error = None
         if self._error is not None:
@@ -133,7 +135,9 @@ class AsyncCheckpointer:
         """Synchronous capture (the identity comparator for the async
         path: same target, same bytes)."""
         if _is_engine_doc(target):
-            return encode_engine_grab(grab(target))
+            # synchronous: grabbed refs are encoded before returning, so
+            # a donation-enabled doc is safe here (inline contract)
+            return encode_engine_grab(grab(target, inline=True))
         from .backend_codec import capture_state
         return capture_state(target)
 

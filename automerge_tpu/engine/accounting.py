@@ -1,0 +1,93 @@
+"""Device dispatch & blocking-sync accounting for the streaming tier.
+
+The sustained-throughput story (INTERNALS §9) only holds if the engine's
+device-interaction COUNT is bounded: on a remote-attached chip every
+program launch pays dispatch overhead and every blocking sync pays a full
+link round trip (~70 ms through this environment's WAN tunnel, ~1 ms on
+PCIe), so an accidental extra sync per batch is invisible on cpu and
+catastrophic at deployment. Counting is therefore first-class and
+ASSERTED, not profiled after the fact:
+
+- a **dispatch** is one jitted device program launched by the engine
+  (merge/materialize/residual/scatter/linearize kernels);
+- a **blocking sync** is one forced device->host completion — a d2h
+  fetch the host logic consumes (`np.asarray` of a device array, scalar
+  reads) or an explicit `block_until_ready`. Async h2d staging
+  (`device_put`) is neither: it overlaps planning by design and is
+  tracked separately as `staged_h2d_bytes`.
+
+Counters live in two places, updated together by the engine's
+`_count_dispatch`/`_count_sync` hooks (engine/base.py):
+
+- per-document (`CausalDeviceDoc.dispatch_stats`), with the last
+  committed batch's delta broken out (`last_commit`), so the pipeline
+  ring can assert its per-batch budget;
+- the process-wide totals here, so call sites that span documents (the
+  interactive `am.change` path through backend/device.py) can measure a
+  whole operation with `track()` regardless of which docs it touched.
+
+The regression bars: tests/test_dispatch_budget.py pins the write-behind
+`am.change` path and the ring's per-commit budget; `bench.py --pipeline`
+and benchmarks cfg7 carry the measured counts in their records.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_LOCK = threading.Lock()
+
+# process-wide running totals; monotonically increasing
+TOTALS = {"dispatches": 0, "syncs": 0}
+
+
+def record_dispatch(n: int = 1, acct: dict = None):
+    """Count `n` device program launches (and mirror into a per-doc
+    counter dict under the same lock — the pipeline ring's worker thread
+    and caller thread both dispatch against one document)."""
+    with _LOCK:
+        TOTALS["dispatches"] += n
+        if acct is not None:
+            acct["dispatches"] += n
+
+
+def record_sync(n: int = 1, acct: dict = None):
+    """Count `n` blocking device->host syncs."""
+    with _LOCK:
+        TOTALS["syncs"] += n
+        if acct is not None:
+            acct["syncs"] += n
+
+
+def snapshot() -> dict:
+    with _LOCK:
+        return dict(TOTALS)
+
+
+def delta_since(snap: dict) -> dict:
+    cur = snapshot()
+    return {k: cur[k] - snap.get(k, 0) for k in cur}
+
+
+class track:
+    """Context manager measuring the dispatch/sync delta of a region:
+
+        with accounting.track() as t:
+            doc = am.change(doc, ...)
+        assert t.stats["dispatches"] <= BUDGET
+
+    Process-wide (covers every document the region touched). Not
+    isolated against concurrent device work on OTHER threads — callers
+    that need isolation (the budget tests) run the region quiesced.
+    """
+
+    def __init__(self):
+        self.stats: dict = {}
+
+    def __enter__(self):
+        self._snap = snapshot()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stats = delta_since(self._snap)
+        return False

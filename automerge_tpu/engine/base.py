@@ -29,6 +29,15 @@ from typing import Optional
 import numpy as np
 
 from .._common import KIND_DEL, KIND_INC, KIND_SET
+from . import accounting
+
+import threading
+
+# thread-local accounting region: commit_prepared opens one so its
+# per-batch delta counts ONLY the commit's own device interactions — a
+# pipeline worker's concurrent prepare barriers on the same document
+# must not bleed into the committed batch's budget
+_ACCT_TLS = threading.local()
 
 
 @dataclass
@@ -95,6 +104,19 @@ class CausalDeviceDoc:
 
     batch_type = None  # subclass: columnar batch class (has .from_changes)
 
+    # Streaming-tier knobs (INTERNALS §9). `donate_buffers` selects the
+    # *_donated kernel twins (ops/ingest.py) so steady-state device
+    # allocation stays flat across a pipeline ring — opt-in because a
+    # donated input buffer is DEAD after the kernel: the checkpoint
+    # writer's zero-copy grab (checkpoint/engine_codec.grab) holds raw
+    # table references and must degrade to its commit-boundary sync path
+    # while donation is on. `packed_residual_writeback` ships the host
+    # slow-register resolution back as ONE (6, S) matrix instead of six
+    # per-column arrays (one h2d transfer; the legacy path is the parity
+    # comparator, tests/test_dispatch_budget.py).
+    donate_buffers = False
+    packed_residual_writeback = True
+
     def __init__(self, obj_id: str):
         self.obj_id = obj_id
         self.actor_table: list = []           # rank -> actor id (lex-ordered)
@@ -107,6 +129,16 @@ class CausalDeviceDoc:
         self.value_pool: list = []            # rich values (non-inline)
         self._dev: Optional[dict] = None      # device arrays (lazy)
         self._host: Optional[dict] = None     # numpy mirrors (lazy)
+        self._device_lost = False             # a donated-buffer commit
+        # raised AFTER consuming the live tables: no valid device state
+        # remains, so every later access fails loudly via
+        # _check_device_alive (recovery = checkpoint restore or replay;
+        # INTERNALS §9 donation invariants)
+        self._acct = {"dispatches": 0, "syncs": 0}  # device-interaction
+        # counters (engine/accounting.py): every jitted program launch and
+        # every blocking d2h sync this document performs
+        self.last_commit_stats: Optional[dict] = None  # delta of the most
+        # recent commit_prepared (the pipeline ring's per-batch budget)
         self._gen = 0                         # bumps on every state mutation
         self._busy = 0                        # >0 while a mutation is in
         # flight: generation stamps alone cannot expose a mutation that
@@ -114,6 +146,46 @@ class CausalDeviceDoc:
         # so content-mutating entry points raise this first and drop it
         # last — the checkpoint writer's optimistic grab treats any
         # nonzero observation as a conflict (checkpoint/engine_codec)
+
+    def _check_device_alive(self):
+        """Loud gate every _ensure_dev passes through: a donated-buffer
+        commit that raised after consuming the live tables leaves NO
+        valid device state — resurrecting empty tables would be silent
+        corruption."""
+        if self._device_lost:
+            raise RuntimeError(
+                f"device state of {self.obj_id!r} was lost: a commit with "
+                "buffer donation enabled failed after its input tables "
+                "were consumed. Restore from a checkpoint or replay the "
+                "change log (INTERNALS §9 donation invariants)")
+
+    # ------------------------------------------------------------------
+    # dispatch/sync accounting (engine/accounting.py; INTERNALS §9)
+    # ------------------------------------------------------------------
+
+    def _count_dispatch(self, n: int = 1):
+        accounting.record_dispatch(n, self._acct)
+        region = getattr(_ACCT_TLS, "region", None)
+        if region is not None:
+            region["dispatches"] += n
+
+    def _count_sync(self, n: int = 1):
+        accounting.record_sync(n, self._acct)
+        region = getattr(_ACCT_TLS, "region", None)
+        if region is not None:
+            region["syncs"] += n
+
+    @property
+    def dispatch_stats(self) -> dict:
+        """Device-interaction counts for this document: total jitted
+        program launches (`dispatches`) and blocking device->host syncs
+        (`syncs`) since construction, plus the most recent
+        `commit_prepared`'s delta (`last_commit`) — the quantity the
+        streaming tier's per-batch budget is asserted against."""
+        out = dict(self._acct)
+        out["last_commit"] = (dict(self.last_commit_stats)
+                              if self.last_commit_stats else None)
+        return out
 
     # ------------------------------------------------------------------
     # actor interning (order-preserving: rank order == lexicographic order)
@@ -674,8 +746,12 @@ class CausalDeviceDoc:
                 planned_rounds.append((b, rows_arr, (pairs, closures),
                                        exec_plan))
         # barrier: the prepared plan is complete only once its buffers are
-        # resident (keeps commit free of transfer stalls)
+        # resident (keeps commit free of transfer stalls). Counted as a
+        # blocking sync — it is one — but it lands on the PREPARE side,
+        # which the pipeline ring overlaps under device execution, so it
+        # never appears in a commit's per-batch delta.
         import jax
+        self._count_sync()
         jax.block_until_ready(
             [x for _, _, _, p in planned_rounds if p is not None
              for x in p.staged])
@@ -694,10 +770,23 @@ class CausalDeviceDoc:
         document mutated since the plan was prepared — for a chained plan,
         if its base plan has not committed or anything mutated since."""
         self._busy += 1
+        # thread-local region: the delta counts the COMMIT's own device
+        # interactions only — concurrent worker-thread prepares against
+        # this doc (the pipeline ring) update the doc totals but not this
+        region = {"dispatches": 0, "syncs": 0}
+        prior_region = getattr(_ACCT_TLS, "region", None)
+        _ACCT_TLS.region = region
+        n_rounds = len(prepared.rounds)     # severed on success — read now
         try:
-            return self._commit_prepared(prepared)
+            out = self._commit_prepared(prepared)
         finally:
             self._busy -= 1
+            _ACCT_TLS.region = prior_region
+        # per-committed-batch device-interaction delta: the quantity the
+        # streaming tier budgets (asserted <= a small constant on the
+        # write-behind path; carried in bench --pipeline records)
+        self.last_commit_stats = {**region, "n_rounds": n_rounds}
+        return out
 
     def _commit_prepared(self, prepared: PreparedBatch):
         if prepared.committed_gen is not None:
@@ -934,11 +1023,46 @@ class CausalDeviceDoc:
             else:
                 self.conflicts.pop(s, None)
 
-        out = scatter_registers(
-            dev["value"], dev["has_value"], dev["win_actor"], dev["win_seq"],
-            dev["win_counter"], jnp.asarray(slots_p), jnp.asarray(w_v),
-            jnp.asarray(w_h), jnp.asarray(w_wa), jnp.asarray(w_ws),
-            jnp.asarray(w_wc))
+        regs_in = (dev["value"], dev["has_value"], dev["win_actor"],
+                   dev["win_seq"], dev["win_counter"])
+        self._count_dispatch()
+        try:
+            if self.packed_residual_writeback:
+                # ONE packed h2d upload: with the packed slow_info fetch
+                # this makes the whole residual register residue exactly
+                # one d2h round trip + one upload (the WAN-tunnel shape
+                # cfg5b bounds)
+                from ..ops.ingest import (donation_enabled,
+                                          scatter_registers_packed,
+                                          scatter_registers_packed_donated)
+                wb = np.zeros((6, S), np.int32)
+                wb[0] = slots_p
+                wb[1] = w_v
+                wb[2] = w_h
+                wb[3] = w_wa
+                wb[4] = w_ws
+                wb[5] = w_wc
+                fn = (scatter_registers_packed_donated
+                      if self.donate_buffers and donation_enabled()
+                      else scatter_registers_packed)
+                out = fn(*regs_in, jnp.asarray(wb))
+            else:
+                # legacy per-column upload (parity comparator): six
+                # separate transfers, each paying per-transfer latency
+                out = scatter_registers(
+                    *regs_in, jnp.asarray(slots_p), jnp.asarray(w_v),
+                    jnp.asarray(w_h), jnp.asarray(w_wa), jnp.asarray(w_ws),
+                    jnp.asarray(w_wc))
+        except BaseException:
+            # same donation invariant as the commit kernels (INTERNALS
+            # §9.3): a raising donated writeback that CONSUMED the live
+            # register tables leaves no valid device state — poison
+            # loudly; a failure before consumption stays retryable
+            from ..ops.ingest import buffers_consumed
+            if self.donate_buffers and buffers_consumed(regs_in):
+                self._device_lost = True
+                self._dev = None
+            raise
         dev["value"], dev["has_value"], dev["win_actor"], dev["win_seq"], \
             dev["win_counter"] = out
         self._invalidate()
@@ -950,6 +1074,8 @@ class CausalDeviceDoc:
         from ..ops.ingest import pack_rows
         import jax.numpy as jnp
         dev = self._ensure_dev()
+        self._count_dispatch()          # pack_rows program
+        self._count_sync()              # the packed d2h fetch
         packed = np.asarray(pack_rows(*(dev[k] for k in keys)))
         out = {}
         for i, k in enumerate(keys):

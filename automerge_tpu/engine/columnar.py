@@ -17,7 +17,18 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .._common import (HEAD_PARENT, KIND_DEL, KIND_INC, KIND_INS,  # noqa: F401
-                       KIND_SET, parse_elem_id)
+                       KIND_SET, check_int32_envelope, parse_elem_id)
+
+
+def _int32_col(name: str, values, lo: int = 0) -> np.ndarray:
+    """Build an int32 column with a loud envelope check: numpy's cast
+    behavior for out-of-range Python ints varies by version (wrap vs
+    raise), and a wrapped counter/seq would silently reorder elements on
+    device (int32 comparisons stand in for the reference's string
+    ordering). Stage through int64, gate, then narrow."""
+    arr = np.asarray(values, np.int64)
+    check_int32_envelope(name, arr, lo=lo)
+    return arr.astype(np.int32)
 
 
 def intern_deps(deps: list) -> list:
@@ -124,7 +135,7 @@ class MapChangeBatch:
 
         return cls(
             obj_id=obj_id, actors=actors,
-            seqs=np.asarray(seqs, np.int32), deps=intern_deps(deps),
+            seqs=_int32_col("seq", seqs, lo=1), deps=intern_deps(deps),
             messages=messages,
             op_change=np.asarray(cols["change"], np.int32),
             op_kind=np.asarray(cols["kind"], np.int8),
@@ -284,14 +295,16 @@ class TextChangeBatch:
 
         return cls(
             obj_id=obj_id, actors=actors,
-            seqs=np.asarray(seqs, np.int32), deps=intern_deps(deps),
+            seqs=_int32_col("seq", seqs, lo=1), deps=intern_deps(deps),
             messages=messages,
             op_change=np.asarray(cols["change"], np.int32),
             op_kind=np.asarray(cols["kind"], np.int8),
             op_target_actor=np.asarray(cols["ta"], np.int32),
-            op_target_ctr=np.asarray(cols["tc"], np.int32),
+            # elemId counters ride the int64 packed-key format and the
+            # int32 device ctr column: wrap = silent reordering, so gate
+            op_target_ctr=_int32_col("elemId counter", cols["tc"]),
             op_parent_actor=np.asarray(cols["pa"], np.int32),
-            op_parent_ctr=np.asarray(cols["pc"], np.int32),
+            op_parent_ctr=_int32_col("parent elemId counter", cols["pc"]),
             op_value=np.asarray(cols["val"], np.int64),
             actor_table=actor_table, value_pool=value_pool,
         )

@@ -54,6 +54,7 @@ class DeviceMapDoc(CausalDeviceDoc):
         self._cap = max(self._cap, bucket(max(n, 16)))
 
     def _ensure_dev(self) -> dict:
+        self._check_device_alive()
         if self._dev is None:
             import jax.numpy as jnp
             cap = self._cap
@@ -76,6 +77,7 @@ class DeviceMapDoc(CausalDeviceDoc):
         import jax.numpy as jnp
         from ..ops.ingest import remap_ranks
         dev = self._ensure_dev()
+        self._count_dispatch()
         dev["win_actor"] = remap_ranks(dev["win_actor"], jnp.asarray(remap))
 
     def _intern_keys(self, keys) -> np.ndarray:
@@ -121,6 +123,7 @@ class DeviceMapDoc(CausalDeviceDoc):
         if self.conflicts:
             conflict_slots[: len(self.conflicts)] = list(self.conflicts)
 
+        self._count_dispatch()
         (value_n, has_n, wa_n, ws_n, wc_n, slow_info) = apply_map_round(
             dev["value"], dev["has_value"], dev["win_actor"],
             dev["win_seq"], dev["win_counter"],
@@ -135,6 +138,7 @@ class DeviceMapDoc(CausalDeviceDoc):
         self._host = None
 
         # one packed transfer: slow mask + slots + register state
+        self._count_sync()
         info = np.asarray(slow_info)[:, :n_ops]
         if info[0].any():
             idxs = np.nonzero(info[0])[0]

@@ -18,16 +18,22 @@ This module closes the planning half of that gap:
   monolithic copy at the end; the prepare-side completion barrier
   (engine/base.py prepare_batch) is unchanged and still guarantees the
   plan's buffers are resident before commit.
-- `PipelinedIngestor` — the double-buffered background planner: a worker
-  thread prepares batch k+1 *chained onto* batch k's still-uncommitted
-  plan (engine/base.py `prepare_batch(after=...)`) while the caller
-  thread commits batch k and the device executes its kernels. Two
-  PreparedBatch slots bound the speculation; every commit is
-  generation-checked, and a mismatch (the document mutated outside the
-  pipeline) falls back to a fresh inline prepare instead of corrupting
-  state. This is what makes `bench.py run_overlapped` a true pipeline in
-  ONE process: host planning of round k+1, host bookkeeping of round k,
-  and device execution of round k genuinely overlap.
+- `PipelinedIngestor` — the K-deep in-flight batch ring (INTERNALS §9):
+  a worker thread prepares batch k+1 *chained onto* batch k's
+  still-uncommitted plan (engine/base.py `prepare_batch(after=...)`)
+  while the caller thread commits batch k and the device executes its
+  kernels. `slots` PreparedBatch slots bound the speculation (default
+  `AMTPU_PIPELINE_DEPTH`, 4): at depth K the worker can run K-1 chained
+  plans ahead of the commit front, so a long stream of
+  causally-independent batches keeps host planning, h2d staging, commit
+  bookkeeping, and device execution ALL saturated — one slow phase no
+  longer stalls the others (double buffering only hid one phase; the
+  ring amortizes all of them). Every commit is generation-checked, and
+  a mismatch (the document mutated outside the pipeline) falls back to
+  a fresh inline prepare instead of corrupting state. `stats` reports
+  how the session actually ran (chained vs serial prepares, fallbacks,
+  committed batches) — `bench.py --pipeline` records them next to the
+  throughput number.
 
 Jiffy's batch-update/snapshot split and PAM's bulk-parallel map
 construction (PAPERS.md) are the shape being reproduced: bulk-plan on
@@ -55,6 +61,19 @@ def plan_workers() -> int:
     if w <= 0:
         w = min(4, os.cpu_count() or 1)
     return max(1, w)
+
+
+def pipeline_depth() -> int:
+    """Default in-flight slot count of the batch ring (K). K-1 chained
+    plans can run ahead of the commit front; 4 keeps planning, staging,
+    commit, and device execution all occupied without unbounded
+    speculation (each slot pins its plan's staged device buffers until
+    commit). AMTPU_PIPELINE_DEPTH overrides; 1 degrades to serial."""
+    try:
+        k = int(os.environ.get("AMTPU_PIPELINE_DEPTH", "0"))
+    except ValueError:
+        k = 0
+    return k if k >= 1 else 4
 
 
 def planner_pool():
@@ -109,7 +128,7 @@ _SERIAL = object()   # worker marker: batch not chainable, prepare inline
 
 
 class PipelinedIngestor:
-    """Double-buffered background planner for one CausalDeviceDoc.
+    """K-deep in-flight batch ring for one CausalDeviceDoc.
 
     Contract: while a pipeline session is open, the document is mutated
     ONLY through it. The worker thread prepares each fed batch chained
@@ -117,10 +136,19 @@ class PipelinedIngestor:
     (`prepare_batch(after=...)`), so planning of batch k+1 overlaps both
     the caller's commit bookkeeping for batch k and the device's kernel
     execution; `slots` bounds the speculation depth (2 = classic double
-    buffering). Commits stay generation-checked: if the document moved
+    buffering; default AMTPU_PIPELINE_DEPTH, 4 — the sustained-streaming
+    ring). Commits stay generation-checked: if the document moved
     under a pending plan (outside mutation, or a chained base that
     failed), `flush()` degrades that batch to a fresh inline
     prepare+commit — semantics are always exactly apply_batch's.
+
+    `donate=True` additionally switches the document onto the donated
+    commit kernels for the session (ops/ingest.py `*_donated`): XLA may
+    write each round's output tables in place of the inputs, so
+    steady-state device allocation is flat across the ring instead of
+    holding K dead table generations. The flag is restored on close();
+    see engine/base.py `donate_buffers` for why it is incompatible with
+    the checkpoint writer's zero-copy grab.
 
     Batches whose actor interning would reorder existing ranks cannot be
     planned concurrently with an uncommitted base (the remap would
@@ -131,9 +159,9 @@ class PipelinedIngestor:
     path is the common case.
     """
 
-    def __init__(self, doc, slots: int = 2):
+    def __init__(self, doc, slots: int = None, donate: bool = False):
         self.doc = doc
-        self._n_slots = max(1, slots)
+        self._n_slots = max(1, pipeline_depth() if slots is None else slots)
         self._slots = threading.Semaphore(self._n_slots)
         self._in: "queue.Queue" = queue.Queue()
         self._out: "queue.Queue" = queue.Queue()
@@ -142,7 +170,18 @@ class PipelinedIngestor:
         self._cv = threading.Condition()
         self._n_committed = 0
         self._fallbacks = 0     # commits that degraded to a fresh prepare
+        self._chained = 0       # background prepares chained onto a base
+        self._serial = 0        # batches the caller had to prepare inline
+        # running min/max of the per-commit device-interaction deltas
+        # (doc.last_commit_stats): the ring's public budget surface, so
+        # consumers never re-implement the drain loop to sample it
+        self._budget = {"dispatches_min": None, "dispatches_max": 0,
+                        "syncs_min": None, "syncs_max": 0}
         self._closing = False
+        self._donate = donate
+        self._donate_prior = getattr(doc, "donate_buffers", False)
+        if donate:
+            doc.donate_buffers = True
         # serializes prepare_batch calls between the worker and the
         # caller's degraded-path inline re-prepares (commit_next): two
         # concurrent UNCHAINED prepares could race actor interning
@@ -176,6 +215,24 @@ class PipelinedIngestor:
             self._in.put(None)
             self._thread.join()
             self._started = False
+        if self._donate:
+            self.doc.donate_buffers = self._donate_prior
+
+    @property
+    def stats(self) -> dict:
+        """How the session actually ran: ring depth, committed batches,
+        chained vs caller-inline (serial) prepares, and degraded-path
+        fallbacks. Carried in bench --pipeline records so a ring that
+        silently degraded to serial planning cannot pass as pipelined."""
+        with self._cv:
+            return {"depth": self._n_slots,
+                    "committed": self._n_committed,
+                    "chained_prepares": self._chained,
+                    "fresh_prepares": (self._n_committed - self._chained
+                                       - self._serial),
+                    "serial_prepares": self._serial,
+                    "fallbacks": self._fallbacks,
+                    "per_commit_budget": dict(self._budget)}
 
     # -- feeding / committing --------------------------------------------
     def feed(self, batch):
@@ -206,6 +263,8 @@ class PipelinedIngestor:
                 raise PipelineError(
                     "background prepare failed") from err
             if plan is _SERIAL:
+                with self._cv:
+                    self._serial += 1
                 with self._prep_lock:
                     plan = self.doc.prepare_batch(batch)
             try:
@@ -226,6 +285,16 @@ class PipelinedIngestor:
                 self._n_committed += 1
                 self._cv.notify_all()
             self._slots.release()
+        # reached on successful commits only: fold the committed batch's
+        # device-interaction delta into the public budget surface
+        st = getattr(self.doc, "last_commit_stats", None)
+        if st:
+            with self._cv:
+                b = self._budget
+                for k in ("dispatches", "syncs"):
+                    b[k + "_max"] = max(b[k + "_max"], st[k])
+                    b[k + "_min"] = (st[k] if b[k + "_min"] is None
+                                     else min(b[k + "_min"], st[k]))
 
     def flush(self):
         """Commit every batch still in flight; returns the document."""
@@ -278,6 +347,9 @@ class PipelinedIngestor:
                 try:
                     with self._prep_lock:
                         plan = self.doc.prepare_batch(batch, after=base)
+                    if base is not None:
+                        with self._cv:
+                            self._chained += 1
                 except ValueError:
                     # not chainable (actor remap / missing shadow):
                     # the caller prepares this one inline after the

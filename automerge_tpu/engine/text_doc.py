@@ -161,6 +161,7 @@ class DeviceTextDoc(CausalDeviceDoc):
     # ------------------------------------------------------------------
 
     def _ensure_dev(self) -> dict:
+        self._check_device_alive()
         if self._dev is None:
             import jax.numpy as jnp
             cap = self._cap
@@ -204,6 +205,7 @@ class DeviceTextDoc(CausalDeviceDoc):
         import jax.numpy as jnp
         from ..ops.ingest import remap_actors
         dev = self._ensure_dev()
+        self._count_dispatch()
         actor_n, wa_n = remap_actors(
             dev["actor"], dev["win_actor"], jnp.asarray(remap),
             np.int32(self.n_elems))
@@ -364,6 +366,10 @@ class DeviceTextDoc(CausalDeviceDoc):
         N = bucket(n_pairs, 256) if n_runs else 0
         needed = base_elems + 1 + (N if dense else n_ins)
         out_cap = max(bucket(needed), base_cap)
+        from .._common import check_int32_envelope
+        # slots live in int32 device columns; past this the padding bucket
+        # itself wraps — fail loudly (shard the doc) instead
+        check_int32_envelope("element slot capacity", out_cap)
 
         desc_dev = blob_dev = None
         ascii_clear = False
@@ -516,9 +522,8 @@ class DeviceTextDoc(CausalDeviceDoc):
         """Commit a planned round: index/count bookkeeping + device
         dispatches (+ the host slow-register path when flagged)."""
         import jax.numpy as jnp
-        from ..ops.ingest import (apply_residual_packed, break_chains_packed,
-                                  bucket, expand_runs_dense_packed,
-                                  expand_runs_packed)
+        from ..ops import ingest as K
+        from ..ops.ingest import bucket, donation_enabled
 
         out_cap = plan.out_cap
         self.index = plan.index_after
@@ -527,63 +532,91 @@ class DeviceTextDoc(CausalDeviceDoc):
         dev = self._ensure_dev()
         tables = tuple(dev[k] for k in self._TABLE_KEYS)
 
-        fused_mat = None
-        if plan.n_runs:
-            if plan.dense:
-                if (plan.seg_plan is not None and self.eager_materialize
-                        and self.use_condensed and plan.n_res == 0):
-                    # fused merge + HOST-PLANNED materialization: no device
-                    # sort, no pointer doubling (engine/segments.py)
-                    from ..ops.ingest import merge_and_materialize_dense_planned
+        # streaming-tier donation: once the first donated kernel consumes
+        # the live tables, a raising step before `self._dev` is rebound
+        # leaves NO valid device state — mark the doc lost so every later
+        # access fails loudly (see _ensure_dev) instead of corrupting
+        donate = self.donate_buffers and donation_enabled()
+        try:
+            fused_mat = None
+            slow_info_np = None
+            if (plan.n_runs and plan.dense and self.eager_materialize
+                    and self.use_condensed and plan.n_res == 0):
+                if plan.seg_plan is not None:
+                    # fused merge + HOST-PLANNED materialization: no
+                    # device sort, no pointer doubling (engine/segments)
+                    fn = (K.merge_and_materialize_dense_planned_donated
+                          if donate
+                          else K.merge_and_materialize_dense_planned)
                     S = plan.seg_S
                     _, L, as_u8 = self._mat_params(
                         seg_bound=S, n_elems=plan.n_elems_after,
                         cap=out_cap,
                         ascii_=self.all_ascii and not plan.ascii_clear)
-                    out = merge_and_materialize_dense_planned(
-                        *tables, plan.desc, plan.blob, plan.seg_plan,
-                        out_cap=out_cap, S=S, as_u8=as_u8, L=L)
-                    tables = out[:9]
-                    fused_mat = (out[9], out[10], S)
-                elif (self.eager_materialize and self.use_condensed
-                        and plan.n_res == 0):
-                    from ..ops.ingest import merge_and_materialize_dense
+                    self._count_dispatch()
+                    out = fn(*tables, plan.desc, plan.blob,
+                             plan.seg_plan, out_cap=out_cap, S=S,
+                             as_u8=as_u8, L=L)
+                else:
+                    fn = (K.merge_and_materialize_dense_donated if donate
+                          else K.merge_and_materialize_dense)
                     S, L, as_u8 = self._mat_params(
                         seg_bound=self._seg_bound + plan.seg_inc,
                         n_elems=plan.n_elems_after, cap=out_cap,
                         ascii_=self.all_ascii and not plan.ascii_clear)
-                    out = merge_and_materialize_dense(
-                        *tables, plan.desc, plan.blob, out_cap=out_cap,
-                        S=S, as_u8=as_u8, L=L)
-                    tables = out[:9]
-                    fused_mat = (out[9], out[10], S)
-                else:
-                    tables = expand_runs_dense_packed(
-                        *tables, plan.desc, plan.blob, out_cap=out_cap)
+                    self._count_dispatch()
+                    out = fn(*tables, plan.desc, plan.blob,
+                             out_cap=out_cap, S=S, as_u8=as_u8, L=L)
+                tables = out[:9]
+                fused_mat = (out[9], out[10], S)
             else:
-                tables = expand_runs_packed(
-                    *tables, plan.desc, plan.blob, out_cap=out_cap)
-
-        slow_info_np = None
-        if plan.n_res:
-            # conflict slots are built at execute time (NOT staged at plan
-            # time): an earlier round of the same prepared batch may have
-            # minted new conflicts through the slow path
-            K = bucket(max(len(self.conflicts), 1), 64)
-            conflict_slots = np.full(K, out_cap, np.int32)
-            if self.conflicts:
-                conflict_slots[: len(self.conflicts)] = list(self.conflicts)
-            out = apply_residual_packed(
-                *tables, plan.res, jnp.asarray(conflict_slots),
-                out_cap=out_cap)
-            tables = out[:9]
-            # one packed transfer: slow mask + slots + register state
-            slow_info_np = np.asarray(out[9])[:, : plan.n_res]
-
-        if plan.touch is not None:
-            chain_n = break_chains_packed(
-                tables[8], tables[0], tables[1], tables[2], plan.touch)
-            tables = tables[:8] + (chain_n,)
+                # every other round shape — dense/sparse expansion,
+                # residual placement + register fast path, chain breaks —
+                # is ONE fused device program (apply_mixed_round): one
+                # dispatch per committed round, and XLA fuses the phases
+                # instead of round-tripping tables between three programs
+                expand_kind = (("dense" if plan.dense else "sparse")
+                               if plan.n_runs else "none")
+                with_res = bool(plan.n_res)
+                with_touch = plan.touch is not None
+                if with_res:
+                    # conflict slots are built at execute time (NOT staged
+                    # at plan time): an earlier round of the same prepared
+                    # batch may have minted conflicts through the slow path
+                    Kc = bucket(max(len(self.conflicts), 1), 64)
+                    conflict_slots = np.full(Kc, out_cap, np.int32)
+                    if self.conflicts:
+                        conflict_slots[: len(self.conflicts)] = \
+                            list(self.conflicts)
+                    conflict_dev = jnp.asarray(conflict_slots)
+                else:
+                    conflict_dev = K._dummy_i32()
+                dummy = K._dummy_i32()
+                fn = (K.apply_mixed_round_donated if donate
+                      else K.apply_mixed_round)
+                self._count_dispatch()
+                out = fn(*tables,
+                         plan.desc if plan.desc is not None else dummy,
+                         plan.blob if plan.blob is not None else dummy,
+                         plan.res if plan.res is not None else dummy,
+                         conflict_dev,
+                         plan.touch if plan.touch is not None else dummy,
+                         out_cap=out_cap, expand_kind=expand_kind,
+                         with_res=with_res, with_touch=with_touch)
+                tables = out[:9]
+                if with_res:
+                    # the ONE d2h round trip of the residual path: slow
+                    # mask + slots + register state, one packed transfer
+                    self._count_sync()
+                    slow_info_np = np.asarray(out[9])[:, : plan.n_res]
+        except BaseException:
+            # poison ONLY when a donated kernel actually consumed the live
+            # tables (a trace/compile failure consumes nothing and stays
+            # retryable — the tables are still valid)
+            if donate and K.buffers_consumed(tables):
+                self._device_lost = True
+                self._dev = None
+            raise
 
         self._dev = dict(zip(self._TABLE_KEYS, tables))
         self._cap = out_cap
@@ -675,6 +708,7 @@ class DeviceTextDoc(CausalDeviceDoc):
             n = self._n_elems_dev[1]
         else:
             n = np.int32(self.n_elems)
+        self._count_dispatch()          # one materialize program
         if (self.prefer_planned and self.seg_mirror is not None
                 and self.seg_mirror.n_segs + 2 <= S):
             # host-planned structure: device skips the structural S-stage
@@ -700,6 +734,7 @@ class DeviceTextDoc(CausalDeviceDoc):
                 self._materialize(with_pos=False)
             heals = 0
             while True:
+                self._count_sync()      # the read path's one device sync
                 scalars = np.asarray(self._mat[-1])
                 n_segs = int(scalars[1])
                 if len(scalars) == 5:
@@ -763,6 +798,7 @@ class DeviceTextDoc(CausalDeviceDoc):
             elif self.use_condensed:
                 self._materialize(with_pos=True)
                 self._scalars()  # verify the S bucket fit (re-runs if not)
+                self._count_sync()
                 self._pos_cache = np.asarray(
                     self._mat[0])[: self.n_elems + 1]
             else:
@@ -785,6 +821,8 @@ class DeviceTextDoc(CausalDeviceDoc):
 
         valid = np.zeros(cap, bool)
         valid[:n] = True
+        self._count_dispatch()
+        self._count_sync()
         pos = rga_linearize(jnp.asarray(padded(h["parent"])),
                             jnp.asarray(padded(h["ctr"])),
                             jnp.asarray(padded(h["actor"])),
@@ -821,6 +859,7 @@ class DeviceTextDoc(CausalDeviceDoc):
                     return out
             self._materialize(with_pos=False)
             n_vis = int(self._scalars()[0])   # may re-run w/ bigger S
+            self._count_sync()                # the O(doc) codes pull
             values = np.asarray(self._mat[-2])[:n_vis]
             self.pull_stats = {"mode": "full",
                                "span_bytes": int(values.nbytes),
@@ -875,6 +914,8 @@ class DeviceTextDoc(CausalDeviceDoc):
             n = self._n_elems_dev[1]
         else:
             n = np.int32(self.n_elems)
+        self._count_dispatch()
+        self._count_sync()
         return np.asarray(segment_visible_counts(
             dev["has_value"], n, segplan_dev, S=S, L=L))
 
@@ -1004,6 +1045,8 @@ class DeviceTextDoc(CausalDeviceDoc):
             spans_np = np.zeros((2, Db), np.int32)
             spans_np[0, :n_spans] = span_starts
             spans_np[1, :n_spans] = span_lens
+            self._count_dispatch()
+            self._count_sync()
             buf = np.asarray(gather_spans(codes, jnp.asarray(spans_np),
                                           P=P))[:total]
             pulled = buf.tobytes().decode("ascii")

@@ -27,10 +27,26 @@ the chain-condensed RGA linearization (see ops/linearize.py).
 
 All shapes are static; callers bucket sizes with `bucket()` so XLA retraces
 rarely.
+
+**Buffer donation (the streaming tier, INTERNALS §9).** The commit-path
+kernels that *replace* the document tables (`expand_runs*_packed`,
+`apply_residual_packed`, `merge_and_materialize_dense*`,
+`break_chains_packed`, `scatter_registers_packed`) each have a
+`*_donated` twin jitted with ``donate_argnums`` over the table operands:
+XLA may then write outputs in place of the inputs, so a K-deep pipeline
+ring's steady-state device allocation is flat (one table set + staged
+inputs) instead of accumulating K generations of dead tables until the
+allocator catches up. Donation is a caller CONTRACT, not a hint the
+engine can ignore: a donated input buffer is dead after the call, so the
+engine only selects the donated twins when the document has opted in
+(``CausalDeviceDoc.donate_buffers`` — the checkpoint writer's zero-copy
+grab holds raw table references and is incompatible; see
+checkpoint/engine_codec.grab).
 """
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -46,6 +62,57 @@ def bucket(n: int, minimum: int = 256) -> int:
     while cap < n:
         cap = cap * 3 // 2 if (cap & (cap - 1)) == 0 else (cap // 3) * 4
     return cap
+
+
+# the 9 element-table operands every commit-path kernel leads with
+_TABLE_ARGNUMS = tuple(range(9))
+_REG_ARGNUMS = tuple(range(5))      # the 5 register tables
+
+_DONATION = None
+_DONATION_FILTERED = False
+
+
+def donation_enabled() -> bool:
+    """Whether the *_donated kernel twins are usable on this backend.
+
+    Donation is an aliasing optimization; results are identical either
+    way, but backends that cannot alias emit a per-compile warning which
+    this gate suppresses once. ``AMTPU_DONATE=0/1`` forces the answer
+    (tests force 1 on cpu to exercise the donated code path); the
+    default is on for every non-cpu backend — exactly the platforms
+    where steady-state HBM headroom matters."""
+    global _DONATION, _DONATION_FILTERED
+    if _DONATION is None:
+        v = os.environ.get("AMTPU_DONATE", "")
+        if v in ("0", "1"):
+            _DONATION = v == "1"
+        else:
+            _DONATION = jax.default_backend() != "cpu"
+    if _DONATION and not _DONATION_FILTERED:
+        # registered ONCE: this sits on the per-committed-round hot path,
+        # and filterwarnings() invalidates the process-wide warning cache
+        # on every call
+        _DONATION_FILTERED = True
+        import warnings
+        # backends that cannot alias a particular donated operand
+        # (shape-growing rounds; cpu) warn per compile — donation is
+        # best-effort there by design
+        warnings.filterwarnings("ignore", message=".*onated buffer.*")
+    return _DONATION
+
+
+def buffers_consumed(arrays) -> bool:
+    """True iff any of `arrays` was consumed by a donated call — the
+    poison-or-recover decision after a raising donated commit (a
+    trace/compile failure consumes nothing and must stay retryable)."""
+    return any(getattr(a, "is_deleted", lambda: False)() for a in arrays)
+
+
+def _jit_pair(fn, donate_argnums, static_argnames=()):
+    """(plain, donated) jit twins of one kernel implementation."""
+    kw = {"static_argnames": static_argnames} if static_argnames else {}
+    return (jax.jit(fn, **kw),
+            jax.jit(fn, donate_argnums=donate_argnums, **kw))
 
 
 def _ext(a, fill, out_cap):
@@ -79,34 +146,81 @@ def expand_runs(
     R = run_head_slot.shape[0]
     N = blob.shape[0]
 
-    # run-of-element: scatter run ids at each run's first element, cummax
-    ridx = jnp.arange(R, dtype=jnp.int32)
-    run_of = jnp.zeros(N, jnp.int32).at[run_elem_base].max(ridx, mode="drop")
-    run_of = jax.lax.cummax(run_of)
+    # GATHER-FREE, like `expand_runs_dense`: every per-element column —
+    # including the target SLOT itself — is piecewise affine over runs
+    # (constant or +1 per element, resetting at run starts), so instead
+    # of `table[run_of]` gathers the columns come from one (6, N)
+    # boundary-delta cumsum; the only O(N)-indexed operation left is the
+    # final single stacked (C, 9) scatter (shared index vector across
+    # all nine columns — scatter cost is per-INDEX, so one pass instead
+    # of nine is a ~3.4x measured win at residual-round shapes;
+    # docs/MEASUREMENTS.md streaming-tier entry).
+    run_len_prev = run_elem_base - jnp.concatenate(
+        [jnp.zeros(1, run_elem_base.dtype), run_elem_base[:-1]])
+    prev = lambda a: jnp.concatenate([jnp.zeros(1, a.dtype), a[:-1]])
+    first = jnp.arange(R, dtype=jnp.int32) == 0
+    # +1-per-element columns: reset to (ctr0, head_slot) at run starts
+    d_ctr = jnp.where(first, run_ctr0,
+                      run_ctr0 - (prev(run_ctr0) + run_len_prev - 1))
+    d_slot = jnp.where(first, run_head_slot,
+                       run_head_slot
+                       - (prev(run_head_slot) + run_len_prev - 1))
+    # piecewise-constant columns: value deltas at run starts
+    wa_v = jnp.where(run_has_value, run_win_actor, -1)
+    ws_v = jnp.where(run_has_value, run_win_seq, 0)
+    has_v = run_has_value.astype(jnp.int32)
+    d_actor = jnp.where(first, run_actor, run_actor - prev(run_actor))
+    d_wa = jnp.where(first, wa_v, wa_v - prev(wa_v))
+    d_ws = jnp.where(first, ws_v, ws_v - prev(ws_v))
+    d_has = jnp.where(first, has_v, has_v - prev(has_v))
+
+    deltas = jnp.ones((6, N), jnp.int32)
+    deltas = deltas.at[2:].set(0)
+    deltas = deltas.at[:, run_elem_base].set(
+        jnp.stack([d_ctr, d_slot, d_actor, d_wa, d_ws, d_has]),
+        mode="drop")                      # padding runs: elem_base == N
+    cols = jnp.cumsum(deltas, axis=1)
+    ctr_col, slot_col = cols[0], cols[1]
 
     j = jnp.arange(N, dtype=jnp.int32)
     live = j < n_run_elems
-    off = j - run_elem_base[run_of]
-    slot = run_head_slot[run_of] + off
-    tgt = jnp.where(live, slot, out_cap)        # OOB sentinel drops padding
+    is_start = jnp.zeros(N, bool).at[run_elem_base].set(True, mode="drop")
+    tgt = jnp.where(live, slot_col, out_cap)    # OOB sentinel drops padding
+    # parent: slot-1 everywhere except run heads (R-sized scatter)
+    parent_col = (slot_col - 1).at[run_elem_base].set(
+        run_parent_slot, mode="drop")
+    has_col = (cols[5] > 0) & live
 
-    parent_e = jnp.where(off == 0, run_parent_slot[run_of], slot - 1)
-    has = run_has_value[run_of]
+    return _scatter_rows_9(
+        (parent, ctr, actor, value, has_value, win_actor, win_seq,
+         win_counter, chain),
+        tgt,
+        (parent_col, ctr_col, cols[2], blob.astype(jnp.int32), has_col,
+         jnp.where(has_col, cols[3], -1), jnp.where(has_col, cols[4], 0),
+         jnp.zeros(N, jnp.int32), live & ~is_start),
+        out_cap)
 
-    parent_n = _ext(parent, 0, out_cap).at[tgt].set(parent_e, mode="drop")
-    ctr_n = _ext(ctr, 0, out_cap).at[tgt].set(run_ctr0[run_of] + off, mode="drop")
-    actor_n = _ext(actor, 0, out_cap).at[tgt].set(run_actor[run_of], mode="drop")
-    value_n = _ext(value, 0, out_cap).at[tgt].set(
-        blob.astype(value.dtype), mode="drop")
-    has_n = _ext(has_value, False, out_cap).at[tgt].set(has, mode="drop")
-    wa_n = _ext(win_actor, -1, out_cap).at[tgt].set(
-        jnp.where(has, run_win_actor[run_of], -1), mode="drop")
-    ws_n = _ext(win_seq, 0, out_cap).at[tgt].set(
-        jnp.where(has, run_win_seq[run_of], 0), mode="drop")
-    wc_n = _ext(win_counter, False, out_cap).at[tgt].set(False, mode="drop")
-    chain_n = _ext(chain, False, out_cap).at[tgt].set(off > 0, mode="drop")
-    return (parent_n, ctr_n, actor_n, value_n, has_n, wa_n, ws_n, wc_n,
-            chain_n)
+
+def _scatter_rows_9(tables, idx, updates, out_cap: int):
+    """Write 9 aligned element-table rows at `idx` as ONE (C, 9) scatter
+    (shared index vector; OOB `idx` drops). `tables` / `updates` follow
+    the canonical column order (parent, ctr, actor, value, has_value,
+    win_actor, win_seq, win_counter, chain); bool columns are carried as
+    int32 and cast back on the way out."""
+    parent, ctr, actor, value, has_value, win_actor, win_seq, \
+        win_counter, chain = tables
+    tbl = jnp.stack([
+        _ext(parent, 0, out_cap), _ext(ctr, 0, out_cap),
+        _ext(actor, 0, out_cap), _ext(value, 0, out_cap),
+        _ext(has_value, False, out_cap).astype(jnp.int32),
+        _ext(win_actor, -1, out_cap), _ext(win_seq, 0, out_cap),
+        _ext(win_counter, False, out_cap).astype(jnp.int32),
+        _ext(chain, False, out_cap).astype(jnp.int32)], axis=1)
+    upd = jnp.stack([u.astype(jnp.int32) for u in updates], axis=1)
+    out = tbl.at[idx].set(upd, mode="drop")
+    return (out[:, 0], out[:, 1], out[:, 2], out[:, 3],
+            out[:, 4].astype(bool), out[:, 5], out[:, 6],
+            out[:, 7].astype(bool), out[:, 8].astype(bool))
 
 
 @partial(jax.jit, static_argnames=("out_cap",))
@@ -208,8 +322,7 @@ def _unpack_desc(desc):
             desc[DESC_ELEM_BASE], desc[DESC_HAS_VALUE].astype(bool))
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
-def expand_runs_packed(
+def _expand_runs_packed(
     parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
     chain, desc, blob, *, out_cap: int,
 ):
@@ -222,8 +335,11 @@ def expand_runs_packed(
         desc[DESC_META, META_N_ELEMS], out_cap=out_cap)
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
-def expand_runs_dense_packed(
+expand_runs_packed, expand_runs_packed_donated = _jit_pair(
+    _expand_runs_packed, _TABLE_ARGNUMS, ("out_cap",))
+
+
+def _expand_runs_dense_packed(
     parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
     chain, desc, blob, *, out_cap: int,
 ):
@@ -251,6 +367,10 @@ def expand_runs_dense_packed(
     return tables[:8] + (chain_n,)
 
 
+expand_runs_dense_packed, expand_runs_dense_packed_donated = _jit_pair(
+    _expand_runs_dense_packed, _TABLE_ARGNUMS, ("out_cap",))
+
+
 def _break_chains_core(chain, parent, ctr, actor, p_slots, h_ctr, h_actor):
     """Clear the chain bit of slot p+1 for every touched parent p whose new
     child Lamport-exceeds (ctr, actor) of p+1.
@@ -271,16 +391,18 @@ def _break_chains_core(chain, parent, ctr, actor, p_slots, h_ctr, h_actor):
 break_chains = jax.jit(_break_chains_core)
 
 
-@jax.jit
-def break_chains_packed(chain, parent, ctr, actor, touch):
+def _break_chains_packed(chain, parent, ctr, actor, touch):
     """`break_chains` with the (p_slot, ctr, actor) touch rows packed as one
     (3, T) int32 transfer."""
     return _break_chains_core(chain, parent, ctr, actor,
                               touch[0], touch[1], touch[2])
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
-def apply_residual_packed(
+break_chains_packed, break_chains_packed_donated = _jit_pair(
+    _break_chains_packed, (0,))     # only `chain` is replaced
+
+
+def _apply_residual_packed(
     parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
     chain, res, conflict_slots, *, out_cap: int,
 ):
@@ -292,6 +414,59 @@ def apply_residual_packed(
         res[RES_KIND].astype(jnp.int8), res[RES_SLOT], res[RES_NEW_SLOT],
         res[RES_CTR], res[RES_ACTOR], res[RES_VALUE], res[RES_WIN_ACTOR],
         res[RES_WIN_SEQ], conflict_slots, out_cap=out_cap)
+
+
+apply_residual_packed, apply_residual_packed_donated = _jit_pair(
+    _apply_residual_packed, _TABLE_ARGNUMS, ("out_cap",))
+
+
+def _apply_mixed_round(
+    parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
+    chain, desc, blob, res, conflict_slots, touch,
+    *, out_cap: int, expand_kind: str, with_res: bool, with_touch: bool,
+):
+    """One device program for a whole MIXED round: run expansion
+    (dense or sparse, per `expand_kind`), residual placement + register
+    fast path, and chain breaks, composed by static flags. The commit of
+    any round — dense, sparse, residual-bearing or not — is therefore
+    ONE dispatch, and XLA fuses the phases' elementwise work (the
+    per-phase (C, 9) stack/unstack round trips of the split programs
+    disappear). Unused operands ride as tiny dummies (static flags cut
+    the dead branches at trace time). Returns the 9 tables, plus
+    `slow_info` when `with_res`."""
+    tables = (parent, ctr, actor, value, has_value, win_actor, win_seq,
+              win_counter, chain)
+    if expand_kind == "dense":
+        tables = _expand_runs_dense_packed(*tables, desc, blob,
+                                           out_cap=out_cap)
+    elif expand_kind == "sparse":
+        tables = _expand_runs_packed(*tables, desc, blob, out_cap=out_cap)
+    slow_info = None
+    if with_res:
+        out = _apply_residual_packed(*tables, res, conflict_slots,
+                                     out_cap=out_cap)
+        tables, slow_info = out[:9], out[9]
+    if with_touch:
+        tables = tables[:8] + (_break_chains_packed(
+            tables[8], tables[0], tables[1], tables[2], touch),)
+    return tables + ((slow_info,) if with_res else ())
+
+
+apply_mixed_round, apply_mixed_round_donated = _jit_pair(
+    _apply_mixed_round, _TABLE_ARGNUMS,
+    ("out_cap", "expand_kind", "with_res", "with_touch"))
+
+_DUMMY_I32 = None
+
+
+def _dummy_i32():
+    """Shared tiny placeholder for unused traced operands of
+    apply_mixed_round (static flags dead-code them; a fresh upload per
+    call would still pay a transfer)."""
+    global _DUMMY_I32
+    if _DUMMY_I32 is None:
+        _DUMMY_I32 = jnp.zeros((1, 1), jnp.int32)
+    return _DUMMY_I32
 
 
 @partial(jax.jit, static_argnames=("out_cap",))
@@ -321,15 +496,16 @@ def apply_residual(
     is_assign = (kind == KIND_SET) | (kind == KIND_DEL) | (kind == KIND_INC)
 
     ins_idx = jnp.where(is_ins, op_new_slot, out_cap)
-    parent_n = _ext(parent, 0, out_cap).at[ins_idx].set(op_slot, mode="drop")
-    ctr_n = _ext(ctr, 0, out_cap).at[ins_idx].set(op_ctr, mode="drop")
-    actor_n = _ext(actor, 0, out_cap).at[ins_idx].set(op_actor, mode="drop")
-    value_n = _ext(value, 0, out_cap).at[ins_idx].set(0, mode="drop")
-    has_n = _ext(has_value, False, out_cap).at[ins_idx].set(False, mode="drop")
-    wa_n = _ext(win_actor, -1, out_cap).at[ins_idx].set(-1, mode="drop")
-    ws_n = _ext(win_seq, 0, out_cap).at[ins_idx].set(0, mode="drop")
-    wc_n = _ext(win_counter, False, out_cap).at[ins_idx].set(False, mode="drop")
-    chain_n = _ext(chain, False, out_cap).at[ins_idx].set(False, mode="drop")
+    zeros = jnp.zeros(M, jnp.int32)
+    # one stacked scatter for the insert placement (see _scatter_rows_9)
+    (parent_n, ctr_n, actor_n, value_n, has_n, wa_n, ws_n, wc_n,
+     chain_n) = _scatter_rows_9(
+        (parent, ctr, actor, value, has_value, win_actor, win_seq,
+         win_counter, chain),
+        ins_idx,
+        (op_slot, op_ctr, op_actor, zeros, zeros,
+         jnp.full(M, -1, jnp.int32), zeros, zeros, zeros),
+        out_cap)
 
     (value_n, has_n, wa_n, ws_n, wc_n, slow_info) = _register_fast_path(
         value_n, has_n, wa_n, ws_n, wc_n, kind, is_assign, op_slot,
@@ -365,11 +541,17 @@ def _register_fast_path(value_n, has_n, wa_n, ws_n, wc_n, kind, is_assign,
             & (counts[tclip] == 1) & (empty | self_over)
             & ~cmask[tclip] & (op_value >= 0))
     f_idx = jnp.where(fast, tslot, out_cap)
-    value_n = value_n.at[f_idx].set(op_value, mode="drop")
-    has_n = has_n.at[f_idx].set(True, mode="drop")
-    wa_n = wa_n.at[f_idx].set(op_win_actor, mode="drop")
-    ws_n = ws_n.at[f_idx].set(op_win_seq, mode="drop")
-    wc_n = wc_n.at[f_idx].set(False, mode="drop")
+    # one stacked (C, 5) scatter over the register columns (shared index
+    # vector — same per-index-overhead argument as _scatter_rows_9)
+    M = f_idx.shape[0]
+    regs = jnp.stack([value_n, has_n.astype(jnp.int32), wa_n, ws_n,
+                      wc_n.astype(jnp.int32)], axis=1)
+    upd = jnp.stack([op_value, jnp.ones(M, jnp.int32), op_win_actor,
+                     op_win_seq, jnp.zeros(M, jnp.int32)], axis=1)
+    regs = regs.at[f_idx].set(upd, mode="drop")
+    value_n, has_n, wa_n, ws_n, wc_n = (
+        regs[:, 0], regs[:, 1].astype(bool), regs[:, 2], regs[:, 3],
+        regs[:, 4].astype(bool))
 
     slow = is_assign & ~fast
     # register state at each slow op's slot, post fast-path/insert writes
@@ -411,8 +593,7 @@ def apply_map_round(
         op_value, op_win_actor, op_win_seq, conflict_slots, out_cap)
 
 
-@partial(jax.jit, static_argnames=("out_cap", "S", "as_u8", "L"))
-def merge_and_materialize_dense(
+def _merge_and_materialize_dense(
     parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
     chain, desc, blob, *, out_cap: int, S: int, as_u8: bool, L: int,
 ):
@@ -435,6 +616,11 @@ def merge_and_materialize_dense(
     codes, scalars = _materialize_core(*cols, n_elems, S, with_pos=False,
                                        as_u8=as_u8)
     return tables + (codes, scalars)
+
+
+merge_and_materialize_dense, merge_and_materialize_dense_donated = _jit_pair(
+    _merge_and_materialize_dense, _TABLE_ARGNUMS,
+    ("out_cap", "S", "as_u8", "L"))
 
 
 @jax.jit
@@ -755,8 +941,7 @@ def materialize_codes_planned(parent, ctr, actor, value, has_value, chain,
                                      with_pos=False, as_u8=as_u8)
 
 
-@partial(jax.jit, static_argnames=("out_cap", "S", "as_u8", "L"))
-def merge_and_materialize_dense_planned(
+def _merge_and_materialize_dense_planned(
     parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
     chain, desc, blob, segplan, *, out_cap: int, S: int, as_u8: bool, L: int,
 ):
@@ -773,6 +958,12 @@ def merge_and_materialize_dense_planned(
     codes, scalars = _materialize_core_planned(
         *cols, n_elems, segplan, S, with_pos=False, as_u8=as_u8)
     return tables + (codes, scalars)
+
+
+(merge_and_materialize_dense_planned,
+ merge_and_materialize_dense_planned_donated) = _jit_pair(
+    _merge_and_materialize_dense_planned, _TABLE_ARGNUMS,
+    ("out_cap", "S", "as_u8", "L"))
 
 
 def _slice_live(cols, L):
@@ -863,9 +1054,40 @@ def pack_rows(*arrays):
 @jax.jit
 def scatter_registers(value, has_value, win_actor, win_seq, win_counter,
                       slots, v, h, wa, ws, wc):
-    """Write back host-resolved registers (OOB sentinel slots drop)."""
+    """Write back host-resolved registers (OOB sentinel slots drop).
+
+    LEGACY per-column upload shape: six separate host arrays, each a
+    distinct h2d transfer paying per-transfer link latency. Kept as the
+    parity comparator for `scatter_registers_packed`
+    (tests/test_dispatch_budget.py) and selectable via
+    ``CausalDeviceDoc.packed_residual_writeback = False``."""
     return (value.at[slots].set(v, mode="drop"),
             has_value.at[slots].set(h, mode="drop"),
             win_actor.at[slots].set(wa, mode="drop"),
             win_seq.at[slots].set(ws, mode="drop"),
             win_counter.at[slots].set(wc, mode="drop"))
+
+
+# Packed-writeback row layout for scatter_registers_packed: one (6, S)
+# int32 host->device transfer replaces the six separate arrays above —
+# with the packed (7, M) slow_info fetch, the whole host slow-register
+# residue costs exactly ONE d2h round trip + ONE h2d upload per round.
+WB_SLOT, WB_VALUE, WB_HAS, WB_WIN_ACTOR, WB_WIN_SEQ, WB_WIN_COUNTER = \
+    range(6)
+
+
+def _scatter_registers_packed(value, has_value, win_actor, win_seq,
+                              win_counter, wb):
+    """`scatter_registers` with the resolved rows packed as one (6, S)
+    int32 matrix (row layout: WB_*; padding rows carry an OOB slot)."""
+    slots = wb[WB_SLOT]
+    return (value.at[slots].set(wb[WB_VALUE], mode="drop"),
+            has_value.at[slots].set(wb[WB_HAS].astype(bool), mode="drop"),
+            win_actor.at[slots].set(wb[WB_WIN_ACTOR], mode="drop"),
+            win_seq.at[slots].set(wb[WB_WIN_SEQ], mode="drop"),
+            win_counter.at[slots].set(wb[WB_WIN_COUNTER].astype(bool),
+                                      mode="drop"))
+
+
+scatter_registers_packed, scatter_registers_packed_donated = _jit_pair(
+    _scatter_registers_packed, _REG_ARGNUMS)

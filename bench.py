@@ -6,6 +6,24 @@ reference semantics. The reference publishes no numbers (BASELINE.md), so
 vs_baseline is measured against that target rate.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Modes:
+- default          — the cfg5 headline merge. `value` is the MEDIAN of
+  `--reps N` timed-region reps (AMTPU_BENCH_REPS; >=5 in a chip session)
+  with the per-rep series and spread recorded — never a best-of-N
+  maximum (VERDICT r5).
+- ``--pipeline``   — the sustained streaming tier (INTERNALS §9): stream
+  B causally-independent batches through the K-deep PipelinedIngestor
+  ring with buffer donation, report `e2e_pipeline_ops_per_sec` as
+  median-of-N full streams with spread, assert the per-batch
+  dispatch/sync budget, and machine-check the on-chip >=100M floor
+  (`floor_met`; a miss records the dominating term, it is never
+  laundered into a best-of). ``--quick`` shrinks shapes for CI.
+
+Every live on-chip headline run appends its full JSON to the committed
+session log (BENCH_SESSIONS.jsonl); `maybe_refresh_last_good` refuses to
+promote a run that is not in that log (round 5's 115.5M flagship was an
+unlogged best-of-seven — exactly the failure this closes).
 """
 
 import json
@@ -114,6 +132,34 @@ TIMED_REGION = (
     "detects once); prepare_cold_s / e2e_cold_* are the same batch's "
     "first-application costs with the cache explicitly cleared — compare "
     "THOSE against pre-cache rounds' records.")
+
+
+def bench_reps(default: int = 3) -> int:
+    """Headline rep count: --reps N > AMTPU_BENCH_REPS > default. The
+    chip session runs >=5 (median + spread into the config record)."""
+    import sys as _sys
+    if "--reps" in _sys.argv:
+        try:
+            return max(2, int(_sys.argv[_sys.argv.index("--reps") + 1]))
+        except (IndexError, ValueError):
+            pass
+    try:
+        return max(2, int(os.environ.get("AMTPU_BENCH_REPS", default)))
+    except ValueError:
+        return default
+
+
+def _median(xs):
+    import statistics
+    return statistics.median(xs)
+
+
+def _spread_pct(xs) -> float:
+    """Max-min spread as a percent of the median — the honesty rider
+    every median-of-N headline carries (tunnel weather varied unchanged
+    code by ±40% in round 5; a number without its spread overclaims)."""
+    med = _median(xs)
+    return 0.0 if med == 0 else 100.0 * (max(xs) - min(xs)) / med
 
 
 def run_overlapped(halves, expect_vis, *, obj_id="bench-text",
@@ -299,14 +345,67 @@ def run_once(batch):
 
 LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "BENCH_LAST_GOOD.json")
+# The committed session log: EVERY live on-chip headline run appends its
+# full JSON here (append_session_log below; the chip session commits the
+# file). It is the promotion gate's source of truth — a number that is
+# not in this log cannot become the last-good fallback. Round 5's
+# flagship 115.5M was exactly such a number: the single best of ~7
+# readings, present in no committed log (VERDICT r5).
+SESSION_LOG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_SESSIONS.jsonl")
 
 # the ONE chip-acceptance rule, shared with every probe/gate site
 # (scripts/probe_device.py, the last-good refresh below) — see VERDICT r4
 # Weak #1 for what gate drift across sites cost
 from benchmarks.common import is_chip_platform  # noqa: E402
 
+# fields that identify one run in the session log (value alone can
+# collide across runs; recorded_at_utc pins the exact measurement)
+_LOG_ID_KEYS = ("metric", "value", "platform", "recorded_at_utc")
 
-def maybe_refresh_last_good(rec, path=None):
+
+def append_session_log(rec, path=None):
+    """Append one run's full JSON to the committed session log (one line
+    per run, append-only — history is never rewritten). A torn final
+    line (a session timeout killed a mid-append) is healed by starting
+    on a fresh line, so one crash can never make later runs unpromotable."""
+    path = path or SESSION_LOG_PATH
+    lead = ""
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            if fh.tell() > 0:
+                fh.seek(-1, os.SEEK_END)
+                if fh.read(1) != b"\n":
+                    lead = "\n"
+    except OSError:
+        pass                        # new file
+    with open(path, "a") as fh:
+        fh.write(lead + json.dumps(rec, sort_keys=True) + "\n")
+
+
+def in_session_log(rec, path=None) -> bool:
+    """True iff `rec`'s identifying fields appear in the session log."""
+    path = path or SESSION_LOG_PATH
+    want = tuple(rec.get(k) for k in _LOG_ID_KEYS)
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue       # torn line: never wedge the gate
+                if tuple(row.get(k) for k in _LOG_ID_KEYS) == want:
+                    return True
+    except OSError:
+        return False
+    return False
+
+
+def maybe_refresh_last_good(rec, path=None, session_log=None):
     """Self-maintaining fallback: a successful ON-CHIP run refreshes the
     last-good record (committed to the repo by the chip session) so a
     future tunnel outage degrades to a stale-marked number instead of a
@@ -318,24 +417,35 @@ def maybe_refresh_last_good(rec, path=None):
     the record (round-5 code review). A prior record that is unreadable,
     for a different metric, or not from a chip platform is replaced.
 
-    Best-of is a claim about the ENGINE AS COMMITTED, so the record
-    carries git_sha provenance: if the engine later regresses, the kept
-    record's sha shows which code earned the number (and the driver's
-    per-round BENCH_r{N}.json — always the live run, never this
-    fallback — is where a regression shows up as a worse fresh
-    measurement)."""
+    VERIFIED-runs-only (VERDICT r5 item 1b): a candidate whose full JSON
+    is not already in the committed session log (append_session_log —
+    every live chip run writes it before promotion is attempted) is
+    REFUSED, so an ad-hoc reading that bypassed the session pipeline can
+    never become the fallback. Promotion re-stamps git_sha from the
+    CURRENT checkout — the claim is about the engine as committed — and
+    a prior record without a git_sha (or flagged unverified) no longer
+    defends its value: it predates this gate and is replaceable by any
+    verified run."""
     path = path or LAST_GOOD_PATH
+    session_log = session_log or SESSION_LOG_PATH
     if not is_chip_platform(rec["platform"]):
         return False
+    if not in_session_log(rec, session_log):
+        print("bench.py: refusing last-good promotion: run not found in "
+              f"the committed session log ({os.path.basename(session_log)})",
+              file=sys.stderr)
+        return False
     rec = dict(rec)
-    rec.setdefault("git_sha", _git_sha())
+    rec["git_sha"] = _git_sha()     # re-stamped at promotion time
     prior_value = -1.0
     if os.path.exists(path):
         try:
             with open(path) as fh:
                 prior = json.load(fh)
             if (prior.get("metric") == rec["metric"]
-                    and is_chip_platform(prior.get("platform", ""))):
+                    and is_chip_platform(prior.get("platform", ""))
+                    and prior.get("git_sha")
+                    and not prior.get("unverified")):
                 prior_value = float(prior.get("value", -1.0))
         except (ValueError, TypeError, OSError):
             pass            # unreadable record: replace it
@@ -392,6 +502,189 @@ def _serve_stale(reason: str):
     return 0
 
 
+# Per-committed-batch device-interaction budget of the streaming ring
+# (engine/accounting.py): the steady-state dense fused commit is ONE
+# program and ZERO blocking syncs; the budget leaves headroom for a
+# residual round's single packed slow-register fetch, nothing more.
+PIPELINE_DISPATCH_BUDGET = 3
+PIPELINE_SYNC_BUDGET = 1
+
+PIPELINE_TIMED_REGION = (
+    "K-deep streaming ring (engine/pipeline.PipelinedIngestor, "
+    "INTERNALS §9): B causally-independent batches stream through K "
+    "in-flight slots — background chained prepare_batch (host planning "
+    "+ async h2d staging) overlaps commit dispatch and device kernel "
+    "execution; commit kernels run with buffer donation so steady-state "
+    "device allocation is flat. dt spans first feed -> final materialize "
+    "+ the one scalar-fetch sync: host planning, transfers, commits, and "
+    "device execution ALL inside the timed region (nothing untimed but "
+    "the base-document build). value = median over n_reps full streams; "
+    "per-batch dispatch/sync budget asserted from dispatch_stats.")
+
+
+def measure_pipeline(n_batches: int = 6, n_actors: int = 2_000,
+                     ops_per_change: int = OPS_PER_CHANGE,
+                     base_n: int = BASE_LEN, reps: int = None,
+                     depth: int = None, quick: bool = False) -> dict:
+    """The sustained streaming headline: median-of-N steady-state
+    `e2e_pipeline_ops_per_sec` over full K-deep streams.
+
+    Machine checks (all asserted, so a regression fails the run instead
+    of recording an unfalsifiable string): >=5 reps with the median (not
+    max) reported; per-committed-batch dispatches <= 3 and blocking
+    syncs <= 1 (engine/accounting.py); the ring genuinely pipelined
+    (every batch after the first chained, zero fallbacks). The on-chip
+    >=100M ops/s floor lands in `floor_met`; a miss records `shortfall`
+    naming the dominating serial-profile term — never a best-of
+    promotion."""
+    from automerge_tpu.engine import DeviceTextDoc, PipelinedIngestor
+
+    if quick:
+        n_batches, n_actors, base_n = 4, 400, 50_000
+        ops_per_change = 200
+    reps = max(5, bench_reps(5) if reps is None else reps)
+    # actor prefixes ascend lexicographically past 'base', so every
+    # chained prepare interns append-only and the ring never degrades
+    batches = [merge_batch("pipe-text", n_actors, ops_per_change, base_n,
+                           seed=100 + k, actor_prefix=f"s{k:03d}")
+               for k in range(n_batches)]
+    total_ops = sum(b.n_ops for b in batches)
+    expect_vis = base_n + n_batches * n_actors * (ops_per_change // 2)
+
+    def stream():
+        """One full stream; returns (dt, ring stats incl. the public
+        per-commit budget surface)."""
+        doc = DeviceTextDoc("pipe-text")
+        doc.eager_materialize = True
+        doc.apply_batch(base_batch("pipe-text", base_n))
+        doc.text()
+        t0 = time.perf_counter()
+        with PipelinedIngestor(doc, slots=depth, donate=True) as pipe:
+            pipe.run(batches)
+            ring = pipe.stats
+        doc._materialize(with_pos=False)
+        scal = doc._scalars()
+        dt = time.perf_counter() - t0
+        assert int(scal[0]) == expect_vis, (int(scal[0]), expect_vis)
+        return dt, ring
+
+    def serial_profile():
+        """Serial comparator: the same stream with prepare/commit/sync
+        timed apart — names the dominating term on a floor miss and
+        yields pipeline_gain."""
+        doc = DeviceTextDoc("pipe-text")
+        doc.eager_materialize = True
+        doc.apply_batch(base_batch("pipe-text", base_n))
+        doc.text()
+        prep_s = commit_s = 0.0
+        for b in batches:
+            t0 = time.perf_counter()
+            plan = doc.prepare_batch(b)
+            prep_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            doc.commit_prepared(plan)
+            commit_s += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        doc._materialize(with_pos=False)
+        scal = doc._scalars()
+        sync_s = time.perf_counter() - t0
+        assert int(scal[0]) == expect_vis
+        return {"prepare_s": round(prep_s, 4),
+                "commit_s": round(commit_s, 4),
+                "final_sync_s": round(sync_s, 4)}
+
+    stream()                        # warm-up: jit compiles at these shapes
+    runs = [stream() for _ in range(reps)]
+    times = [r[0] for r in runs]
+    rates = [total_ops / t for t in times]
+    med_rate = _median(rates)
+    # detail fields from the median-closest rep
+    dt, ring = min(runs, key=lambda r: abs(r[0] - _median(times)))
+    profile = serial_profile()
+    serial_s = sum(profile.values())
+
+    # --- machine checks -------------------------------------------------
+    assert reps >= 5 and len(rates) == reps
+    budget = ring["per_commit_budget"]
+    disp_max = budget["dispatches_max"]
+    sync_max = budget["syncs_max"]
+    assert disp_max <= PIPELINE_DISPATCH_BUDGET, (
+        f"ring commit dispatched {disp_max} programs/batch "
+        f"(budget {PIPELINE_DISPATCH_BUDGET}): {budget}")
+    assert sync_max <= PIPELINE_SYNC_BUDGET, (
+        f"ring commit blocked on {sync_max} syncs/batch "
+        f"(budget {PIPELINE_SYNC_BUDGET}): {budget}")
+    assert ring["fallbacks"] == 0 and ring["serial_prepares"] == 0, ring
+    assert ring["chained_prepares"] >= n_batches - 1, (
+        "ring degraded to unchained planning", ring)
+
+    floor_met = None
+    shortfall = None
+    import jax as _jax
+    platform = _jax.devices()[0].platform
+    if is_chip_platform(platform):
+        floor_met = bool(med_rate >= TARGET_OPS_PER_SEC)
+        if not floor_met:
+            term = max(profile, key=profile.get)
+            shortfall = (
+                f"median {med_rate / 1e6:.1f}M ops/s < 100M floor; "
+                f"dominating term: {term} ({profile[term]}s of "
+                f"{serial_s:.3f}s serial profile; spread "
+                f"{_spread_pct(rates):.0f}%)")
+
+    from datetime import datetime, timezone
+    rec = {
+        "metric": "e2e_pipeline_ops_per_sec",
+        "value": round(med_rate),
+        "unit": "ops/s",
+        "vs_baseline": round(med_rate / TARGET_OPS_PER_SEC, 4),
+        "threshold": (
+            "asserted in code: median-of->=5 full streams (never max); "
+            f"dispatches/batch <= {PIPELINE_DISPATCH_BUDGET}; blocking "
+            f"syncs/batch <= {PIPELINE_SYNC_BUDGET}; every batch after "
+            "the first chained, zero fallbacks. On-chip floor 100e6 "
+            "ops/s -> floor_met; a miss records `shortfall` naming the "
+            "dominating term"),
+        "timed_region": PIPELINE_TIMED_REGION,
+        "n_reps": reps,
+        "reps_ops_per_sec": [round(r) for r in rates],
+        "value_spread_pct": round(_spread_pct(rates), 1),
+        "median_stream_s": round(_median(times), 4),
+        "total_ops": total_ops,
+        "n_batches": n_batches,
+        "ops_per_batch": total_ops // n_batches,
+        "ring": ring,
+        "dispatches_per_batch_max": disp_max,
+        "syncs_per_batch_max": sync_max,
+        "serial_profile": profile,
+        "pipeline_gain_vs_serial": round(serial_s / _median(times), 3),
+        "floor_met": floor_met,
+        **({"shortfall": shortfall} if shortfall else {}),
+        "platform": platform,
+        "recorded_at_utc": datetime.now(timezone.utc).isoformat(),
+    }
+    # the median-semantics machine check, on the REPORTED quantity: the
+    # record's value must be the median of the recorded rep series (a
+    # future edit promoting max() fails here, not in review)
+    assert rec["value"] == round(_median(rec["reps_ops_per_sec"])), rec
+    return rec
+
+
+def main_pipeline():
+    """`bench.py --pipeline`: the streaming-tier headline entry point."""
+    from benchmarks.common import preflight_device
+    budget = float(os.environ.get("AMTPU_PREFLIGHT_BUDGET_S", "420"))
+    if not preflight_device(total_budget_s=budget):
+        print("bench.py --pipeline: no reachable jax device — refusing "
+              "to hang", file=sys.stderr)
+        return 3
+    rec = measure_pipeline(quick="--quick" in sys.argv)
+    print(json.dumps(rec))
+    if is_chip_platform(rec["platform"]):
+        append_session_log(rec)
+    return 0
+
+
 def main():
     from benchmarks.common import preflight_device
     # The tunnel to the chip flaps (BENCH_r03 was lost to a single failed
@@ -424,6 +717,10 @@ def main():
             return served
         raise
     print(json.dumps(rec))
+    if is_chip_platform(rec["platform"]):
+        # the committed session log gets EVERY live chip run, before any
+        # promotion question is asked (VERDICT r5 items 1a/1b)
+        append_session_log(rec)
     maybe_refresh_last_good(rec)
     return 0
 
@@ -431,10 +728,17 @@ def main():
 def _measure() -> dict:
     batch = merge_batch("bench-text", N_ACTORS, OPS_PER_CHANGE, BASE_LEN)
     n_ops = batch.n_ops
+    reps = bench_reps()
     run_once(batch)                 # warm-up: pays jit compiles at full shapes
-    runs = [run_once(batch) for _ in range(2)]        # steady state
-    elapsed, prepare_s, staged, pull_s, pull_stats = min(
-        runs, key=lambda r: r[0])
+    runs = [run_once(batch) for _ in range(reps)]     # steady state
+    # MEDIAN-of-reps, never best-of (VERDICT r5: the 115.5M flagship was
+    # the max of ~7 readings whose median sat at 0.82x). The per-rep
+    # series + spread ride along so one quiet window can't overclaim.
+    rep_rates = [n_ops / r[0] for r in runs]
+    elapsed = _median([r[0] for r in runs])
+    # per-rep detail fields come from the rep closest to the median
+    _, prepare_s, staged, pull_s, pull_stats = min(
+        runs, key=lambda r: abs(r[0] - elapsed))
     # first-application run (run-detection cache cleared): what ONE cold
     # delivery pays before the per-batch detection amortizes. A full rep,
     # not just a prepare: its elapsed+prepare is the honest e2e_cold_*
@@ -445,8 +749,8 @@ def _measure() -> dict:
     cold_elapsed, prepare_cold_s, _, _, _ = run_once(batch)
     e2e_cold = cold_elapsed + prepare_cold_s
     ops_per_sec = n_ops / elapsed
-    e2e = min(r[0] + r[1] for r in runs)
-    e2e_pull = min(r[0] + r[1] + r[3] for r in runs)
+    e2e = _median([r[0] + r[1] for r in runs])
+    e2e_pull = _median([r[0] + r[1] + r[3] for r in runs])
     # pipelined e2e: same total op count, two disjoint half-batches,
     # prepare of half 2 overlapping the device's commit of half 1
     halves = [merge_batch("bench-text", N_ACTORS // 2, OPS_PER_CHANGE,
@@ -454,16 +758,30 @@ def _measure() -> dict:
               for s, p in ((1, "alpha"), (2, "beta"))]
     expect_vis = BASE_LEN + 2 * (N_ACTORS // 2) * (OPS_PER_CHANGE // 2)
     run_overlapped(halves, expect_vis)               # warm-up at half shapes
-    e2e_ov = min(run_overlapped(halves, expect_vis) for _ in range(2))
+    e2e_ov = _median([run_overlapped(halves, expect_vis)
+                      for _ in range(2)])
     restore = measure_restore()                      # checkpoint tier win
 
     from datetime import datetime, timezone
     import jax as _jax
+    floor_met = None
+    if is_chip_platform(_jax.devices()[0].platform):
+        floor_met = bool(ops_per_sec >= TARGET_OPS_PER_SEC)
     rec = {
         "metric": "ops_per_sec_merged_text_10k_actors_1M_doc",
         "value": round(ops_per_sec),
         "unit": "ops/s",
         "vs_baseline": round(ops_per_sec / TARGET_OPS_PER_SEC, 4),
+        # the cfg5 machine check (non-null by construction): median-of-N
+        # semantics + the on-chip floor folded into floor_met
+        "threshold": (
+            f"machine-checked: value = median of {reps} timed-region reps "
+            "(value_reps/value_spread_pct recorded, never best-of-N); "
+            "on-chip floor 100e6 ops/s -> floor_met (null off-chip)"),
+        "n_reps": reps,
+        "value_reps": [round(r) for r in rep_rates],
+        "value_spread_pct": round(_spread_pct(rep_rates), 1),
+        "floor_met": floor_met,
         "timed_region": TIMED_REGION,
         "prepare_s": round(prepare_s, 4),
         "prepare_cold_s": round(prepare_cold_s, 4),
@@ -514,4 +832,4 @@ def _measure() -> dict:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main_pipeline() if "--pipeline" in sys.argv else main())

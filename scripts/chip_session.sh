@@ -81,7 +81,22 @@ fi
 # Measurements, highest value first, non-gating. configs_record folds the
 # bench.py headline in as its FIRST row and rewrites the record after every
 # config, so each completed step survives a drop.
-run "bench"      900 python bench.py
+#
+# --reps 5: the headline VALUE is the median of >=5 back-to-back timed
+# reps with the spread recorded (VERDICT r5 items 1a/1c — never a
+# best-of-N maximum), and every live chip run appends its full JSON to
+# the committed session log (BENCH_SESSIONS.jsonl) BEFORE any last-good
+# promotion; maybe_refresh_last_good refuses runs absent from that log.
+SESSIONS_LOG=BENCH_SESSIONS.jsonl
+LOG_LINES_BEFORE=$(wc -l < "$SESSIONS_LOG" 2>/dev/null || echo 0)
+run "bench"          1200 python bench.py --reps 5
+run "bench_pipeline" 1200 python bench.py --pipeline --reps 5
+LOG_LINES_AFTER=$(wc -l < "$SESSIONS_LOG" 2>/dev/null || echo 0)
+if [ "$LOG_LINES_AFTER" -le "$LOG_LINES_BEFORE" ] && [ "${AMTPU_SESSION_DRYRUN:-0}" != "1" ]; then
+  # a chip bench run that left no session-log line cannot be promoted or
+  # cited later — surface it in the session log NOW, not at review time
+  echo "WARNING: headline steps appended nothing to $SESSIONS_LOG (tunnel drop mid-run?); these runs are NOT promotable" >> "$LOG"
+fi
 run "planned_ab" 900 python profile_bench.py --planned
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,

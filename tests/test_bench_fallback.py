@@ -158,27 +158,98 @@ def test_last_good_refresh_keeps_best_verified_run(tmp_path):
     night on an unchanged engine); the fallback must report the chip's
     demonstrated capability, so a slower later run must NOT downgrade
     the record, while a faster one replaces it and a cpu run never
-    touches it."""
+    touches it. Every candidate here is first appended to the session
+    log — promotion REQUIRES log presence (see the companion tests)."""
     import bench
 
     path = str(tmp_path / "last_good.json")
-    mk = lambda v, plat="tpu": {  # noqa: E731
-        "metric": "ops_per_sec_merged_text_10k_actors_1M_doc",
-        "value": v, "unit": "ops/s", "platform": plat}
+    log = str(tmp_path / "sessions.jsonl")
+    n = [0]
 
-    assert bench.maybe_refresh_last_good(mk(100), path)       # first write
-    assert not bench.maybe_refresh_last_good(mk(80), path)    # slower: kept
+    def mk(v, plat="tpu"):
+        n[0] += 1
+        rec = {"metric": "ops_per_sec_merged_text_10k_actors_1M_doc",
+               "value": v, "unit": "ops/s", "platform": plat,
+               "recorded_at_utc": f"2026-08-03T00:00:{n[0]:02d}Z"}
+        bench.append_session_log(rec, log)     # the live-run discipline
+        return rec
+
+    refresh = lambda rec: bench.maybe_refresh_last_good(  # noqa: E731
+        rec, path, session_log=log)
+    assert refresh(mk(100))                               # first write
+    assert not refresh(mk(80))                            # slower: kept
     assert json.load(open(path))["value"] == 100
-    assert bench.maybe_refresh_last_good(mk(120, "axon"), path)  # faster
+    assert refresh(mk(120, "axon"))                       # faster
     assert json.load(open(path))["value"] == 120
-    assert not bench.maybe_refresh_last_good(mk(999, "cpu"), path)
+    assert not refresh(mk(999, "cpu"))
     assert json.load(open(path))["value"] == 120
     # a prior record for a DIFFERENT metric is replaced, not compared
     with open(path, "w") as fh:
         json.dump({"metric": "other", "value": 10**9,
-                   "platform": "tpu"}, fh)
-    assert bench.maybe_refresh_last_good(mk(120), path)
+                   "platform": "tpu", "git_sha": "abc"}, fh)
+    assert refresh(mk(120))
     assert json.load(open(path))["metric"] != "other"
+
+
+def test_last_good_refresh_requires_session_log(tmp_path):
+    """VERDICT r5 item 1b: a run whose JSON is not in the committed
+    session log is REFUSED promotion (round 5's 115.5M flagship was
+    exactly such an unlogged reading), and promotion re-stamps git_sha
+    from the current checkout."""
+    import bench
+
+    path = str(tmp_path / "last_good.json")
+    log = str(tmp_path / "sessions.jsonl")
+    rec = {"metric": "ops_per_sec_merged_text_10k_actors_1M_doc",
+           "value": 500, "unit": "ops/s", "platform": "tpu",
+           "recorded_at_utc": "2026-08-03T01:00:00Z"}
+    # not in the log (the log doesn't even exist): refused
+    assert not bench.maybe_refresh_last_good(rec, path, session_log=log)
+    assert not os.path.exists(path)
+    # logged: promoted, with git_sha re-stamped at promotion time
+    bench.append_session_log(rec, log)
+    assert bench.maybe_refresh_last_good(rec, path, session_log=log)
+    promoted = json.load(open(path))
+    assert promoted["value"] == 500
+    assert promoted.get("git_sha")          # stamped even though the
+    assert "git_sha" not in rec             # candidate carried none
+    # a corrupt log line must not wedge the gate for later valid lines
+    with open(log, "a") as fh:
+        fh.write('{"torn": ')
+    rec2 = dict(rec, value=600, recorded_at_utc="2026-08-03T02:00:00Z")
+    bench.append_session_log(rec2, log)
+    assert bench.maybe_refresh_last_good(rec2, path, session_log=log)
+
+
+def test_last_good_sha_less_prior_is_replaceable(tmp_path):
+    """Satellite 1b demotion semantics: a prior record WITHOUT git_sha
+    (or flagged unverified) predates the verification gate and must not
+    defend its value — any verified run replaces it, even a slower one."""
+    import bench
+
+    path = str(tmp_path / "last_good.json")
+    log = str(tmp_path / "sessions.jsonl")
+    with open(path, "w") as fh:      # the round-5 shape: sha-less maximum
+        json.dump({"metric": "ops_per_sec_merged_text_10k_actors_1M_doc",
+                   "value": 115481761, "unit": "ops/s",
+                   "platform": "tpu"}, fh)
+    rec = {"metric": "ops_per_sec_merged_text_10k_actors_1M_doc",
+           "value": 88_000_000, "unit": "ops/s", "platform": "tpu",
+           "recorded_at_utc": "2026-08-03T03:00:00Z"}
+    bench.append_session_log(rec, log)
+    assert bench.maybe_refresh_last_good(rec, path, session_log=log)
+    assert json.load(open(path))["value"] == 88_000_000
+
+
+def test_committed_last_good_record_is_verified_shape():
+    """The repo's live BENCH_LAST_GOOD.json must carry the post-demotion
+    shape: a git_sha, and no unverifiable best-of maximum as its value
+    (the demoted prior rides along as provenance instead)."""
+    rec = json.load(open(LAST_GOOD))
+    assert rec.get("git_sha"), "committed last-good record lost its git_sha"
+    prior = rec.get("demoted_prior")
+    if prior:
+        assert rec["value"] != prior["value"]
 
 
 def test_chip_platform_gate_accepts_axon():

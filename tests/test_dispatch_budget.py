@@ -1,0 +1,152 @@
+"""Dispatch/sync accounting and the streaming tier's budgets (ISSUE 4).
+
+Counting is link-independent: these bars gate identically on cpu and on
+chip, which is the point — an extra blocking sync per batch is invisible
+in cpu wall clock but costs a full WAN round trip (~70 ms) at deployment.
+Pinned here:
+
+- the write-behind interactive path (`am.change`) performs ZERO device
+  dispatches and ZERO blocking syncs per change in steady state, with a
+  budget of 2 as the regression bar (cfg7 carries the measured numbers);
+- a pipeline-ring commit of a dense merge batch is ONE device program
+  and ZERO blocking syncs (`doc.dispatch_stats["last_commit"]`);
+- the residual slow-register path costs exactly ONE blocking d2h sync
+  (the packed slow_info fetch) regardless of op count, and the packed
+  one-upload writeback is byte-equivalent to the legacy six-transfer
+  path.
+"""
+
+import numpy as np
+
+import bench as B
+from automerge_tpu.engine import DeviceTextDoc, PipelinedIngestor, \
+    TextChangeBatch
+from automerge_tpu.engine import accounting
+
+WRITE_BEHIND_BUDGET = 2     # dispatches AND syncs per am.change
+RING_DISPATCH_BUDGET = B.PIPELINE_DISPATCH_BUDGET
+RING_SYNC_BUDGET = B.PIPELINE_SYNC_BUDGET
+
+
+def test_write_behind_change_dispatch_budget():
+    """The interactive editing loop must stay host work: per-am.change
+    device dispatches/syncs measured via accounting.track and asserted
+    <= the budget (steady state is 0/0 — the write-behind fast path
+    defers all device reconciliation)."""
+    import automerge_tpu as am
+    from automerge_tpu import Text
+
+    doc = am.change(am.init("user"),
+                    lambda d: d.__setitem__("t", Text("x" * 20_000)))
+    deltas = []
+    for i in range(20):
+        with accounting.track() as t:
+            doc = am.change(doc, lambda d, i=i: d["t"]
+                            .insert_at(500 + 11 * i, *"helloworld"))
+        deltas.append((t.stats["dispatches"], t.stats["syncs"]))
+    assert len(doc["t"]) == 20_000 + 200
+    disp_max = max(d for d, _ in deltas)
+    sync_max = max(s for _, s in deltas)
+    assert disp_max <= WRITE_BEHIND_BUDGET, deltas
+    assert sync_max <= WRITE_BEHIND_BUDGET, deltas
+    # the steady-state claim is the strong one: all-zero after warm-up
+    assert deltas[5:] == [(0, 0)] * len(deltas[5:]), deltas
+
+
+def test_ring_commit_budget_and_stats():
+    """A dense merge batch committed through the ring is ONE program +
+    ZERO blocking syncs; the per-commit delta is exposed via the ring's
+    public budget surface (stats['per_commit_budget']) and
+    dispatch_stats['last_commit'], and stays within the bench budget."""
+    doc = DeviceTextDoc("t")
+    doc.eager_materialize = True
+    doc.apply_batch(B.base_batch("t", 4000))
+    doc.text()
+    hs = [B.merge_batch("t", 40, 30, 4000, seed=s + 1,
+                        actor_prefix=f"p{s:02d}") for s in range(5)]
+    with PipelinedIngestor(doc, slots=4) as pipe:
+        pipe.run(list(hs))
+        st = pipe.stats
+    budget = st["per_commit_budget"]
+    assert st["committed"] == len(hs)
+    assert budget["dispatches_max"] <= RING_DISPATCH_BUDGET, budget
+    assert budget["syncs_max"] <= RING_SYNC_BUDGET, budget
+    # steady state (warm shapes, dense fused path): EXACTLY 1 program, 0
+    # syncs per commit — the regression this file exists to catch is
+    # this becoming 2 (min == max pins every commit, not just the worst)
+    assert budget["dispatches_min"] == budget["dispatches_max"] == 1, budget
+    assert budget["syncs_min"] == budget["syncs_max"] == 0, budget
+    assert doc.last_commit_stats == {"dispatches": 1, "syncs": 0,
+                                     "n_rounds": 1}, doc.last_commit_stats
+
+
+def _conflict_doc(n_actors=6, n_targets=40, **doc_attrs):
+    base_ops = []
+    for i in range(1, n_targets + 1):
+        key = "_head" if i == 1 else f"base:{i - 1}"
+        base_ops.append({"action": "ins", "obj": "t", "key": key, "elem": i})
+        base_ops.append({"action": "set", "obj": "t", "key": f"base:{i}",
+                         "value": chr(97 + i % 26)})
+    changes = []
+    for a in range(n_actors):
+        ops = []
+        for i in range(1, n_targets + 1):
+            if (a + i) % 5 == 0:
+                ops.append({"action": "del", "obj": "t",
+                            "key": f"base:{i}"})
+            else:
+                ops.append({"action": "set", "obj": "t",
+                            "key": f"base:{i}",
+                            "value": chr(65 + (a + i) % 26)})
+        changes.append({"actor": f"actor-{a:04d}", "seq": 1,
+                        "deps": {"base": 1}, "ops": ops})
+    doc = DeviceTextDoc("t")
+    for k, v in doc_attrs.items():
+        setattr(doc, k, v)
+    doc.apply_changes([{"actor": "base", "seq": 1, "deps": {},
+                        "ops": base_ops}])
+    return doc, TextChangeBatch.from_changes(changes, "t")
+
+
+def test_residual_round_is_one_sync():
+    """The residual slow-register path: ONE blocking d2h (the packed
+    slow_info fetch) per round, independent of how many registers went
+    slow — the one-RTT contract the WAN tunnel's cfg5b bound rests on."""
+    doc, batch = _conflict_doc()
+    snap = dict(doc._acct)
+    doc.commit_prepared(doc.prepare_batch(batch))
+    delta_sync = doc._acct["syncs"] - snap["syncs"]
+    # prepare's staging barrier + the packed slow_info fetch, nothing else
+    assert delta_sync == 2, doc.dispatch_stats
+    assert doc.last_commit_stats["syncs"] == 1, doc.last_commit_stats
+    assert doc.conflicts            # the slow path genuinely ran
+
+
+def test_packed_writeback_parity_with_per_register_path():
+    """scatter_registers_packed (one (6,S) upload) lands byte-identical
+    register state to the legacy per-column scatter_registers path."""
+    packed, b1 = _conflict_doc()
+    legacy, b2 = _conflict_doc()
+    legacy.packed_residual_writeback = False
+    packed.apply_batch(b1)
+    legacy.apply_batch(b2)
+    assert packed.text() == legacy.text()
+    assert packed.conflicts == legacy.conflicts
+    assert packed.clock == legacy.clock
+    assert packed.elem_ids() == legacy.elem_ids()
+    h_p, h_l = packed._mirrors(), legacy._mirrors()
+    for k in h_p:
+        np.testing.assert_array_equal(h_p[k], h_l[k], err_msg=k)
+
+
+def test_map_round_accounting():
+    """The map engine counts its one program + one packed info fetch."""
+    from automerge_tpu.engine import DeviceMapDoc, MapChangeBatch
+
+    doc = DeviceMapDoc("m")
+    changes = [{"actor": f"a{i}", "seq": 1, "deps": {},
+                "ops": [{"action": "set", "obj": "m", "key": f"k{i}",
+                         "value": i}]} for i in range(4)]
+    doc.apply_batch(MapChangeBatch.from_changes(changes, "m"))
+    st = doc.dispatch_stats
+    assert st["dispatches"] == 1 and st["syncs"] == 1, st

@@ -227,6 +227,119 @@ def test_chained_prepare_refuses_remap():
     doc.apply_batch(low)
 
 
+def test_k_deep_ring_matches_serial():
+    """The K-deep ring (ISSUE 4 tentpole): 8 batches through 6 slots are
+    byte-identical to serial application, with every batch after the
+    first planned CHAINED on the worker (the ring genuinely pipelined,
+    not silently degraded)."""
+    hs = halves(k=8)
+    serial = fresh_doc()
+    for h in hs:
+        serial.apply_batch(h)
+    doc = fresh_doc()
+    with PipelinedIngestor(doc, slots=6) as pipe:
+        pipe.run(list(hs))
+        st = pipe.stats
+    assert doc.text() == serial.text()
+    assert doc.elem_ids() == serial.elem_ids()
+    assert doc.clock == serial.clock
+    assert st["depth"] == 6 and st["committed"] == 8
+    assert st["chained_prepares"] == 7, st
+    assert st["serial_prepares"] == 0 and st["fallbacks"] == 0, st
+
+
+def test_k_deep_overlap_never_loses():
+    """Satellite: the K-deep schedule must not lose to serial across a
+    LONGER stream than the classic two-half A/B (4 batches, depth 4) —
+    same contention discipline as cfg5d."""
+    n = 20_000
+    hs = [B.merge_batch("t", 150, 200, n, seed=s, actor_prefix=f"s{s:02d}")
+          for s in range(4)]
+    expect = n + sum(h.n_ops for h in hs) // 2
+    B.run_overlapped(hs, expect, obj_id="t", base_n=n)           # warm-up
+    B.run_overlapped(hs, expect, obj_id="t", base_n=n, barrier=True)
+    for attempt in range(3):
+        ser = min(B.run_overlapped(hs, expect, obj_id="t", base_n=n,
+                                   barrier=True) for _ in range(2))
+        ov = min(B.run_overlapped(hs, expect, obj_id="t", base_n=n)
+                 for _ in range(2))
+        if ov <= ser:
+            break
+        time.sleep(2)
+    assert ov <= ser * 1.15, (
+        f"K-deep overlapped {ov:.4f}s vs serial {ser:.4f}s")
+
+
+def test_gen_mismatch_abort_mid_ring():
+    """Mid-ring abort: with a FULL ring of chained plans in flight, an
+    outside mutation invalidates every pending plan; each affected
+    commit degrades to a fresh inline prepare (never corruption) and
+    the stream still lands byte-identical to the serial control."""
+    hs = halves(k=6)
+    extra = B.merge_batch("t", 5, 10, 4000, seed=9, actor_prefix="zz")
+    doc = fresh_doc()
+    with PipelinedIngestor(doc, slots=4) as pipe:
+        for h in hs[:4]:
+            pipe.feed(h)               # ring full: 4 plans speculated
+        pipe.commit_next()
+        doc.apply_batch(extra)         # mutation UNDER 3 pending plans
+        for h in hs[4:]:
+            pipe.feed(h)
+        pipe.flush()
+        st = pipe.stats
+    assert st["fallbacks"] >= 1, st    # the degraded path genuinely ran
+    control = fresh_doc()
+    for h in hs[:4]:
+        control.apply_batch(h)
+    control.apply_batch(extra)
+    for h in hs[4:]:
+        control.apply_batch(h)
+    # NOTE: commit order is ring order (batches 2-4 commit AFTER extra),
+    # which matches the control's application order above
+    assert doc.text() == control.text()
+    assert doc.elem_ids() == control.elem_ids()
+
+
+def test_donated_ring_parity_and_flag_restore(monkeypatch):
+    """donate=True sessions run the *_donated commit kernels (forced on
+    cpu via the donation gate) and land byte-identical state; close()
+    restores the document's donate_buffers flag."""
+    from automerge_tpu.ops import ingest as I
+    monkeypatch.setattr(I, "_DONATION", True)      # force-enable on cpu
+    hs = halves(k=5)
+    serial = fresh_doc()
+    for h in hs:
+        serial.apply_batch(h)
+    doc = fresh_doc()
+    assert doc.donate_buffers is False
+    with PipelinedIngestor(doc, slots=4, donate=True) as pipe:
+        assert doc.donate_buffers is True
+        pipe.run(list(hs))
+    assert doc.donate_buffers is False             # restored on close
+    assert doc.text() == serial.text()
+    assert doc.elem_ids() == serial.elem_ids()
+
+
+def test_donation_refuses_deferred_checkpoint_grab():
+    """Donation invariant (INTERNALS §9): a donation-enabled doc refuses
+    the checkpoint writer's zero-copy deferred grab (CaptureConflict ->
+    the writer's commit-boundary sync path), while the inline grab —
+    encoded before any further commit — still captures correctly."""
+    import pytest as _pytest
+    from automerge_tpu.checkpoint.engine_codec import (CaptureConflict,
+                                                       grab)
+    from automerge_tpu.checkpoint import writer as W
+
+    doc = fresh_doc()
+    doc.donate_buffers = True
+    with _pytest.raises(CaptureConflict):
+        grab(doc)
+    g = grab(doc, inline=True)                     # the sync-path promise
+    assert g["obj_id"] == "t"
+    data = W.AsyncCheckpointer.capture(doc)        # inline end to end
+    assert isinstance(data, bytes) and data
+
+
 def causal_batch(n_actors=80):
     """Multi-round shape: seq-2 changes depending on the batch's own
     seq-1 changes, plus duplicates and an unsatisfiable straggler."""
