@@ -23,6 +23,7 @@ interning order change).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -32,6 +33,36 @@ from .._common import KIND_DEL, KIND_INC, KIND_SET
 from . import accounting
 
 import threading
+
+
+def columnar_plan_enabled() -> bool:
+    """The columnar planner (INTERNALS §10) is the default; the legacy
+    per-change planner stays available as the parity comparator behind
+    ``AMTPU_COLUMNAR_PLAN=0`` (read per call so tests can pin either
+    path)."""
+    return os.environ.get("AMTPU_COLUMNAR_PLAN", "1") != "0"
+
+
+class _GroupedRound(list):
+    """A causally-ready round already in grouped-column form: a list of
+    ``(batch, rows_arr, mask)`` triples (the shape `_group_round`
+    produces), emitted directly by the columnar scheduler so no
+    per-change ``(batch, row)`` tuples ever materialize on the planning
+    hot path. `_group_round` passes instances through untouched."""
+
+    __slots__ = ()
+
+
+def _round_row_pairs(ready) -> set:
+    """(actor, seq) pairs of one round, either representation."""
+    if isinstance(ready, _GroupedRound):
+        out: set = set()
+        for b, rows_arr, _ in ready:
+            actors = b.actors
+            seqs = b.seqs
+            out.update((actors[r], int(seqs[r])) for r in rows_arr.tolist())
+        return out
+    return {(b.actors[r], int(b.seqs[r])) for b, r in ready}
 
 # thread-local accounting region: commit_prepared opens one so its
 # per-batch delta counts ONLY the commit's own device interactions — a
@@ -140,6 +171,9 @@ class CausalDeviceDoc:
         self.last_commit_stats: Optional[dict] = None  # delta of the most
         # recent commit_prepared (the pipeline ring's per-batch budget)
         self._gen = 0                         # bumps on every state mutation
+        self._intern_gen = 0                  # bumps when the actor table /
+        # rank mapping changes: the validity token of every batch-level
+        # rank cache (wire_columns.ColumnarChangeBatch.rank_cache)
         self._busy = 0                        # >0 while a mutation is in
         # flight: generation stamps alone cannot expose a mutation that
         # SPANS an observer's whole read (the gen bump lands at the end),
@@ -191,19 +225,112 @@ class CausalDeviceDoc:
     # actor interning (order-preserving: rank order == lexicographic order)
     # ------------------------------------------------------------------
 
-    def _intern_actors(self, new_actors) -> Optional[np.ndarray]:
-        """Add actors; if rank order changes, return the old->new remap."""
-        missing = sorted(set(a for a in new_actors if a not in self._actor_rank))
+    def _intern_actors(self, new_actors,
+                       presorted: bool = False) -> Optional[np.ndarray]:
+        """Add actors; if rank order changes, return the old->new remap.
+
+        ``presorted`` asserts `new_actors` is already sorted and
+        duplicate-free (the columnar batch's cached table): the missing
+        scan then stays sorted by construction and the union is a linear
+        merge of two sorted disjoint lists instead of re-sorting the
+        whole table per batch."""
+        if presorted:
+            missing = [a for a in new_actors if a not in self._actor_rank]
+        else:
+            missing = sorted(set(a for a in new_actors
+                                 if a not in self._actor_rank))
         if not missing:
             return None
-        merged = sorted(set(self.actor_table) | set(missing))
-        new_rank = {a: i for i, a in enumerate(merged)}
+        table = self.actor_table
+        if not table:
+            merged = list(missing)
+        elif missing[0] > table[-1]:
+            merged = table + missing
+        elif missing[-1] < table[0]:
+            merged = missing + table
+        else:
+            import heapq      # disjoint sorted lists: linear merge
+            merged = list(heapq.merge(table, missing))
+        new_rank = dict(zip(merged, range(len(merged))))
         remap = None
-        if self.actor_table and merged[: len(self.actor_table)] != self.actor_table:
-            remap = np.asarray(
-                [new_rank[a] for a in self.actor_table], np.int32)
+        if table and merged[: len(table)] != table:
+            remap = np.asarray([new_rank[a] for a in table], np.int32)
         self.actor_table = merged
         self._actor_rank = new_rank
+        self._intern_gen += 1
+        return remap
+
+    def _intern_batch_actors(self, b, append_only: bool = False
+                             ) -> Optional[np.ndarray]:
+        """Intern one batch's whole actor table.
+
+        Uses the batch's cached presorted table when the per-change
+        columns exist, and skips the scan entirely when this batch's
+        ranks are already resolved against this document at the current
+        interning generation (ColumnarChangeBatch.rank_cache — populated
+        by the engine planners). `append_only` routes through
+        `_intern_actors_append` (the chained-prepare constraint).
+
+        The all-new prepend/append shape (a wide merge of fresh actors
+        landing entirely before or after the current table — the
+        headline workload) resolves ranks POSITIONALLY: the batch's
+        precomputed table positions plus one offset, seeded straight
+        into the rank cache, so no per-actor rank lookups run at all."""
+        cols = getattr(b, "_change_columns", None)
+        if cols is None:
+            if append_only:
+                self._intern_actors_append(b.actor_table)
+                return None
+            return self._intern_actors(b.actor_table)
+        rc = cols.rank_cache.get(self)
+        if rc is not None and rc["gen"] == self._intern_gen:
+            return None         # already resolved; table unchanged since
+        if append_only:
+            self._intern_actors_append(cols.table_sorted, presorted=True)
+            return None
+        ts = cols.table_sorted
+        rank = self._actor_rank
+        missing = [a for a in ts if a not in rank]
+        if not missing:
+            return None
+        table = self.actor_table
+        if len(ts) - len(missing) == len(table):
+            # every existing actor appears in the batch table too, so the
+            # merged table IS `ts` and ranks are the batch's precomputed
+            # positions — zero per-actor rank lookups (the headline
+            # shape: a wide merge referencing the document's actors)
+            pos = cols.table_pos_map()
+            old_pos = [pos[a] for a in table]
+            remap = (np.asarray(old_pos, np.int32)
+                     if old_pos != list(range(len(table))) else None)
+            self.actor_table = list(ts)
+            self._actor_rank = dict(zip(ts, range(len(ts))))
+            self._intern_gen += 1
+            tp, rp = cols.positional_ranks(b)
+            cols.rank_cache[self] = {
+                "gen": self._intern_gen, "batch_rank": tp, "row_rank": rp}
+            return remap
+        off = None
+        remap = None
+        if len(missing) == len(ts):
+            if not table or missing[0] > table[-1]:
+                off = len(table)            # append: existing ranks keep
+                merged = table + missing
+            elif missing[-1] < table[0]:
+                off = 0                     # prepend: old ranks shift up
+                merged = missing + table
+                remap = np.arange(len(missing),
+                                  len(missing) + len(table), dtype=np.int32)
+        if off is None:                     # interleaved: general merge
+            return self._intern_actors(ts, presorted=True)
+        self.actor_table = merged
+        self._actor_rank = dict(zip(merged, range(len(merged))))
+        self._intern_gen += 1
+        tp, rp = cols.positional_ranks(b)
+        cols.rank_cache[self] = {
+            "gen": self._intern_gen,
+            "batch_rank": tp + off,
+            "row_rank": (rp + off).astype(np.int32)}
         return remap
 
     def _apply_remap(self, remap: np.ndarray):
@@ -217,15 +344,18 @@ class CausalDeviceDoc:
         finally:
             self._busy -= 1
 
-    def _intern_actors_append(self, new_actors):
+    def _intern_actors_append(self, new_actors, presorted: bool = False):
         """Intern actors WITHOUT ever remapping existing ranks — the only
         interning a chained prepare may perform, because a remap would
         invalidate the pending base plan's staged actor columns. Raises
         ValueError when the new actors would not all rank after the
         current table (the caller falls back to a fresh, unchained
         prepare once the base commit lands)."""
-        missing = sorted(set(a for a in new_actors
-                             if a not in self._actor_rank))
+        if presorted:
+            missing = [a for a in new_actors if a not in self._actor_rank]
+        else:
+            missing = sorted(set(a for a in new_actors
+                                 if a not in self._actor_rank))
         if not missing:
             return
         if self.actor_table and missing[0] < self.actor_table[-1]:
@@ -235,6 +365,7 @@ class CausalDeviceDoc:
         for a in missing:
             self._actor_rank[a] = len(self.actor_table)
             self.actor_table.append(a)
+        self._intern_gen += 1
 
     # ------------------------------------------------------------------
     # causality
@@ -289,8 +420,15 @@ class CausalDeviceDoc:
     # ------------------------------------------------------------------
 
     def apply_changes(self, changes):
-        return self.apply_batch(
-            type(self).batch_type.from_changes(changes, self.obj_id))
+        return self.apply_batch(self._decode_wire(changes))
+
+    def _decode_wire(self, changes):
+        """Protocol boundary: wire changes -> columnar batch. Subclasses
+        with a vectorized boundary decoder (text: wire_columns) override;
+        the base decodes ops columnar and leaves the per-change columns
+        to derive lazily at first schedule (equivalent — they cache on
+        the batch either way)."""
+        return type(self).batch_type.from_changes(changes, self.obj_id)
 
     def _schedule(self, batch, clock=None, prior_queue=None):
         """Admission scheduling: partition the batch + queued items into
@@ -300,6 +438,16 @@ class CausalDeviceDoc:
         pending base plan's post-commit snapshots instead."""
         prior_queue = list(self.queue if prior_queue is None
                            else prior_queue)
+        # columnar planner (default; INTERNALS §10): admission over the
+        # batch's per-change struct-of-arrays — rounds come back already
+        # GROUPED ((batch, rows, mask) triples), no per-change tuples.
+        # Plan-equivalent to the legacy paths below by construction;
+        # pinned by tests/test_columnar_plan.py.
+        if not prior_queue and batch.n_changes and columnar_plan_enabled():
+            out = self._schedule_columnar(
+                batch, self.clock if clock is None else clock, prior_queue)
+            if out is not None:
+                return out
         pending = list(range(batch.n_changes)) + prior_queue
         clock = dict(self.clock if clock is None else clock)
         scheduled: set = set()  # (actor, seq) admitted in this call
@@ -419,7 +567,25 @@ class CausalDeviceDoc:
         g_seq = [np.asarray([s for _, s in d.items()], np.int64)
                  for d in group_deps]
 
-        rounds: list = []
+        round_rows, remaining = self._admission_rounds(
+            aidx, seqs, dgid, g_actor, g_seq, len(group_deps), clock)
+        rounds = [[(batch, int(r)) for r in r_idx] for r_idx in round_rows]
+        queue_after = [(batch, int(r)) for r in np.flatnonzero(remaining)]
+        return rounds, queue_after, prior_queue
+
+    @staticmethod
+    def _admission_rounds(aidx, seqs, dgid, g_actor, g_seq,
+                          n_groups: int, clock):
+        """The ONE vectorized admission loop (one numpy pass per causal
+        round) shared by `_schedule_bulk` and `_schedule_columnar` — the
+        admission SEMANTICS (idempotent dup skip, implicit self-dep
+        override via single-failure forgiveness, first-occurrence-wins
+        for same-(actor, seq) rows in one round) live here and nowhere
+        else, so the default planner and the parity comparator cannot
+        drift. `clock` is mutated in place. Returns (round index arrays,
+        remaining mask: rows still pending = the queue)."""
+        n = len(seqs)
+        round_rows: list = []
         remaining = np.ones(n, bool)
         while True:
             idxs = np.flatnonzero(remaining)
@@ -439,8 +605,8 @@ class CausalDeviceDoc:
             # forgiven for rows whose own actor it names (the implicit
             # self-dep override)
             gs = np.unique(dgid[idxs])
-            n_fail = np.zeros(len(group_deps), np.int64)
-            fail_one = np.full(len(group_deps), -1, np.int64)
+            n_fail = np.zeros(n_groups, np.int64)
+            fail_one = np.full(n_groups, -1, np.int64)
             for g in gs:
                 fa, fs = g_actor[g], g_seq[g]
                 fails = fa[clock[fa] < fs]
@@ -461,8 +627,95 @@ class CausalDeviceDoc:
                 r_idx = r_idx[np.sort(first)]
             remaining[r_idx] = False
             np.maximum.at(clock, aidx[r_idx], seqs[r_idx])
-            rounds.append([(batch, int(r)) for r in r_idx])
+            round_rows.append(r_idx)
+        return round_rows, remaining
+
+    def _schedule_columnar(self, batch, clock0: dict, prior_queue: list):
+        """Columnar admission (INTERNALS §10): rounds over the batch's
+        per-change struct-of-arrays, emitted pre-grouped.
+
+        The per-change metadata — dense actor ids, seq column, dep
+        GROUPS — was derived once at the protocol boundary
+        (engine/wire_columns.change_columns) and is reused across every
+        application of the (immutable) batch, so admission is boolean
+        column ops against a clock vector: no per-change dict lookups,
+        no (batch, row) tuple lists. Returns None for shapes the columns
+        do not cover (small batches without the wide-merge shape fall to
+        the per-change loop, whose cost at that size is the setup's).
+        Admission decisions are exactly the legacy paths' — the fast
+        path tests the same frontier/new-actor conditions at dep-CONTENT
+        level (the legacy identity test plus `_schedule_bulk`'s content
+        dedup reach the same partition), and the bulk loop mirrors
+        `_schedule_bulk` row for row."""
+        n = batch.n_changes
+        cols = getattr(batch, "_change_columns", None)
+        if cols is None and n < _BULK_SCHEDULE_MIN:
+            # tiny (interactive) batches: deriving columns costs more
+            # than the per-change loop saves, and the legacy identity
+            # fast path covers the small wide-merge shape equally well —
+            # don't burden the cfg7 write-behind hot path
+            return None
+        if cols is None:
+            from .wire_columns import change_columns
+            cols = change_columns(batch)
+
+        # fast path — wide concurrent merge: every change at seq 1 from a
+        # distinct new actor, one already-covered dep frontier. The
+        # columns make each test O(distinct) instead of O(changes).
+        if cols.all_seq1 and cols.distinct_actors and cols.single_group:
+            d0 = cols.group_deps[0]
+            if all(clock0.get(a, 0) >= s for a, s in d0.items()):
+                # new-actor test from the cheaper side: the batch's actor
+                # set is a frozenset, the clock a dict — iterate whichever
+                # is smaller
+                if len(clock0) <= cols.n_change_actors:
+                    fresh = not any(a in cols.actor_set for a in clock0)
+                else:
+                    fresh = not any(
+                        a in clock0
+                        for a in cols.local_actors[:cols.n_change_actors])
+                if fresh:
+                    return ([_GroupedRound(
+                        [(batch, np.arange(n, dtype=np.int32),
+                          slice(None))])], [], prior_queue)
+
+        if n < _BULK_SCHEDULE_MIN:
+            return None         # loop path: setup costs more than the walk
+
+        # bulk columnar rounds — `_schedule_bulk`'s per-round vector pass
+        # with every per-call derivation (dense ids, dep grouping, group
+        # arrays) replaced by the batch's cached columns. Only the clock
+        # vector is per-document.
+        aidx = cols.actor_idx.astype(np.int64)
+        seqs = cols.seqs.astype(np.int64)
+        dgid = cols.dep_gid
+        n_groups = len(cols.group_deps)
+        clock = np.empty(len(cols.local_actors), np.int64)
+        for j, a in enumerate(cols.local_actors):
+            clock[j] = clock0.get(a, 0)
+        g_actor = [cols.g_actor[cols.g_off[g]:cols.g_off[g + 1]]
+                   .astype(np.int64) for g in range(n_groups)]
+        g_seq = [cols.g_seq[cols.g_off[g]:cols.g_off[g + 1]]
+                 for g in range(n_groups)]
+
+        round_rows, remaining = self._admission_rounds(
+            aidx, seqs, dgid, g_actor, g_seq, n_groups, clock)
         queue_after = [(batch, int(r)) for r in np.flatnonzero(remaining)]
+
+        if len(round_rows) == 1 and len(round_rows[0]) == n:
+            rounds = [_GroupedRound(
+                [(batch, np.arange(n, dtype=np.int32), slice(None))])]
+        else:
+            # one pass builds every round's op mask: rounds partition the
+            # admitted changes, so op masks come from a change->round map
+            round_of = np.full(n, -1, np.int64)
+            for k, r_idx in enumerate(round_rows):
+                round_of[r_idx] = k
+            op_round = round_of[batch.op_change]
+            rounds = [
+                _GroupedRound([(batch, r_idx.astype(np.int32),
+                                op_round == k)])
+                for k, r_idx in enumerate(round_rows)]
         return rounds, queue_after, prior_queue
 
     def apply_batch(self, batch):
@@ -480,7 +733,7 @@ class CausalDeviceDoc:
         try:
             for ready in rounds:
                 self._apply_round(ready)
-                applied |= {(b.actors[r], int(b.seqs[r])) for b, r in ready}
+                applied |= _round_row_pairs(ready)
         except BaseException:
             # a failed round must not swallow changes that were queued before
             # this call: admission consumed self.queue into the round plan, so
@@ -501,7 +754,10 @@ class CausalDeviceDoc:
     @staticmethod
     def _group_round(ready) -> list:
         """Group one round's (batch, row) pairs by source batch and compute
-        each group's op mask."""
+        each group's op mask. Columnar rounds arrive pre-grouped and pass
+        through untouched."""
+        if isinstance(ready, _GroupedRound):
+            return ready
         b0 = ready[0][0]
         if len(ready) == b0.n_changes and all(it[0] is b0 for it in ready):
             # single whole batch (the fast-schedule shape): rows are the
@@ -524,27 +780,51 @@ class CausalDeviceDoc:
             groups.append((b, rows_arr, mask))
         return groups
 
+    def _frontier_pairs(self, b, rows_arr):
+        """The shared-frontier decision of one round group, ONE place for
+        both the apply path (`_round_bookkeeping`) and the prepare path
+        (`prepare_batch`): returns (d0, pairs, rows_l, seqs_l) where a
+        non-None `d0` is the single dep frontier every row shares (all at
+        seq 1) and `pairs` its (actor, 1) rows — derived from the
+        columnar shape flags + the batch-level pairs cache when the
+        columns exist, from the identity walk otherwise. d0 None = mixed
+        round; rows_l/seqs_l are the materialized lists the mixed path
+        consumes (only built when actually needed)."""
+        actors = b.actors
+        cols = (getattr(b, "_change_columns", None)
+                if columnar_plan_enabled() else None)
+        if (cols is not None and len(rows_arr)
+                and cols.all_seq1 and cols.single_group):
+            pairs = (cols.pairs_all(actors, b.seqs)
+                     if len(rows_arr) == b.n_changes
+                     else [(actors[r], 1) for r in rows_arr.tolist()])
+            return cols.group_deps[0], pairs, None, None
+        seqs_l = b.seqs.tolist()
+        rows_l = rows_arr.tolist()
+        d0 = (self._shared_frontier(b.deps, rows_l, seqs_l)
+              if rows_l else None)
+        pairs = ([(actors[r], 1) for r in rows_l]
+                 if d0 is not None else None)
+        return d0, pairs, rows_l, seqs_l
+
     def _round_bookkeeping(self, b, rows_arr):
         """Advance clock/_all_deps for a round's rows; returns the snapshots
         `_rollback_bookkeeping` needs if the round's ingest fails."""
         clock = self.clock
         all_deps = self._all_deps
         actors, deps_list = b.actors, b.deps
-        seqs = b.seqs.tolist()
-        rows = rows_arr.tolist()
-
-        d0 = self._shared_frontier(deps_list, rows, seqs) if rows else None
+        d0, pairs, rows, seqs = self._frontier_pairs(b, rows_arr)
         if d0 is not None:
             # one closure serves the whole round; bookkeeping is bulk
             # C-speed dict work (dict.fromkeys/update) per row
-            hit = self._compute_all_deps(actors[rows[0]], 1, d0)
-            row_actors = [actors[r] for r in rows]
-            pairs = [(a, 1) for a in row_actors]
-            prev_clock = {a: clock.get(a) for a in row_actors}
+            hit = self._compute_all_deps(pairs[0][0], 1, d0)
+            prev_clock = {a: clock.get(a) for a, _ in pairs}
             prev_deps = {p: all_deps.get(p) for p in pairs}
             all_deps.update(dict.fromkeys(pairs, hit))
-            clock.update(dict.fromkeys(row_actors, 1))
+            clock.update(pairs)
             return prev_clock, prev_deps
+        # d0 None comes only from the identity-walk branch: rows/seqs set
+        assert rows is not None
 
         # mixed round: closures computed grouped by shared deps dict
         # (rows of one round are causally independent, so computing every
@@ -587,7 +867,7 @@ class CausalDeviceDoc:
             # leaves the causal state untouched (extra interned actors are
             # harmless — interning only renames ranks consistently, it adds
             # no document content).
-            remap = self._intern_actors(b.actor_table)
+            remap = self._intern_batch_actors(b)
             if remap is not None:
                 self._apply_remap(remap)
 
@@ -671,7 +951,7 @@ class CausalDeviceDoc:
                     "cannot chain prepare onto a plan without shadow state")
             # append-only interning (raises on reorder) — a remap would
             # invalidate the pending base plan's staged actor columns
-            self._intern_actors_append(batch.actor_table)
+            self._intern_batch_actors(batch, append_only=True)
             p: Optional[PreparedBatch] = after
             while p is not None:
                 chain.append(p)
@@ -679,24 +959,26 @@ class CausalDeviceDoc:
             rounds, queue_after, prior_queue = self._schedule(
                 batch, clock=after.clock_after,
                 prior_queue=after.queue_after)
-            for ready in rounds:
-                for b, _ in ready:
+            grounds = [self._group_round(r) for r in rounds]
+            for groups in grounds:
+                for b, _, _ in groups:
                     if b is not batch:
-                        self._intern_actors_append(b.actor_table)
+                        self._intern_batch_actors(b, append_only=True)
             gen = None
             shadow = after.final_shadow
             base_clock = after.clock_after
         else:
-            remap = self._intern_actors(batch.actor_table)
+            remap = self._intern_batch_actors(batch)
             if remap is not None:
                 self._apply_remap(remap)
             rounds, queue_after, prior_queue = self._schedule(batch)
+            grounds = [self._group_round(r) for r in rounds]
             # intern queued batches' actors too, BEFORE planning: a remap
             # after a round was planned would invalidate its staged ranks
-            for ready in rounds:
-                for b, _ in ready:
+            for groups in grounds:
+                for b, _, _ in groups:
                     if b is not batch:
-                        remap = self._intern_actors(b.actor_table)
+                        remap = self._intern_batch_actors(b)
                         if remap is not None:
                             self._apply_remap(remap)
             gen = self._gen
@@ -717,19 +999,20 @@ class CausalDeviceDoc:
                             *[p.memo_overlay for p in chain],
                             self._closure_memo)
         clock_after = dict(base_clock)
-        for ready in rounds:
-            for b, rows_arr, mask in self._group_round(ready):
+        for groups in grounds:
+            for b, rows_arr, mask in groups:
                 actors, deps_list = b.actors, b.deps
-                seqs_l = b.seqs.tolist()
-                rows_l = rows_arr.tolist()
-                d0 = (self._shared_frontier(deps_list, rows_l, seqs_l)
-                      if rows_l else None)
+                # ONE shared-frontier decision for apply and prepare
+                # paths alike (`_frontier_pairs`): columnar shape flags +
+                # the batch-level pairs cache when columns exist, the
+                # identity walk otherwise
+                d0, pairs, rows_l, seqs_l = self._frontier_pairs(
+                    b, rows_arr)
                 if d0 is not None:
                     hit = self._compute_all_deps(
-                        actors[rows_l[0]], 1, d0, all_deps=all_map,
+                        pairs[0][0], 1, d0, all_deps=all_map,
                         memo=memo_map)
-                    pairs = [(actors[r], 1) for r in rows_l]
-                    closures = [hit] * len(rows_l)
+                    closures = [hit] * len(pairs)
                     deps_overlay.update(dict.fromkeys(pairs, hit))
                 else:
                     pairs, closures = self._bulk_closures(

@@ -38,6 +38,14 @@ This module closes the planning half of that gap:
 Jiffy's batch-update/snapshot split and PAM's bulk-parallel map
 construction (PAPERS.md) are the shape being reproduced: bulk-plan on
 the host in parallel, commit as pure dispatch.
+
+The ring is planner-agnostic: the columnar planner (INTERNALS §10,
+`engine/wire_columns.py` + `base._schedule_columnar`) chains its
+pre-grouped plans through `prepare_batch(after=...)` unchanged — the
+worker thread just plans in column space (batch-level decode caches
+shared across the stream), and `AMTPU_COLUMNAR_PLAN=0` runs the same
+ring over the legacy per-change planner
+(tests/test_columnar_plan.py::test_ring_integration_both_planners).
 """
 
 from __future__ import annotations

@@ -140,6 +140,13 @@ class SegmentMirror:
     def n_segs(self) -> int:
         return len(self.heads) - 1
 
+    def copy(self) -> "SegmentMirror":
+        """Independent copy — required wherever one mirror value could be
+        shared across documents (the per-batch mirror cache,
+        engine/text_doc.py), because `remap_actors` mutates in place."""
+        return SegmentMirror(self.heads.copy(), self.par.copy(),
+                             self.hctr.copy(), self.hactor.copy())
+
     def head_checksum(self) -> int:
         """Wrapping sum of a NONLINEAR 32-bit mix of each live head slot —
         the host twin of the device-side reduce the planned kernel derives

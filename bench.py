@@ -571,12 +571,23 @@ def measure_pipeline(n_batches: int = 6, n_actors: int = 2_000,
     def serial_profile():
         """Serial comparator: the same stream with prepare/commit/sync
         timed apart — names the dominating term on a floor miss and
-        yields pipeline_gain."""
+        yields pipeline_gain.
+
+        Each commit is followed by a hard device-completion barrier whose
+        time is its own term (`device_wait_s`): dispatch is async, so
+        without the barrier the next prepare's staging wait silently
+        absorbed the previous batch's device execution and the profile
+        named `prepare_s` the dominating term when the device was
+        (docs/PROFILE_r7.md — the columnar-planner round found the
+        mislabel). This also makes the comparator a TRUE serial schedule
+        (no prepare-under-execution overlap), the same definition cfg5d's
+        barrier=True comparator uses."""
+        import jax as _jax
         doc = DeviceTextDoc("pipe-text")
         doc.eager_materialize = True
         doc.apply_batch(base_batch("pipe-text", base_n))
         doc.text()
-        prep_s = commit_s = 0.0
+        prep_s = commit_s = wait_s = 0.0
         for b in batches:
             t0 = time.perf_counter()
             plan = doc.prepare_batch(b)
@@ -584,6 +595,9 @@ def measure_pipeline(n_batches: int = 6, n_actors: int = 2_000,
             t0 = time.perf_counter()
             doc.commit_prepared(plan)
             commit_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            _jax.block_until_ready(list(doc._dev.values()))
+            wait_s += time.perf_counter() - t0
         t0 = time.perf_counter()
         doc._materialize(with_pos=False)
         scal = doc._scalars()
@@ -591,6 +605,7 @@ def measure_pipeline(n_batches: int = 6, n_actors: int = 2_000,
         assert int(scal[0]) == expect_vis
         return {"prepare_s": round(prep_s, 4),
                 "commit_s": round(commit_s, 4),
+                "device_wait_s": round(wait_s, 4),
                 "final_sync_s": round(sync_s, 4)}
 
     stream()                        # warm-up: jit compiles at these shapes
@@ -667,6 +682,13 @@ def measure_pipeline(n_batches: int = 6, n_actors: int = 2_000,
     # record's value must be the median of the recorded rep series (a
     # future edit promoting max() fails here, not in review)
     assert rec["value"] == round(_median(rec["reps_ops_per_sec"])), rec
+    # machine-checked CPU floor against the latest committed cpu row
+    # (VERDICT r5 #6); chip rows are floor-checked via floor_met above.
+    # NOT in --quick mode: the committed baseline is full-scale, and a
+    # reduced-shape CI run compared against it would alarm forever
+    if not quick:
+        from benchmarks.common import headline_cpu_floor
+        headline_cpu_floor(rec, "cfg5f_" + rec["metric"])
     return rec
 
 
@@ -809,6 +831,11 @@ def _measure() -> dict:
         "platform": _jax.devices()[0].platform,
         "recorded_at_utc": datetime.now(timezone.utc).isoformat(),
     }
+    # the cfg5 machine-checked CPU floor (VERDICT r5 #6): value >= 80% of
+    # the latest committed cpu row; chip runs carry floor_met instead.
+    # threshold_met lands in the record and a miss prints to stderr.
+    from benchmarks.common import headline_cpu_floor
+    headline_cpu_floor(rec, "cfg5_" + rec["metric"])
     # A live on-chip run inherits the tunnel weather of its minute
     # (observed 65-115M ops/s across one night on unchanged code). The
     # headline VALUE stays this run's honest measurement; when a better

@@ -154,6 +154,85 @@ def tracking_only_wan(bound: str) -> str:
             f"local: {bound}")
 
 
+def prior_committed_value(metric: str, platform: str, root: str = None):
+    """Value of the latest committed record row for (metric, platform).
+
+    Scans BENCH_CONFIGS_r*.json newest-first (by NUMERIC round — a
+    lexicographic sort would rank r99 above r100 once rounds outgrow the
+    2-digit padding) and returns the first matching row's value, or
+    None. The committed records are the cross-round regression baseline
+    the tracking-only methodology diffs against; this helper turns that
+    diff into a machine check for the headline rows (VERDICT r5 #6: CPU
+    row >= its prior record -20%). `root` overrides the repo root
+    (tests)."""
+    import glob
+    import re
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def round_no(path: str) -> int:
+        m = re.search(r"_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_CONFIGS_r*.json")),
+                       key=round_no, reverse=True):
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (row.get("metric") == metric
+                            and row.get("platform") == platform
+                            and isinstance(row.get("value"), (int, float))):
+                        return float(row["value"])
+        except OSError:
+            continue
+    return None
+
+
+def headline_cpu_floor(rec: dict, committed_metric: str,
+                       slack: float = 0.8, root: str = None) -> dict:
+    """Fold the cfg5/headline CPU floor into a bench record (in place).
+
+    On cpu, the machine check is `value >= slack * latest committed cpu
+    row` (VERDICT r5 #6; chip rows carry `floor_met` against the 100M
+    north star instead). The result is recorded, never silently dropped:
+    `threshold_met` lands in the row and a miss prints to stderr so a
+    regression of the one metric the project is judged on is loud in
+    every sweep log."""
+    if rec.get("platform") != "cpu":
+        return rec
+    prior = prior_committed_value(committed_metric, "cpu", root=root)
+    if prior is None:
+        rec["threshold"] += ("; cpu floor: no committed cpu row yet for "
+                             f"{committed_metric} — this run seeds it")
+        return rec
+    bound = slack * prior
+    met = bool(float(rec["value"]) >= bound)
+    rec["threshold"] += (
+        f"; cpu floor (machine-checked): value >= {slack:.0%} of the "
+        f"latest committed cpu row ({round(prior)} {rec.get('unit', '')}, "
+        f"{committed_metric}) -> threshold_met. The committed row may "
+        "come from a DIFFERENT host: a miss with the device region "
+        "untouched usually means the box changed, not the code — confirm "
+        "with a same-box A/B (docs/PROFILE_r7.md method) before reading "
+        "it as a regression")
+    rec["threshold_met"] = met
+    rec["threshold_prior_cpu"] = prior
+    if not met:
+        print(f"bench: HEADLINE CPU FLOOR MISS: {rec['metric']} = "
+              f"{rec['value']} < {bound:.0f} (= {slack} x committed "
+              f"{round(prior)}). Code regression OR host change — run a "
+              "same-box A/B against the prior tree before concluding "
+              "(docs/PROFILE_r7.md)", file=sys.stderr)
+    return rec
+
+
 def emit(metric: str, value: float, unit: str,
          vs_baseline: float | None = None, **extra):
     # vs_baseline None -> json null: an honest "no defined target" instead

@@ -504,6 +504,8 @@ def config5f_pipeline(quick: bool = False):
          serial_profile=rec["serial_profile"],
          floor_met=rec["floor_met"],
          **({"shortfall": rec["shortfall"]} if "shortfall" in rec else {}),
+         **({"threshold_met": rec["threshold_met"]}
+            if "threshold_met" in rec else {}),
          threshold=rec["threshold"])
 
 

@@ -1,0 +1,434 @@
+"""Columnar-vs-legacy planner parity (INTERNALS §10).
+
+The columnar planner (engine/wire_columns.py + engine/base.py
+`_schedule_columnar`, the AMTPU_COLUMNAR_PLAN default) must produce
+EXACTLY the legacy per-change planner's outcome on every input: same
+committed device state (all nine element tables byte-identical), same
+text, same clock/queue/conflicts, same backend patches. These tests pin
+that contract over randomized batches covering the admission edge cases
+— out-of-order seqs, duplicate deliveries, causally-premature changes,
+multi-round chains, shared and distinct dep frontiers — plus the
+decoder-level parity of the vectorized wire decoder.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+import bench as B
+from automerge_tpu.engine import DeviceTextDoc, PipelinedIngestor
+from automerge_tpu.engine.columnar import TextChangeBatch
+from automerge_tpu.engine.map_doc import DeviceMapDoc
+from automerge_tpu.engine.wire_columns import (
+    _from_changes_numpy, change_columns, decode_text_changes_columnar)
+
+
+# ---------------------------------------------------------------------------
+# randomized wire-change generation (admission edge cases included)
+# ---------------------------------------------------------------------------
+
+
+def rand_text_changes(rng, n_changes=30, obj="t", n_actors=6,
+                      premature=True, dups=True):
+    """Randomized wire changes: typing runs, bare assigns, out-of-order
+    seqs (shuffled delivery), duplicates, and causally-premature dep
+    frontiers (changes that queue forever). Deliveries stay CONSISTENT:
+    every foreign elemId reference is covered by a dep on its minting
+    change, so both planners admit the exact same rows (an uncovered ref
+    is an inconsistency the engine rejects by raising)."""
+    changes = []
+    elems = {}            # actor -> next elem counter
+    known = ["_head"]     # insertable parents (elemIds + head)
+    src = {}              # elemId -> (actor, seq) of the minting change
+    seq_of = {}
+    for _ in range(n_changes):
+        actor = f"a{rng.randrange(n_actors):02d}"
+        seq = seq_of.get(actor, 0) + 1
+        seq_of[actor] = seq
+        deps = {}
+        ops = []
+
+        def ref(eid):
+            """Reference an elemId, covering it causally."""
+            s = src.get(eid)
+            if s is not None and s[0] != actor:
+                deps[s[0]] = max(deps.get(s[0], 0), s[1])
+            return eid
+
+        premature_change = premature and rng.random() < 0.15
+        if premature_change:
+            # an unsatisfiable frontier: queues for the session; its ops
+            # reference only its own fresh elements
+            other = f"a{rng.randrange(n_actors):02d}"
+            if other != actor:
+                deps[other] = seq_of.get(other, 0) + rng.randrange(2, 4)
+        for _ in range(rng.randrange(0, 5)):
+            r = rng.random()
+            if r < 0.55 or len(known) == 1 or premature_change:
+                e = elems.get(actor, 0) + 1
+                elems[actor] = e
+                key = ("_head" if (premature_change or len(known) == 1
+                                   or rng.random() < 0.3)
+                       else ref(rng.choice(known[1:])))
+                ops.append({"action": "ins", "obj": obj, "key": key,
+                            "elem": e})
+                eid = f"{actor}:{e}"
+                ops.append({"action": "set", "obj": obj, "key": eid,
+                            "value": chr(97 + rng.randrange(26))})
+                known.append(eid)
+                src[eid] = (actor, seq)
+            elif r < 0.75:
+                ops.append({"action": "set", "obj": obj,
+                            "key": ref(rng.choice(known[1:])),
+                            "value": chr(97 + rng.randrange(26))})
+            elif r < 0.9:
+                ops.append({"action": "del", "obj": obj,
+                            "key": ref(rng.choice(known[1:]))})
+            else:
+                ops.append({"action": "inc", "obj": obj,
+                            "key": ref(rng.choice(known[1:])),
+                            "value": rng.randrange(-2, 5)})
+        changes.append({"actor": actor, "seq": seq, "deps": deps,
+                        "ops": ops})
+        if premature_change:
+            # the actor's later seqs would implicitly depend on the
+            # queued change; stop minting from it so `known` stays
+            # resolvable for other actors
+            for eid in [k for k, v in src.items() if v == (actor, seq)]:
+                known.remove(eid)
+                del src[eid]
+    rng.shuffle(changes)                   # out-of-order delivery
+    if dups:
+        for _ in range(rng.randrange(0, 3)):
+            changes.insert(rng.randrange(len(changes) + 1),
+                           dict(rng.choice(changes)))
+    return changes
+
+
+def engine_state(doc):
+    """Everything the committed document state consists of, host-side."""
+    out = {
+        "text": doc.text(),
+        "n_elems": doc.n_elems,
+        "clock": dict(doc.clock),
+        "queue": sorted((b.actors[r], int(b.seqs[r])) for b, r in doc.queue),
+        "conflicts": {k: sorted((o["actor_rank"], o["seq"], o["value"],
+                                 o["counter"]) for o in v)
+                      for k, v in doc.conflicts.items()},
+        "actor_table": list(doc.actor_table),
+        "value_pool": [str(v) for v in doc.value_pool],
+    }
+    if doc.n_elems:
+        mirrors = doc._fetch_mirrors(doc._TABLE_KEYS)
+        n = doc.n_elems + 1
+        out["tables"] = {k: v[:n].tobytes() for k, v in mirrors.items()}
+    return out
+
+
+def apply_with_flag(changes, flag, monkeypatch, *, prepared=False,
+                    seed_doc=True):
+    monkeypatch.setenv("AMTPU_COLUMNAR_PLAN", flag)
+    doc = DeviceTextDoc("t")
+    if seed_doc:
+        doc.apply_changes([{"actor": "base", "seq": 1, "deps": {}, "ops": [
+            {"action": "ins", "obj": "t", "key": "_head", "elem": 1},
+            {"action": "set", "obj": "t", "key": "base:1", "value": "Z"},
+        ]}])
+    batch = TextChangeBatch.from_changes(changes, "t", _try_native=False)
+    if flag == "1":
+        # the random batches sit below the scheduler's derive gate
+        # (_BULK_SCHEDULE_MIN); attach the columns as the protocol
+        # boundary would for a bulk payload, so the columnar paths are
+        # what this parity suite actually exercises
+        change_columns(batch)
+    if prepared:
+        doc.commit_prepared(doc.prepare_batch(batch))
+    else:
+        doc.apply_batch(batch)
+    return engine_state(doc)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_planner_parity_random_batches(seed, monkeypatch):
+    """Committed device state is byte-identical between the columnar and
+    legacy planners over randomized out-of-order/duplicate/premature
+    batches — via both apply_batch and the prepare/commit path."""
+    rng = random.Random(seed)
+    changes = rand_text_changes(rng, n_changes=10 + 5 * seed)
+    legacy = apply_with_flag(list(changes), "0", monkeypatch)
+    cols = apply_with_flag(list(changes), "1", monkeypatch)
+    assert cols == legacy
+    cols_prep = apply_with_flag(list(changes), "1", monkeypatch,
+                                prepared=True)
+    assert cols_prep == legacy
+
+
+def test_planner_parity_forced_loop_vs_columnar(monkeypatch):
+    """Columnar admission agrees with the per-change loop even below the
+    bulk threshold (the loop is the ground-truth comparator)."""
+    import automerge_tpu.engine.base as eb
+    rng = random.Random(99)
+    changes = rand_text_changes(rng, n_changes=40)
+    monkeypatch.setattr(eb, "_BULK_SCHEDULE_MIN", 10**9)
+    legacy = apply_with_flag(list(changes), "0", monkeypatch)
+    cols = apply_with_flag(list(changes), "1", monkeypatch)
+    assert cols == legacy
+
+
+def test_wide_merge_parity(monkeypatch):
+    """The headline shape (wide concurrent merge over one frontier) —
+    fast path vs legacy, including a second (duplicate) delivery."""
+    batch_changes = None
+    states = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("AMTPU_COLUMNAR_PLAN", flag)
+        doc = DeviceTextDoc("t")
+        doc.apply_batch(B.base_batch("t", 500))
+        merge = B.merge_batch("t", 40, 20, 500, seed=3)
+        dup = B.merge_batch("t", 40, 20, 500, seed=3)
+        if flag == "1":
+            change_columns(merge)     # below the scheduler derive gate
+            change_columns(dup)
+        doc.apply_batch(merge)
+        doc.apply_batch(dup)          # duplicate delivery
+        states[flag] = engine_state(doc)
+        batch_changes = merge
+    assert states["0"] == states["1"]
+    assert batch_changes.n_ops == 40 * 20
+
+
+def test_map_planner_parity(monkeypatch):
+    """Map/counter documents run the same admission machinery."""
+    rng = random.Random(5)
+    seq_of = {}
+    changes = []
+    for _ in range(120):
+        actor = f"m{rng.randrange(5)}"
+        seq = seq_of.get(actor, 0) + 1
+        seq_of[actor] = seq
+        changes.append({
+            "actor": actor, "seq": seq, "deps": {},
+            "ops": [{"action": "set", "obj": "m",
+                     "key": f"k{rng.randrange(9)}",
+                     "value": rng.randrange(100)}]})
+    random.Random(7).shuffle(changes)
+    states = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("AMTPU_COLUMNAR_PLAN", flag)
+        doc = DeviceMapDoc("m")
+        doc.apply_changes(list(changes))
+        states[flag] = {
+            "clock": dict(doc.clock),
+            "values": {k: doc.get(k) for k in
+                       (f"k{i}" for i in range(9))},
+        }
+    assert states["0"] == states["1"]
+
+
+def test_backend_patch_parity(monkeypatch):
+    """The device backend tier produces identical patches either way."""
+    import json
+
+    from automerge_tpu.backend import device as device_backend
+
+    def run(flag):
+        monkeypatch.setenv("AMTPU_COLUMNAR_PLAN", flag)
+        state = device_backend.Backend.init()
+        doc_change = {
+            "actor": "alice", "seq": 1, "deps": {},
+            "ops": [
+                {"action": "makeText", "obj": "txt"},
+                {"action": "link", "obj": "00000000-0000-0000-0000-000000000000",
+                 "key": "text", "value": "txt"},
+            ] + [op for k in range(1, 9) for op in (
+                {"action": "ins", "obj": "txt",
+                 "key": "_head" if k == 1 else f"alice:{k-1}", "elem": k},
+                {"action": "set", "obj": "txt", "key": f"alice:{k}",
+                 "value": chr(96 + k)})],
+        }
+        concurrent = [{
+            "actor": f"bob{i}", "seq": 1, "deps": {"alice": 1},
+            "ops": [
+                {"action": "ins", "obj": "txt", "key": f"alice:{4 + i}",
+                 "elem": 1},
+                {"action": "set", "obj": "txt", "key": f"bob{i}:1",
+                 "value": str(i)}],
+        } for i in range(3)]
+        state, p1 = device_backend.Backend.apply_changes(state, [doc_change])
+        state, p2 = device_backend.Backend.apply_changes(state, concurrent)
+        return json.dumps([p1, p2], sort_keys=True, default=str)
+
+    assert run("0") == run("1")
+
+
+def test_ring_integration_both_planners(monkeypatch):
+    """The K-deep pipelined ring converges identically with either
+    planner, stays fully chained, and the budget surface agrees."""
+    texts = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("AMTPU_COLUMNAR_PLAN", flag)
+        doc = DeviceTextDoc("p")
+        doc.eager_materialize = True
+        doc.apply_batch(B.base_batch("p", 2000))
+        doc.text()
+        batches = [B.merge_batch("p", 50, 40, 2000, seed=20 + k,
+                                 actor_prefix=f"s{k:03d}")
+                   for k in range(4)]
+        if flag == "1":
+            for bb in batches:        # below the scheduler derive gate
+                change_columns(bb)
+        with PipelinedIngestor(doc, slots=3) as pipe:
+            pipe.run(batches)
+            stats = pipe.stats
+        assert stats["committed"] == 4
+        assert stats["fallbacks"] == 0
+        assert stats["chained_prepares"] >= 3, (flag, stats)
+        texts[flag] = doc.text()
+    assert texts["0"] == texts["1"]
+
+
+# ---------------------------------------------------------------------------
+# wire decoder parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_numpy_decoder_parity(seed):
+    """The vectorized wire decoder emits batches identical to the per-op
+    walk on everything inside its scope."""
+    rng = random.Random(seed)
+    changes = rand_text_changes(rng, n_changes=25, premature=False)
+    walk = TextChangeBatch.from_changes(list(changes), "t",
+                                        _try_native=False)
+    fast = _from_changes_numpy(list(changes), "t")
+    assert fast is not None
+    assert walk.actors == fast.actors
+    assert walk.actor_table == fast.actor_table
+    assert walk.deps == fast.deps
+    assert walk.messages == fast.messages
+    assert walk.value_pool == fast.value_pool
+    for f in ("seqs", "op_change", "op_kind", "op_target_actor",
+              "op_target_ctr", "op_parent_actor", "op_parent_ctr",
+              "op_value"):
+        assert np.array_equal(getattr(walk, f), getattr(fast, f)), f
+
+
+def test_numpy_decoder_rich_values_fall_back():
+    """Out-of-scope shapes (rich values, datatypes, links) return None so
+    the caller falls back to the per-op decoder — never a wrong batch."""
+    base = [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+        {"action": "ins", "obj": "t", "key": "_head", "elem": 1},
+        {"action": "set", "obj": "t", "key": "a:1", "value": "multi-char"},
+    ]}]
+    assert _from_changes_numpy(base, "t") is None
+    dt = [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+        {"action": "ins", "obj": "t", "key": "_head", "elem": 1},
+        {"action": "set", "obj": "t", "key": "a:1", "value": "x",
+         "datatype": "counter"},
+    ]}]
+    assert _from_changes_numpy(dt, "t") is None
+    # in-scope BULK payloads attach the columns eagerly; tiny windows
+    # stay on the walk (below _NUMPY_MIN_OPS) and derive lazily
+    n = 40
+    bulk = [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+        op for k in range(1, n + 1) for op in (
+            {"action": "ins", "obj": "t",
+             "key": "_head" if k == 1 else f"a:{k-1}", "elem": k},
+            {"action": "set", "obj": "t", "key": f"a:{k}", "value": "x"})]}]
+    batch = decode_text_changes_columnar(bulk, "t")
+    assert getattr(batch, "_change_columns", None) is not None
+    small = [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+        {"action": "ins", "obj": "t", "key": "_head", "elem": 1},
+        {"action": "set", "obj": "t", "key": "a:1", "value": "x"},
+    ]}]
+    sbatch = decode_text_changes_columnar(small, "t")
+    assert getattr(sbatch, "_change_columns", None) is None
+    doc = DeviceTextDoc("t")
+    doc.apply_batch(sbatch)
+    assert doc.text() == "x"
+
+
+def test_change_columns_shape():
+    """The per-change columns capture the batch's admission-relevant
+    structure exactly once and cache on the batch."""
+    merge = B.merge_batch("t", 8, 10, 100, seed=1)
+    cols = change_columns(merge)
+    assert change_columns(merge) is cols            # cached
+    assert cols.n_changes == 8
+    assert cols.all_seq1 and cols.distinct_actors and cols.single_group
+    assert cols.group_deps == [{"base": 1}]
+    assert cols.table_sorted == sorted(set(merge.actor_table))
+    assert list(cols.actor_idx) == sorted(
+        range(8), key=lambda i: merge.actors[i]) or len(
+            set(cols.actor_idx.tolist())) == 8
+    # dep group CSR refers to the combined local actor space
+    g0 = cols.g_actor[cols.g_off[0]:cols.g_off[1]]
+    assert [cols.local_actors[j] for j in g0] == ["base"]
+
+
+def test_rank_cache_invalidation(monkeypatch):
+    """A later interning change (new actor reordering ranks) invalidates
+    the per-(doc, generation) rank cache — stale ranks never commit."""
+    monkeypatch.setenv("AMTPU_COLUMNAR_PLAN", "1")
+    doc = DeviceTextDoc("t")
+    doc.apply_batch(B.base_batch("t", 50))
+    merge = B.merge_batch("t", 6, 10, 50, seed=2)
+    cols = change_columns(merge)      # boundary decode (below the gate)
+    doc.apply_batch(merge)
+    assert cols.rank_cache[doc]["gen"] == doc._intern_gen
+    # an actor ranking BELOW every existing one forces a remap
+    doc.apply_changes([{"actor": "AAA", "seq": 1, "deps": {}, "ops": []}])
+    assert cols.rank_cache[doc]["gen"] != doc._intern_gen
+    # re-applying the batch (duplicate) must re-resolve, not reuse stale
+    doc.apply_batch(merge)
+    legacy = DeviceTextDoc("t")
+    monkeypatch.setenv("AMTPU_COLUMNAR_PLAN", "0")
+    legacy.apply_batch(B.base_batch("t", 50))
+    legacy.apply_batch(B.merge_batch("t", 6, 10, 50, seed=2))
+    legacy.apply_changes([{"actor": "AAA", "seq": 1, "deps": {},
+                           "ops": []}])
+    assert doc.text() == legacy.text()
+    assert doc.clock == legacy.clock
+
+
+def test_numpy_decoder_rejects_malformed_elem_ids():
+    """A ctr that is not pure digits ('b:+5' int-parses but parse_elem_id
+    rejects it) must NOT decode on the vectorized path — bare int() would
+    silently alias the op onto element b:5."""
+    bad = [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+        {"action": "ins", "obj": "t", "key": "b:+5", "elem": 1}]}]
+    assert _from_changes_numpy(bad, "t") is None
+    for key in ("b: 5", "b:5\n", "nocolon", 7):
+        assert _from_changes_numpy(
+            [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+                {"action": "del", "obj": "t", "key": key}]}], "t") is None
+
+
+def test_apply_changes_routes_through_boundary_decoder():
+    """`DeviceTextDoc.apply_changes` IS the columnar protocol boundary:
+    bulk wire dicts decode through the vectorized decoder with columns
+    attached eagerly; small windows keep the per-op walk but still get
+    their columns; both merge identically."""
+    n = 40   # 80 ops: above the numpy-decoder gate
+    changes = [{"actor": "w", "seq": 1, "deps": {}, "ops": [
+        op for k in range(1, n + 1) for op in (
+            {"action": "ins", "obj": "t",
+             "key": "_head" if k == 1 else f"w:{k-1}", "elem": k},
+            {"action": "set", "obj": "t", "key": f"w:{k}",
+             "value": chr(97 + k % 26)})]}]
+    doc = DeviceTextDoc("t")
+    batch = doc._decode_wire(changes)
+    assert getattr(batch, "_change_columns", None) is not None
+    assert _from_changes_numpy(changes, "t") is not None  # numpy scope
+    doc.apply_batch(batch)
+    small = DeviceTextDoc("s")
+    small.apply_changes([{"actor": "w", "seq": 1, "deps": {}, "ops": [
+        {"action": "ins", "obj": "s", "key": "_head", "elem": 1},
+        {"action": "set", "obj": "s", "key": "w:1", "value": "q"}]}])
+    assert small.text() == "q"
+    walk = DeviceTextDoc("t")
+    walk.apply_batch(TextChangeBatch.from_changes(changes, "t",
+                                                  _try_native=False))
+    assert doc.text() == walk.text()

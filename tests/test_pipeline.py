@@ -363,26 +363,47 @@ def seed_small():
     return d
 
 
+def _round_rows(rounds):
+    """Row partition of scheduled rounds, either representation (legacy
+    (batch, row) tuple lists or columnar pre-grouped rounds)."""
+    out = []
+    for rnd in rounds:
+        if isinstance(rnd, eb._GroupedRound):
+            out.append([int(r) for _, rows, _ in rnd
+                        for r in np.asarray(rows).tolist()])
+        else:
+            out.append([r for _, r in rnd])
+    return out
+
+
 def test_schedule_bulk_parity(monkeypatch):
-    """The vectorized admission path partitions EXACTLY like the
-    per-change loop: same rounds, same row order, same queue."""
+    """The vectorized admission paths (columnar AND legacy bulk)
+    partition EXACTLY like the per-change loop: same rounds, same row
+    order, same queue."""
     batch = causal_batch()
     doc = seed_small()
+    cols = doc._schedule(batch)                      # columnar (default)
+    monkeypatch.setenv("AMTPU_COLUMNAR_PLAN", "0")
     bulk = doc._schedule(batch)                      # n >= threshold: bulk
     monkeypatch.setattr(eb, "_BULK_SCHEDULE_MIN", 10**9)
     loop = doc._schedule(batch)                      # forced loop
-    assert [[r for _, r in rnd] for rnd in bulk[0]] == \
-        [[r for _, r in rnd] for rnd in loop[0]]
+    assert _round_rows(bulk[0]) == _round_rows(loop[0])
+    assert _round_rows(cols[0]) == _round_rows(loop[0])
     assert [r for _, r in bulk[1]] == [r for _, r in loop[1]]
+    assert [r for _, r in cols[1]] == [r for _, r in loop[1]]
     # and the applied documents agree end to end
+    monkeypatch.delenv("AMTPU_COLUMNAR_PLAN", raising=False)
+    d_cols = seed_small()
+    d_cols.apply_batch(batch)
+    monkeypatch.setenv("AMTPU_COLUMNAR_PLAN", "0")
     d_bulk = seed_small()
-    d_bulk.apply_batch(batch)
+    d_bulk.apply_batch(causal_batch())
     monkeypatch.setattr(eb, "_BULK_SCHEDULE_MIN", 10**9)
     d_loop = seed_small()
     d_loop.apply_batch(causal_batch())
-    assert d_bulk.text() == d_loop.text()
-    assert d_bulk.clock == d_loop.clock
-    assert len(d_bulk.queue) == len(d_loop.queue) == 1
+    assert d_cols.text() == d_bulk.text() == d_loop.text()
+    assert d_cols.clock == d_bulk.clock == d_loop.clock
+    assert len(d_cols.queue) == len(d_bulk.queue) == len(d_loop.queue) == 1
 
 
 def test_sharded_detect_runs_bit_identical(monkeypatch):
