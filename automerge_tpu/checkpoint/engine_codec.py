@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import obs
 from ..resilience.errors import CheckpointError
 
 
@@ -89,6 +90,8 @@ def grab(doc, inline: bool = False) -> dict:
     if getattr(doc, "_busy", 0):
         # a mutation is in flight: gen stamps alone can't expose one that
         # spans this whole grab (the bump lands at mutation end)
+        if obs.ENABLED:
+            obs.event("ckpt", "busy_wait", args={"doc": doc.obj_id})
         raise CaptureConflict(doc.obj_id)
     gen0 = doc._gen
     dev = dict(doc._dev) if doc._dev is not None else None

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import threading
 
+from .. import obs
 from . import bundle as _bundle
 from .engine_codec import CaptureConflict, encode_grab, grab
 
@@ -67,7 +68,11 @@ class CheckpointHandle:
             # boundary), so a synchronous grab cannot conflict — and the
             # grab is encoded before any further (possibly donating)
             # commit can consume its buffers, hence inline=True
+            _t0 = obs.now() if obs.ENABLED else 0
             self._data = encode_engine_grab(grab(self._doc, inline=True))
+            if obs.ENABLED:
+                obs.span("ckpt", "capture", _t0, args={
+                    "mode": "sync_degraded", "bytes": len(self._data)})
             self._needs_sync = False
             self._error = None
         if self._error is not None:
@@ -169,20 +174,31 @@ class AsyncCheckpointer:
 
     def _capture_engine(self, doc, handle):
         g = None
+        _t0 = obs.now() if obs.ENABLED else 0
         for _ in range(self._max_retries):
             try:
                 g = grab(doc)
                 break
             except CaptureConflict:
                 self.stats["grab_conflicts"] += 1
+                if obs.ENABLED:
+                    obs.event("ckpt", "grab_conflict",
+                              args={"doc": doc.obj_id})
         if g is None:
             # ingestion never paused long enough: degrade to a
             # synchronous grab on the caller's thread at result() time
             self.stats["sync_fallbacks"] += 1
             handle._needs_sync = True
+            if obs.ENABLED:
+                obs.event("ckpt", "sync_fallback",
+                          args={"doc": doc.obj_id})
             return
         handle._data = encode_engine_grab(g)
         self.stats["async_captures"] += 1
+        if obs.ENABLED:
+            obs.span("ckpt", "capture", _t0, args={
+                "mode": "async", "doc": doc.obj_id,
+                "bytes": len(handle._data)})
 
 
 def _is_engine_doc(target) -> bool:

@@ -16,7 +16,7 @@ ASSERTED, not profiled after the fact:
   (`device_put`) is neither: it overlaps planning by design and is
   tracked separately as `staged_h2d_bytes`.
 
-Counters live in two places, updated together by the engine's
+Counters live in three places, updated together by the engine's
 `_count_dispatch`/`_count_sync` hooks (engine/base.py):
 
 - per-document (`CausalDeviceDoc.dispatch_stats`), with the last
@@ -24,7 +24,24 @@ Counters live in two places, updated together by the engine's
   ring can assert its per-batch budget;
 - the process-wide totals here, so call sites that span documents (the
   interactive `am.change` path through backend/device.py) can measure a
-  whole operation with `track()` regardless of which docs it touched.
+  whole operation with `track()` regardless of which docs it touched;
+- a per-THREAD mirror (`thread_snapshot`/`track(...).thread_stats`):
+  `track()`'s process delta is documented non-isolated against
+  concurrent device work on other threads, and nothing used to enforce
+  that — the thread-local mirror gives the budget tests
+  (tests/test_dispatch_budget.py) a delta that is correct by
+  construction even while a pipeline ring or checkpoint worker runs.
+  The process totals stay bit-compatible: same dict, same keys, same
+  update points.
+
+Since ISSUE 6 counts also carry a KERNEL LABEL: `record_dispatch(...,
+label="apply_mixed_round")` aggregates a per-label histogram
+(`labeled_snapshot()`) and feeds the obs flight-recorder counters
+(`device.dispatch:<label>`), so "7 dispatches" decomposes into WHICH
+programs launched — the two integers stay, the histogram rides along.
+Blocking syncs may additionally carry the measured blocked duration
+(`dur_ns`), giving a labeled time histogram of where the host actually
+waited on the device.
 
 The regression bars: tests/test_dispatch_budget.py pins the write-behind
 `am.change` path and the ring's per-commit budget; `bench.py --pipeline`
@@ -35,28 +52,70 @@ from __future__ import annotations
 
 import threading
 
+from .. import obs
+
 _LOCK = threading.Lock()
 
 # process-wide running totals; monotonically increasing
 TOTALS = {"dispatches": 0, "syncs": 0}
 
+# per-label histograms: label -> {"n": launches/syncs, "ns": total
+# blocked ns (syncs with a measured duration only)}. Same lock as TOTALS.
+LABELS = {"dispatch": {}, "sync": {}}
 
-def record_dispatch(n: int = 1, acct: dict = None):
+# per-thread mirror of TOTALS (each thread only ever touches its own
+# dict, so reads of ANOTHER thread's counters see, at worst, a value
+# that is one in-flight increment stale — fine for deltas taken on the
+# measuring thread itself)
+_TLS = threading.local()
+
+
+def _thread_totals() -> dict:
+    t = getattr(_TLS, "totals", None)
+    if t is None:
+        t = _TLS.totals = {"dispatches": 0, "syncs": 0}
+    return t
+
+
+def _bump_label(kind: str, label, n: int, dur_ns: int = 0):
+    h = LABELS[kind]
+    agg = h.get(label)
+    if agg is None:
+        h[label] = {"n": n, "ns": dur_ns}
+    else:
+        agg["n"] += n
+        agg["ns"] += dur_ns
+
+
+def record_dispatch(n: int = 1, acct: dict = None, label: str = None):
     """Count `n` device program launches (and mirror into a per-doc
     counter dict under the same lock — the pipeline ring's worker thread
-    and caller thread both dispatch against one document)."""
+    and caller thread both dispatch against one document). `label` names
+    the kernel for the labeled histogram + obs counters."""
     with _LOCK:
         TOTALS["dispatches"] += n
         if acct is not None:
             acct["dispatches"] += n
+        if label is not None:
+            _bump_label("dispatch", label, n)
+    _thread_totals()["dispatches"] += n
+    if obs.ENABLED and label is not None:
+        obs.counter("device", f"dispatch:{label}", n)
 
 
-def record_sync(n: int = 1, acct: dict = None):
-    """Count `n` blocking device->host syncs."""
+def record_sync(n: int = 1, acct: dict = None, label: str = None,
+                dur_ns: int = 0):
+    """Count `n` blocking device->host syncs; `dur_ns` (optional) is the
+    measured blocked time for the labeled duration histogram."""
     with _LOCK:
         TOTALS["syncs"] += n
         if acct is not None:
             acct["syncs"] += n
+        if label is not None:
+            _bump_label("sync", label, n, dur_ns)
+    _thread_totals()["syncs"] += n
+    if obs.ENABLED and label is not None:
+        obs.counter("device", f"sync:{label}", n)
 
 
 def snapshot() -> dict:
@@ -69,6 +128,19 @@ def delta_since(snap: dict) -> dict:
     return {k: cur[k] - snap.get(k, 0) for k in cur}
 
 
+def thread_snapshot() -> dict:
+    """This thread's own running totals (no lock needed: thread-local)."""
+    return dict(_thread_totals())
+
+
+def labeled_snapshot() -> dict:
+    """Copy of the per-label histograms:
+    {"dispatch": {label: {"n", "ns"}}, "sync": {...}}."""
+    with _LOCK:
+        return {k: {lbl: dict(agg) for lbl, agg in h.items()}
+                for k, h in LABELS.items()}
+
+
 class track:
     """Context manager measuring the dispatch/sync delta of a region:
 
@@ -76,18 +148,24 @@ class track:
             doc = am.change(doc, ...)
         assert t.stats["dispatches"] <= BUDGET
 
-    Process-wide (covers every document the region touched). Not
-    isolated against concurrent device work on OTHER threads — callers
-    that need isolation (the budget tests) run the region quiesced.
-    """
+    `stats` is the PROCESS-wide delta (covers every document the region
+    touched, but also any concurrent device work on other threads).
+    `thread_stats` is the delta of THIS thread's own counters — isolated
+    against concurrent threads by construction, the form the budget
+    tests assert on. For single-threaded regions the two are equal."""
 
     def __init__(self):
         self.stats: dict = {}
+        self.thread_stats: dict = {}
 
     def __enter__(self):
         self._snap = snapshot()
+        self._tsnap = thread_snapshot()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         self.stats = delta_since(self._snap)
+        tcur = thread_snapshot()
+        self.thread_stats = {k: tcur[k] - self._tsnap.get(k, 0)
+                             for k in tcur}
         return False

@@ -30,6 +30,7 @@ from typing import Optional
 import numpy as np
 
 from .._common import KIND_DEL, KIND_INC, KIND_SET
+from .. import obs
 from . import accounting
 
 import threading
@@ -197,14 +198,14 @@ class CausalDeviceDoc:
     # dispatch/sync accounting (engine/accounting.py; INTERNALS §9)
     # ------------------------------------------------------------------
 
-    def _count_dispatch(self, n: int = 1):
-        accounting.record_dispatch(n, self._acct)
+    def _count_dispatch(self, n: int = 1, label: str = None):
+        accounting.record_dispatch(n, self._acct, label=label)
         region = getattr(_ACCT_TLS, "region", None)
         if region is not None:
             region["dispatches"] += n
 
-    def _count_sync(self, n: int = 1):
-        accounting.record_sync(n, self._acct)
+    def _count_sync(self, n: int = 1, label: str = None, dur_ns: int = 0):
+        accounting.record_sync(n, self._acct, label=label, dur_ns=dur_ns)
         region = getattr(_ACCT_TLS, "region", None)
         if region is not None:
             region["syncs"] += n
@@ -436,6 +437,16 @@ class CausalDeviceDoc:
         Returns (rounds, queue_after, prior_queue). `clock`/`prior_queue`
         default to the document's live state; a chained prepare passes the
         pending base plan's post-commit snapshots instead."""
+        if obs.ENABLED:
+            _t0 = obs.now()
+            out = self._schedule_inner(batch, clock, prior_queue)
+            obs.span("plan", "admission", _t0, args={
+                "doc": self.obj_id, "n_changes": batch.n_changes,
+                "n_rounds": len(out[0]), "queued": len(out[1])})
+            return out
+        return self._schedule_inner(batch, clock, prior_queue)
+
+    def _schedule_inner(self, batch, clock=None, prior_queue=None):
         prior_queue = list(self.queue if prior_queue is None
                            else prior_queue)
         # columnar planner (default; INTERNALS §10): admission over the
@@ -944,6 +955,7 @@ class CausalDeviceDoc:
         batch's actors would reorder the interning table, this raises
         ValueError and the caller falls back to an unchained prepare."""
         from collections import ChainMap
+        _t0 = obs.now() if obs.ENABLED else 0
         chain: list = []
         if after is not None:
             if after.final_shadow is None:
@@ -1034,10 +1046,19 @@ class CausalDeviceDoc:
         # which the pipeline ring overlaps under device execution, so it
         # never appears in a commit's per-batch delta.
         import jax
-        self._count_sync()
+        _tb = obs.now() if obs.ENABLED else 0
         jax.block_until_ready(
             [x for _, _, _, p in planned_rounds if p is not None
              for x in p.staged])
+        self._count_sync(label="stage_barrier",
+                         dur_ns=(obs.now() - _tb) if _tb else 0)
+        if obs.ENABLED:
+            obs.span("plan", "prepare_batch", _t0, args={
+                "doc": self.obj_id, "n_ops": getattr(batch, "n_ops", 0),
+                "n_changes": batch.n_changes,
+                "n_rounds": len(planned_rounds),
+                "staged_bytes": staged_bytes,
+                "chained": after is not None})
         return PreparedBatch(gen=gen, rounds=planned_rounds,
                              queue_after=queue_after,
                              prior_queue=prior_queue,
@@ -1060,11 +1081,16 @@ class CausalDeviceDoc:
         prior_region = getattr(_ACCT_TLS, "region", None)
         _ACCT_TLS.region = region
         n_rounds = len(prepared.rounds)     # severed on success — read now
+        _t0 = obs.now() if obs.ENABLED else 0
         try:
             out = self._commit_prepared(prepared)
         finally:
             self._busy -= 1
             _ACCT_TLS.region = prior_region
+            if obs.ENABLED:
+                obs.span("commit", "batch", _t0, args={
+                    "doc": self.obj_id, "n_rounds": n_rounds,
+                    "gen": self._gen, **region})
         # per-committed-batch device-interaction delta: the quantity the
         # streaming tier budgets (asserted <= a small constant on the
         # write-behind path; carried in bench --pipeline records)
@@ -1308,7 +1334,7 @@ class CausalDeviceDoc:
 
         regs_in = (dev["value"], dev["has_value"], dev["win_actor"],
                    dev["win_seq"], dev["win_counter"])
-        self._count_dispatch()
+        self._count_dispatch(label="scatter_registers")
         try:
             if self.packed_residual_writeback:
                 # ONE packed h2d upload: with the packed slow_info fetch
@@ -1357,9 +1383,11 @@ class CausalDeviceDoc:
         from ..ops.ingest import pack_rows
         import jax.numpy as jnp
         dev = self._ensure_dev()
-        self._count_dispatch()          # pack_rows program
-        self._count_sync()              # the packed d2h fetch
+        self._count_dispatch(label="pack_rows")
+        _tf = obs.now() if obs.ENABLED else 0
         packed = np.asarray(pack_rows(*(dev[k] for k in keys)))
+        self._count_sync(label="mirror_fetch",       # the packed d2h fetch
+                         dur_ns=(obs.now() - _tf) if _tf else 0)
         out = {}
         for i, k in enumerate(keys):
             row = packed[i]

@@ -77,7 +77,7 @@ class DeviceMapDoc(CausalDeviceDoc):
         import jax.numpy as jnp
         from ..ops.ingest import remap_ranks
         dev = self._ensure_dev()
-        self._count_dispatch()
+        self._count_dispatch(label="remap_ranks")
         dev["win_actor"] = remap_ranks(dev["win_actor"], jnp.asarray(remap))
 
     def _intern_keys(self, keys) -> np.ndarray:
@@ -123,7 +123,7 @@ class DeviceMapDoc(CausalDeviceDoc):
         if self.conflicts:
             conflict_slots[: len(self.conflicts)] = list(self.conflicts)
 
-        self._count_dispatch()
+        self._count_dispatch(label="apply_map_round")
         (value_n, has_n, wa_n, ws_n, wc_n, slow_info) = apply_map_round(
             dev["value"], dev["has_value"], dev["win_actor"],
             dev["win_seq"], dev["win_counter"],
@@ -138,8 +138,11 @@ class DeviceMapDoc(CausalDeviceDoc):
         self._host = None
 
         # one packed transfer: slow mask + slots + register state
-        self._count_sync()
+        from .. import obs
+        _ts = obs.now() if obs.ENABLED else 0
         info = np.asarray(slow_info)[:, :n_ops]
+        self._count_sync(label="slow_info_fetch",
+                         dur_ns=(obs.now() - _ts) if _ts else 0)
         if info[0].any():
             idxs = np.nonzero(info[0])[0]
             self._apply_slow(

@@ -56,6 +56,8 @@ import threading
 
 import numpy as np
 
+from .. import obs
+
 _POOL = None
 _POOL_LOCK = threading.Lock()
 
@@ -265,12 +267,15 @@ class PipelinedIngestor:
         if self._n_fed <= 0:
             raise RuntimeError("commit_next with no batch fed")
         self._n_fed -= 1
-        batch, plan, err = self._out.get()
+        k, batch, plan, err = self._out.get()
+        _t0 = obs.now() if obs.ENABLED else 0
+        serial = fallback = False
         try:
             if err is not None:
                 raise PipelineError(
                     "background prepare failed") from err
             if plan is _SERIAL:
+                serial = True
                 with self._cv:
                     self._serial += 1
                 with self._prep_lock:
@@ -283,6 +288,10 @@ class PipelinedIngestor:
                 # (the documented degraded path, never silent corruption).
                 # Bump the fallback epoch so the worker abandons the now-
                 # dead chain base instead of chaining onto it forever.
+                fallback = True
+                if obs.ENABLED:
+                    obs.event("ring", "fallback",
+                              args={"doc": self.doc.obj_id, "slot": k})
                 with self._cv:
                     self._fallbacks += 1
                 with self._prep_lock:
@@ -293,16 +302,21 @@ class PipelinedIngestor:
                 self._n_committed += 1
                 self._cv.notify_all()
             self._slots.release()
+            if obs.ENABLED:
+                obs.span("ring", "commit", _t0, args={
+                    "doc": self.doc.obj_id, "slot": k,
+                    "gen": self.doc._gen, "serial": serial,
+                    "fallback": fallback})
         # reached on successful commits only: fold the committed batch's
         # device-interaction delta into the public budget surface
         st = getattr(self.doc, "last_commit_stats", None)
         if st:
             with self._cv:
                 b = self._budget
-                for k in ("dispatches", "syncs"):
-                    b[k + "_max"] = max(b[k + "_max"], st[k])
-                    b[k + "_min"] = (st[k] if b[k + "_min"] is None
-                                     else min(b[k + "_min"], st[k]))
+                for key in ("dispatches", "syncs"):
+                    b[key + "_max"] = max(b[key + "_max"], st[key])
+                    b[key + "_min"] = (st[key] if b[key + "_min"] is None
+                                       else min(b[key + "_min"], st[key]))
 
     def flush(self):
         """Commit every batch still in flight; returns the document."""
@@ -350,11 +364,19 @@ class PipelinedIngestor:
                             or self._closing)
                     if self._closing and self._n_committed < k:
                         # abandoned session: hand the batch back serial
-                        self._out.put((batch, _SERIAL, None))
+                        if obs.ENABLED:
+                            obs.event("ring", "abort", args={
+                                "doc": self.doc.obj_id, "slot": k})
+                        self._out.put((k, batch, _SERIAL, None))
                         continue
                 try:
+                    _t0 = obs.now() if obs.ENABLED else 0
                     with self._prep_lock:
                         plan = self.doc.prepare_batch(batch, after=base)
+                    if obs.ENABLED:
+                        obs.span("ring", "plan", _t0, args={
+                            "doc": self.doc.obj_id, "slot": k,
+                            "chained": base is not None})
                     if base is not None:
                         with self._cv:
                             self._chained += 1
@@ -363,8 +385,11 @@ class PipelinedIngestor:
                     # the caller prepares this one inline after the
                     # preceding commit lands
                     plan = _SERIAL
+                    if obs.ENABLED:
+                        obs.event("ring", "serial", args={
+                            "doc": self.doc.obj_id, "slot": k})
             except BaseException as e:   # pragma: no cover - defensive
                 err = e
                 plan = None
-            self._out.put((batch, plan, err))
+            self._out.put((k, batch, plan, err))
             base = plan if plan not in (None, _SERIAL) else None

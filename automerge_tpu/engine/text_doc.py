@@ -43,6 +43,7 @@ import numpy as np
 import logging
 
 from .._common import HEAD_PARENT, KIND_SET, make_elem_id
+from .. import obs
 from .base import CausalDeviceDoc
 from .columnar import TextChangeBatch
 from .pipeline import stage_h2d
@@ -217,7 +218,7 @@ class DeviceTextDoc(CausalDeviceDoc):
         import jax.numpy as jnp
         from ..ops.ingest import remap_actors
         dev = self._ensure_dev()
-        self._count_dispatch()
+        self._count_dispatch(label="remap_actors")
         actor_n, wa_n = remap_actors(
             dev["actor"], dev["win_actor"], jnp.asarray(remap),
             np.int32(self.n_elems))
@@ -671,7 +672,7 @@ class DeviceTextDoc(CausalDeviceDoc):
                         seg_bound=S, n_elems=plan.n_elems_after,
                         cap=out_cap,
                         ascii_=self.all_ascii and not plan.ascii_clear)
-                    self._count_dispatch()
+                    self._count_dispatch(label="merge_materialize_planned")
                     out = fn(*tables, plan.desc, plan.blob,
                              plan.seg_plan, out_cap=out_cap, S=S,
                              as_u8=as_u8, L=L)
@@ -682,7 +683,7 @@ class DeviceTextDoc(CausalDeviceDoc):
                         seg_bound=self._seg_bound + plan.seg_inc,
                         n_elems=plan.n_elems_after, cap=out_cap,
                         ascii_=self.all_ascii and not plan.ascii_clear)
-                    self._count_dispatch()
+                    self._count_dispatch(label="merge_materialize_dense")
                     out = fn(*tables, plan.desc, plan.blob,
                              out_cap=out_cap, S=S, as_u8=as_u8, L=L)
                 tables = out[:9]
@@ -712,7 +713,7 @@ class DeviceTextDoc(CausalDeviceDoc):
                 dummy = K._dummy_i32()
                 fn = (K.apply_mixed_round_donated if donate
                       else K.apply_mixed_round)
-                self._count_dispatch()
+                self._count_dispatch(label="apply_mixed_round")
                 out = fn(*tables,
                          plan.desc if plan.desc is not None else dummy,
                          plan.blob if plan.blob is not None else dummy,
@@ -725,8 +726,11 @@ class DeviceTextDoc(CausalDeviceDoc):
                 if with_res:
                     # the ONE d2h round trip of the residual path: slow
                     # mask + slots + register state, one packed transfer
-                    self._count_sync()
+                    _ts = obs.now() if obs.ENABLED else 0
                     slow_info_np = np.asarray(out[9])[:, : plan.n_res]
+                    self._count_sync(label="slow_info_fetch",
+                                     dur_ns=(obs.now() - _ts) if _ts
+                                     else 0)
         except BaseException:
             # poison ONLY when a donated kernel actually consumed the live
             # tables (a trace/compile failure consumes nothing and stays
@@ -826,7 +830,7 @@ class DeviceTextDoc(CausalDeviceDoc):
             n = self._n_elems_dev[1]
         else:
             n = np.int32(self.n_elems)
-        self._count_dispatch()          # one materialize program
+        self._count_dispatch(label="materialize")  # one materialize program
         if (self.prefer_planned and self.seg_mirror is not None
                 and self.seg_mirror.n_segs + 2 <= S):
             # host-planned structure: device skips the structural S-stage
@@ -852,7 +856,7 @@ class DeviceTextDoc(CausalDeviceDoc):
                 self._materialize(with_pos=False)
             heals = 0
             while True:
-                self._count_sync()      # the read path's one device sync
+                self._count_sync(label="scalars_fetch")  # the read path's one device sync
                 scalars = np.asarray(self._mat[-1])
                 n_segs = int(scalars[1])
                 if len(scalars) == 5:
@@ -916,7 +920,7 @@ class DeviceTextDoc(CausalDeviceDoc):
             elif self.use_condensed:
                 self._materialize(with_pos=True)
                 self._scalars()  # verify the S bucket fit (re-runs if not)
-                self._count_sync()
+                self._count_sync(label="positions_fetch")
                 self._pos_cache = np.asarray(
                     self._mat[0])[: self.n_elems + 1]
             else:
@@ -939,8 +943,8 @@ class DeviceTextDoc(CausalDeviceDoc):
 
         valid = np.zeros(cap, bool)
         valid[:n] = True
-        self._count_dispatch()
-        self._count_sync()
+        self._count_dispatch(label="rga_linearize")
+        self._count_sync(label="rga_linearize")
         pos = rga_linearize(jnp.asarray(padded(h["parent"])),
                             jnp.asarray(padded(h["ctr"])),
                             jnp.asarray(padded(h["actor"])),
@@ -960,6 +964,17 @@ class DeviceTextDoc(CausalDeviceDoc):
         return inv[h["has_value"][inv]]
 
     def text(self) -> str:
+        if not obs.ENABLED:
+            return self._text_pull()
+        _t0 = obs.now()
+        out = self._text_pull()
+        # span args carry the pull mode + byte counts the incremental
+        # tier reports (pull_stats) — the d2h story per pull, in-trace
+        obs.span("pull", "text", _t0,
+                 args={"doc": self.obj_id, **(self.pull_stats or {})})
+        return out
+
+    def _text_pull(self) -> str:
         if self.n_elems == 0:
             self.pull_stats = {"mode": "empty", "span_bytes": 0,
                                "n_spans": 0}
@@ -977,7 +992,7 @@ class DeviceTextDoc(CausalDeviceDoc):
                     return out
             self._materialize(with_pos=False)
             n_vis = int(self._scalars()[0])   # may re-run w/ bigger S
-            self._count_sync()                # the O(doc) codes pull
+            self._count_sync(label="codes_pull")      # the O(doc) codes pull
             values = np.asarray(self._mat[-2])[:n_vis]
             self.pull_stats = {"mode": "full",
                                "span_bytes": int(values.nbytes),
@@ -1032,8 +1047,8 @@ class DeviceTextDoc(CausalDeviceDoc):
             n = self._n_elems_dev[1]
         else:
             n = np.int32(self.n_elems)
-        self._count_dispatch()
-        self._count_sync()
+        self._count_dispatch(label="segment_visible_counts")
+        self._count_sync(label="segment_visible_counts")
         return np.asarray(segment_visible_counts(
             dev["has_value"], n, segplan_dev, S=S, L=L))
 
@@ -1163,8 +1178,8 @@ class DeviceTextDoc(CausalDeviceDoc):
             spans_np = np.zeros((2, Db), np.int32)
             spans_np[0, :n_spans] = span_starts
             spans_np[1, :n_spans] = span_lens
-            self._count_dispatch()
-            self._count_sync()
+            self._count_dispatch(label="gather_spans")
+            self._count_sync(label="gather_spans")
             buf = np.asarray(gather_spans(codes, jnp.asarray(spans_np),
                                           P=P))[:total]
             pulled = buf.tobytes().decode("ascii")

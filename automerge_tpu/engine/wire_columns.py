@@ -230,6 +230,8 @@ def decode_text_changes_columnar(data, obj_id: str):
     are built eagerly: the caller hands the engine a batch whose first
     `prepare_batch` is already fully columnar."""
     from .columnar import TextChangeBatch
+    from .. import obs
+    _t0 = obs.now() if obs.ENABLED else 0
     if isinstance(data, (str, bytes)):
         batch = TextChangeBatch.from_json(data, obj_id)
         bulk = batch.n_ops >= _NUMPY_MIN_OPS
@@ -247,6 +249,10 @@ def decode_text_changes_columnar(data, obj_id: str):
     # and the scheduler applies the same gate (base._schedule_columnar)
     if bulk:
         change_columns(batch)
+    if obs.ENABLED:
+        obs.span("plan", "decode", _t0, args={
+            "obj": obj_id, "n_changes": batch.n_changes,
+            "n_ops": batch.n_ops, "bulk": bulk})
     return batch
 
 

@@ -1,0 +1,207 @@
+"""Unified tracing & metrics tier (INTERNALS §11).
+
+One structured observability surface threaded through every hot layer —
+host planning, the pipeline ring, device dispatch accounting, the
+resilience tier, and the checkpoint writer — replacing nothing: the
+existing stats dicts (`doc.dispatch_stats`, `PipelinedIngestor.stats`,
+`ResilientChannel.stats`, ...) keep their shapes and are FED by the same
+instrumentation points that emit here.
+
+Contract for instrumented call sites (the hot-path discipline):
+
+    from automerge_tpu import obs
+    ...
+    t0 = obs.now() if obs.ENABLED else 0
+    ... the work ...
+    if obs.ENABLED:
+        obs.span("plan", "prepare_batch", t0,
+                 args={"doc": self.obj_id, "n_ops": batch.n_ops})
+
+``obs.ENABLED`` is a module attribute: when tracing is off, the whole
+emit path is ONE module-dict lookup and a falsy branch — no call, no
+allocation, no lock (the overhead bound is asserted in
+tests/test_obs.py). Everything behind the flag goes to a bounded,
+lock-striped ring-buffer flight recorder (`obs.recorder.FlightRecorder`)
+whose newest records always survive and whose counters are exact across
+wraparound.
+
+Enable via ``AMTPU_TRACE=1`` in the environment, `obs.enable()`, or the
+scoped ``with obs.tracing(): ...``. Export with `obs.write_trace(path)`
+(Chrome trace-event JSON — load at https://ui.perfetto.dev) and read
+aggregates with `obs.metrics_snapshot()`.
+
+Category taxonomy (full schema in docs/INTERNALS.md §11):
+
+  plan    host planning: prepare_batch / admission / wire decode
+  commit  commit_prepared (args carry n_rounds + dispatch/sync delta)
+  device  dispatch/sync accounting (labeled kernel counters), waits
+  ring    PipelinedIngestor slot lifecycle (plan/commit spans,
+          fallback/serial/abort events, gen + slot tags)
+  pull    text materialization pulls (mode + byte counts)
+  chan    ResilientChannel (retransmit / dup_drop / window_drop ...)
+  chaos   ChaosLink fault injections (drop / dup / reorder / delay ...)
+  quar    quarantine admits / evictions / releases
+  ckpt    checkpoint writer (grab spans, conflicts, degrades)
+  bench   harness-side regions (stream reps, explicit device waits)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from .recorder import (  # noqa: F401  (re-exported for consumers/tests)
+    ARGS, CAT, DUR, EVENT_DUR, NAME, TID, TS, FlightRecorder,
+    span_seconds, span_totals,
+)
+
+#: THE fast-path gate. Instrumented call sites read this module attribute
+#: directly (`if obs.ENABLED:`) so a disabled process pays one dict
+#: lookup per site and nothing else. Mutated only by enable()/disable().
+ENABLED = False
+
+_recorder: Optional[FlightRecorder] = None
+
+now = time.perf_counter_ns   # monotonic ns — the span clock
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def recorder() -> Optional[FlightRecorder]:
+    """The live FlightRecorder (None when tracing never enabled)."""
+    return _recorder
+
+
+def enable(capacity: Optional[int] = None) -> FlightRecorder:
+    """Turn tracing on (idempotent). A recorder is created on first
+    enable and retained across disable() so late readers can still
+    export; pass `capacity` (records per stripe) to size a fresh one."""
+    global ENABLED, _recorder
+    if _recorder is None or capacity is not None:
+        _recorder = FlightRecorder(capacity)
+    ENABLED = True
+    return _recorder
+
+
+def disable():
+    global ENABLED
+    ENABLED = False
+
+
+@contextmanager
+def tracing(capacity: Optional[int] = None):
+    """Scoped enable: tracing on inside the block, restored (not force-
+    disabled) on exit — nesting under a process-wide AMTPU_TRACE=1 keeps
+    the outer session running. Yields the recorder."""
+    was = ENABLED
+    rec = enable(capacity)
+    try:
+        yield rec
+    finally:
+        if not was:
+            disable()
+
+
+# ---------------------------------------------------------------------------
+# emit side — call ONLY behind an `if obs.ENABLED:` check
+# ---------------------------------------------------------------------------
+
+
+def span(cat: str, name: str, t0_ns: int, args: Optional[dict] = None,
+         t1_ns: Optional[int] = None):
+    """Record a completed span started at `t0_ns` (from `obs.now()`).
+    A zero `t0_ns` (tracing was off when the region started) is dropped —
+    a half-observed region must not fabricate a duration."""
+    rec = _recorder
+    if rec is None or not t0_ns:
+        return
+    end = t1_ns if t1_ns is not None else time.perf_counter_ns()
+    rec.emit((t0_ns, max(0, end - t0_ns), cat, name,
+              threading.get_ident(), args))
+
+
+def event(cat: str, name: str, args: Optional[dict] = None, n: int = 1):
+    """Record an instant event AND bump its wrap-proof counter."""
+    rec = _recorder
+    if rec is None:
+        return
+    rec.emit((time.perf_counter_ns(), EVENT_DUR, cat, name,
+              threading.get_ident(), args))
+    rec.bump((cat, name), n)
+
+
+def counter(cat: str, name: str, n: int = 1):
+    """Bump a counter without a ring record (per-dispatch call sites:
+    exact totals, no ring pressure)."""
+    rec = _recorder
+    if rec is not None:
+        rec.bump((cat, name), n)
+
+
+@contextmanager
+def span_ctx(cat: str, name: str, args: Optional[dict] = None):
+    """Span context manager for NON-hot call sites (bench, soak, tests).
+    Hot paths use the explicit now()/span() pair behind the flag."""
+    t0 = now() if ENABLED else 0
+    try:
+        yield
+    finally:
+        if ENABLED and t0:
+            span(cat, name, t0, args)
+
+
+# ---------------------------------------------------------------------------
+# read side
+# ---------------------------------------------------------------------------
+
+
+def snapshot(since_ns: int = 0) -> list:
+    """All retained records (see recorder.snapshot); [] when never
+    enabled."""
+    return [] if _recorder is None else _recorder.snapshot(since_ns)
+
+
+def metrics_snapshot(since_ns: int = 0) -> dict:
+    """Aggregate view of the session: exact counters (wrap-proof) plus
+    per-(cat, name) span histograms from the retained ring records.
+
+        {"counters": {"chaos.drop": 12, ...},
+         "spans": {"plan.prepare_batch": {"count", "total_ns",
+                                          "min_ns", "max_ns"}, ...},
+         "emitted": <total records ever>, "retained": <in ring now>}
+    """
+    if _recorder is None:
+        return {"counters": {}, "spans": {}, "emitted": 0, "retained": 0}
+    records = _recorder.snapshot(since_ns)
+    return {
+        "counters": {f"{c}.{n}": v
+                     for (c, n), v in sorted(_recorder.counters().items())},
+        "spans": {f"{c}.{n}": agg
+                  for (c, n), agg in sorted(span_totals(records).items())},
+        "emitted": _recorder.n_emitted,
+        "retained": _recorder.n_retained,
+    }
+
+
+def clear():
+    if _recorder is not None:
+        _recorder.clear()
+
+
+def write_trace(path: str, since_ns: int = 0) -> str:
+    """Dump the retained records as Chrome trace-event JSON (Perfetto-
+    loadable); returns `path`. See obs/export.py for the schema."""
+    from .export import write_trace as _write
+    return _write(path, snapshot(since_ns),
+                  t0_ns=None if _recorder is None else _recorder.t0_ns)
+
+
+# honor AMTPU_TRACE=1 at import: `AMTPU_TRACE=1 python bench.py --trace`
+# needs no code path to remember to call enable() before the first span
+if os.environ.get("AMTPU_TRACE", "0") not in ("", "0"):
+    enable()

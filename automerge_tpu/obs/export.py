@@ -1,0 +1,129 @@
+"""Chrome trace-event JSON export (Perfetto-loadable) + schema validator.
+
+The flight recorder's tuples map onto the Trace Event Format's complete
+("X") and instant ("i") events:
+
+- span  (dur >= 0) -> {"ph": "X", "name", "cat", "ts", "dur", "pid",
+                       "tid", "args"}
+- event (dur == -1)-> {"ph": "i", "name", "cat", "ts", "s": "t", ...}
+
+Timestamps are microseconds relative to the recorder's session origin, so
+a trace opens at t=0 in https://ui.perfetto.dev regardless of process
+uptime. Thread names ride along as metadata ("M") events when known.
+
+`validate_chrome_trace` is the ONE schema check shared by
+tests/test_obs.py and the CI trace-smoke step: every span must carry
+category/ts/dur, the trace must be non-empty, and (when the trace came
+from `bench.py --trace`) every pipeline-ring span must nest inside a
+`bench/stream` span on the timeline — the structural guarantee that ring
+work is attributable to its stream.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from .recorder import ARGS, CAT, DUR, NAME, TID, TS
+
+
+def to_chrome_trace(records, t0_ns: Optional[int] = None,
+                    pid: int = 1) -> dict:
+    """Records -> Chrome trace-event JSON object."""
+    if t0_ns is None:
+        t0_ns = min((r[TS] for r in records), default=0)
+    events = []
+    tids = set()
+    for r in records:
+        ts_us = (r[TS] - t0_ns) / 1000.0
+        tids.add(r[TID])
+        ev = {"name": r[NAME], "cat": r[CAT], "ts": ts_us,
+              "pid": pid, "tid": r[TID]}
+        if r[ARGS]:
+            ev["args"] = dict(r[ARGS])
+        if r[DUR] >= 0:
+            ev["ph"] = "X"
+            ev["dur"] = r[DUR] / 1000.0
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        events.append(ev)
+    meta = [{"ph": "M", "name": "process_name", "pid": pid, "ts": 0,
+             "args": {"name": "automerge_tpu"}}]
+    meta += [{"ph": "M", "name": "thread_name", "pid": pid, "tid": t,
+              "ts": 0, "args": {"name": f"thread-{t}"}}
+             for t in sorted(tids)]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def write_trace(path: str, records, t0_ns: Optional[int] = None) -> str:
+    with open(path, "w") as fh:
+        json.dump(to_chrome_trace(records, t0_ns), fh)
+    return path
+
+
+class TraceValidationError(ValueError):
+    """The emitted trace JSON violates the INTERNALS §11 schema."""
+
+
+def validate_chrome_trace(obj, require_stream_nesting: bool = False
+                          ) -> dict:
+    """Validate a trace JSON object (or a path to one). Raises
+    :class:`TraceValidationError`; returns summary counts on success.
+
+    Checks (the CI smoke's contract, ISSUE 6):
+    - the trace holds at least one non-metadata event (an empty trace
+      FAILS — a --trace run that recorded nothing is a wiring bug);
+    - every "X" span carries name/cat/ts/dur with dur >= 0;
+    - every "i" instant carries name/cat/ts;
+    - with `require_stream_nesting` (bench traces): every `ring`-category
+      span's [ts, ts+dur] interval lies inside some `bench`/`stream`
+      span's interval (thread-agnostic containment — the ring's worker
+      thread is a different tid by design).
+    """
+    if isinstance(obj, (str, bytes)):
+        with open(obj) as fh:
+            obj = json.load(fh)
+    if not isinstance(obj, dict) or not isinstance(
+            obj.get("traceEvents"), list):
+        raise TraceValidationError("trace must be an object with a "
+                                   "traceEvents list")
+    spans, instants, streams, rings = [], [], [], []
+    for ev in obj["traceEvents"]:
+        ph = ev.get("ph")
+        if ph == "M":
+            continue
+        for fld in ("name", "cat", "ts"):
+            if fld not in ev:
+                raise TraceValidationError(
+                    f"event missing `{fld}`: {ev!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                raise TraceValidationError(
+                    f"span without a valid `dur`: {ev!r}")
+            spans.append(ev)
+            if ev["cat"] == "bench" and ev["name"] == "stream":
+                streams.append((ev["ts"], ev["ts"] + dur))
+            elif ev["cat"] == "ring":
+                rings.append(ev)
+        elif ph == "i":
+            instants.append(ev)
+        else:
+            raise TraceValidationError(f"unsupported phase {ph!r}: {ev!r}")
+    if not spans and not instants:
+        raise TraceValidationError("empty trace: no spans or events "
+                                   "recorded")
+    if require_stream_nesting:
+        if not streams:
+            raise TraceValidationError("no bench/stream spans to nest "
+                                       "ring spans under")
+        # microsecond float rounding at the edges gets a 1 us grace
+        for ev in rings:
+            lo, hi = ev["ts"], ev["ts"] + ev["dur"]
+            if not any(a - 1 <= lo and hi <= b + 1 for a, b in streams):
+                raise TraceValidationError(
+                    "ring span does not nest inside any bench/stream "
+                    f"span: {ev!r}")
+    return {"n_spans": len(spans), "n_events": len(instants),
+            "n_streams": len(streams), "n_ring_spans": len(rings)}

@@ -29,6 +29,7 @@ import operator
 
 import numpy as np
 
+from .. import obs
 from .errors import ProtocolError
 
 ENVELOPE_KINDS = ("data", "ack")
@@ -113,6 +114,9 @@ class ResilientChannel:
             jitter = int(self._rng.integers(0, max(2, entry["rto"] // 2)))
             entry["due"] = self._round + entry["rto"] + jitter
             self.stats["retransmits"] += 1
+            if obs.ENABLED:
+                obs.event("chan", "retransmit",
+                          args={"seq": seq, "rto": entry["rto"]})
             self._send_raw({"kind": "data", "seq": seq,
                             "ack": self._recv_high,
                             "payload": entry["payload"]})
@@ -131,10 +135,14 @@ class ResilientChannel:
         seq = env["seq"]
         if seq <= self._recv_high or seq in self._recv_buf:
             self.stats["dup_dropped"] += 1
+            if obs.ENABLED:
+                obs.event("chan", "dup_drop", args={"seq": seq})
         elif seq > self._recv_high + self._recv_window:
             # beyond the reorder window: drop UN-acked (the bounded-memory
             # guarantee; a real sender retransmits once the window opens)
             self.stats["window_dropped"] += 1
+            if obs.ENABLED:
+                obs.event("chan", "window_drop", args={"seq": seq})
             return
         else:
             self._recv_buf[seq] = env["payload"]
@@ -158,6 +166,9 @@ class ResilientChannel:
                 if deliver_err is None:
                     deliver_err = exc
                 self.stats["deliver_errors"] += 1
+                if obs.ENABLED:
+                    obs.event("chan", "deliver_error",
+                              args={"seq": self._recv_high})
         self.stats["acks_sent"] += 1
         self._send_raw({"kind": "ack", "seq": 0, "ack": self._recv_high})
         if deliver_err is not None:

@@ -33,6 +33,8 @@ import json
 
 import numpy as np
 
+from .. import obs
+
 
 class ChaosLink:
     def __init__(self, deliver, *, seed: int = 0, rng=None,
@@ -59,6 +61,9 @@ class ChaosLink:
         """Sever the link: in-flight frames die, new sends are dropped."""
         self.partitioned = True
         self.stats["partition_dropped"] += len(self._queue)
+        if obs.ENABLED:
+            obs.event("chaos", "partition",
+                      args={"in_flight_dropped": len(self._queue)})
         self._queue.clear()
 
     def heal(self):
@@ -71,26 +76,37 @@ class ChaosLink:
         wire = json.dumps(msg) if self.codec else msg
         if self.partitioned:
             self.stats["partition_dropped"] += 1
+            if obs.ENABLED:
+                obs.event("chaos", "partition_drop")
             return
         if self.drop and self._rng.random() < self.drop:
             self.stats["dropped"] += 1
+            if obs.ENABLED:
+                obs.event("chaos", "drop")
             return
         copies = 1
         if self.dup and self._rng.random() < self.dup:
             copies = 2
             self.stats["duplicated"] += 1
+            if obs.ENABLED:
+                obs.event("chaos", "dup")
         for _ in range(copies):
             payload = json.loads(wire) if self.codec else msg
             due = self._round
             if self.delay and self._rng.random() < self.delay:
                 due += int(self._rng.integers(1, self.max_delay + 1))
                 self.stats["delayed"] += 1
+                if obs.ENABLED:
+                    obs.event("chaos", "delay",
+                              args={"rounds": due - self._round})
             entry = [due, payload]
             if self.reorder and self._queue \
                     and self._rng.random() < self.reorder:
                 at = int(self._rng.integers(0, len(self._queue)))
                 self._queue.insert(at, entry)
                 self.stats["reordered"] += 1
+                if obs.ENABLED:
+                    obs.event("chaos", "reorder")
             else:
                 self._queue.append(entry)
 

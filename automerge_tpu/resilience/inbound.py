@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 
+from .. import obs
 from .errors import ProtocolError
 from .quarantine import DEFAULT_CAPACITY, QuarantineQueue
 from .validation import prevalidated, validate_changes
@@ -249,6 +250,9 @@ class InboundGate:
                            if (c["actor"], c["seq"]) in drained_keys)
             if released:
                 q.stats["released"] += released
+                if obs.ENABLED:
+                    obs.event("quar", "release", args={"n": released},
+                              n=released)
         self.stats["delivered"] += len(ready)
         return doc, len(ready)
 

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
+from .. import obs
+
 #: Default per-document bound, sized for real reordering windows (a few
 #: hundred in-flight changes on a lossy multi-path mesh). DocIds are
 #: peer-chosen, so this alone is not the hostile-peer memory bound — the
@@ -54,9 +56,14 @@ class QuarantineQueue:
         if len(self._items) >= self.capacity:
             _, evicted = self._items.popitem(last=False)
             self.stats["evicted"] += 1
+            if obs.ENABLED:
+                obs.event("quar", "evict", args={"reason": "capacity"})
         self._items[key] = change
         if not requeue:
             self.stats["parked"] += 1
+            if obs.ENABLED:
+                obs.event("quar", "park",
+                          args={"actor": key[0], "seq": key[1]})
         if len(self._items) > self.stats["peak"]:
             self.stats["peak"] = len(self._items)
         return evicted
@@ -68,6 +75,8 @@ class QuarantineQueue:
             return None
         _, evicted = self._items.popitem(last=False)
         self.stats["evicted"] += 1
+        if obs.ENABLED:
+            obs.event("quar", "evict", args={"reason": "aggregate"})
         return evicted
 
     def drain(self) -> list:

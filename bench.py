@@ -18,7 +18,14 @@ Modes:
   median-of-N full streams with spread, assert the per-batch
   dispatch/sync budget, and machine-check the on-chip >=100M floor
   (`floor_met`; a miss records the dominating term, it is never
-  laundered into a best-of). ``--quick`` shrinks shapes for CI.
+  laundered into a best-of). ``--quick`` shrinks shapes for CI (and,
+  without ``--pipeline``, routes to this mode).
+- ``--trace``    — record the run in the obs flight recorder
+  (INTERNALS §11) and dump Perfetto-loadable Chrome trace JSON to
+  ``bench_trace.json`` (AMTPU_TRACE_OUT overrides); equivalent to
+  running under ``AMTPU_TRACE=1``. Serial-profile terms (`prepare_s`,
+  `commit_s`, `device_wait_s`, `text_pull_s`) are ALWAYS derived from
+  recorded spans — the flag only controls the export.
 
 Every live on-chip headline run appends its full JSON to the committed
 session log (BENCH_SESSIONS.jsonl); `maybe_refresh_last_good` refuses to
@@ -43,6 +50,7 @@ jax.config.update("jax_compilation_cache_dir",
                   os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
+from automerge_tpu import obs  # noqa: E402
 from automerge_tpu.engine import DeviceTextDoc, TextChangeBatch  # noqa: E402
 from automerge_tpu.engine.columnar import HEAD_PARENT, KIND_INS, KIND_SET
 
@@ -196,8 +204,9 @@ def run_overlapped(halves, expect_vis, *, obj_id="bench-text",
                 import jax
                 jax.block_until_ready(list(doc._dev.values()))
     else:
-        with PipelinedIngestor(doc) as pipe:
-            pipe.run(halves)
+        with obs.span_ctx("bench", "stream", args={"mode": "overlapped"}):
+            with PipelinedIngestor(doc) as pipe:
+                pipe.run(halves)
     doc._materialize(with_pos=False)
     scal = doc._scalars()
     dt = time.perf_counter() - t0
@@ -325,20 +334,30 @@ def run_once(batch):
     doc.eager_materialize = True   # merge + materialize as ONE program
     doc.apply_batch(base_batch("bench-text", BASE_LEN))
     doc.text()
-    t0 = time.perf_counter()
-    prepared = doc.prepare_batch(batch)      # host plan + h2d (transfers
-    prepare_s = time.perf_counter() - t0     # complete: prepare barriers)
-    t0 = time.perf_counter()
-    doc.commit_prepared(prepared)
-    doc._materialize(with_pos=False)         # dispatch; codes stay on device
-    scal = doc._scalars()                    # the one device sync
-    elapsed = time.perf_counter() - t0
-    n_vis = int(scal[0])
-    assert n_vis == BASE_LEN + N_ACTORS * (OPS_PER_CHANGE // 2)
-    t0 = time.perf_counter()
-    text = doc.text()                        # host pull + decode (timed
-    pull_s = time.perf_counter() - t0        # separately; the incremental
-    assert len(text) == n_vis                # path ships O(edits) bytes)
+    # prepare_s / text_pull_s are DERIVED FROM RECORDED SPANS (obs,
+    # INTERNALS §11): the term can only ever be the engine's own
+    # prepare_batch / text() span durations — a schedule change that
+    # moves work between phases moves the spans with it, so the PR-5
+    # class of misattribution (async device time booked to prepare_s)
+    # is structurally impossible. The timed-region `elapsed` stays a
+    # wall clock by definition.
+    with obs.tracing():
+        t_rec = obs.now()
+        prepared = doc.prepare_batch(batch)  # host plan + h2d (transfers
+        #                                      complete: prepare barriers)
+        t0 = time.perf_counter()
+        doc.commit_prepared(prepared)
+        doc._materialize(with_pos=False)     # dispatch; codes stay on device
+        scal = doc._scalars()                # the one device sync
+        elapsed = time.perf_counter() - t0
+        n_vis = int(scal[0])
+        assert n_vis == BASE_LEN + N_ACTORS * (OPS_PER_CHANGE // 2)
+        text = doc.text()                    # host pull + decode (its own
+        #                                      span; the incremental path
+        assert len(text) == n_vis            # ships O(edits) bytes)
+        recs = obs.snapshot(since_ns=t_rec)
+    prepare_s = obs.span_seconds(recs, "plan", "prepare_batch")
+    pull_s = obs.span_seconds(recs, "pull", "text")
     pull = dict(doc.pull_stats or {})
     return elapsed, prepare_s, prepared.n_staged_bytes, pull_s, pull
 
@@ -551,19 +570,23 @@ def measure_pipeline(n_batches: int = 6, n_actors: int = 2_000,
     total_ops = sum(b.n_ops for b in batches)
     expect_vis = base_n + n_batches * n_actors * (ops_per_change // 2)
 
-    def stream():
+    def stream(rep: int = -1):
         """One full stream; returns (dt, ring stats incl. the public
-        per-commit budget surface)."""
+        per-commit budget surface). The whole ring region runs inside a
+        `bench/stream` span (rep-tagged) when tracing is on, so every
+        ring.plan/ring.commit span nests under its stream in the
+        exported trace — the containment the CI trace smoke validates."""
         doc = DeviceTextDoc("pipe-text")
         doc.eager_materialize = True
         doc.apply_batch(base_batch("pipe-text", base_n))
         doc.text()
         t0 = time.perf_counter()
-        with PipelinedIngestor(doc, slots=depth, donate=True) as pipe:
-            pipe.run(batches)
-            ring = pipe.stats
-        doc._materialize(with_pos=False)
-        scal = doc._scalars()
+        with obs.span_ctx("bench", "stream", args={"rep": rep}):
+            with PipelinedIngestor(doc, slots=depth, donate=True) as pipe:
+                pipe.run(batches)
+                ring = pipe.stats
+            doc._materialize(with_pos=False)
+            scal = doc._scalars()
         dt = time.perf_counter() - t0
         assert int(scal[0]) == expect_vis, (int(scal[0]), expect_vis)
         return dt, ring
@@ -587,29 +610,45 @@ def measure_pipeline(n_batches: int = 6, n_actors: int = 2_000,
         doc.eager_materialize = True
         doc.apply_batch(base_batch("pipe-text", base_n))
         doc.text()
-        prep_s = commit_s = wait_s = 0.0
-        for b in batches:
-            t0 = time.perf_counter()
-            plan = doc.prepare_batch(b)
-            prep_s += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            doc.commit_prepared(plan)
-            commit_s += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            _jax.block_until_ready(list(doc._dev.values()))
-            wait_s += time.perf_counter() - t0
-        t0 = time.perf_counter()
-        doc._materialize(with_pos=False)
-        scal = doc._scalars()
-        sync_s = time.perf_counter() - t0
+        # every term is DERIVED FROM RECORDED SPANS (obs, INTERNALS
+        # §11): prepare_s can only be the engine's own prepare_batch
+        # spans, commit_s only commit_prepared's, and the explicit
+        # completion barrier is its own `device/wait` span — the PR-7
+        # round's mislabel (async device execution silently absorbed
+        # into whatever region a hand-placed perf_counter pair straddled)
+        # has no place to hide. Parity with legacy perf_counter pairs is
+        # pinned by tests/test_obs.py::test_span_terms_match_legacy.
+        with obs.tracing():
+            t_rec = obs.now()
+            for b in batches:
+                plan = doc.prepare_batch(b)
+                doc.commit_prepared(plan)
+                with obs.span_ctx("device", "wait"):
+                    _jax.block_until_ready(list(doc._dev.values()))
+            with obs.span_ctx("device", "final_sync"):
+                doc._materialize(with_pos=False)
+                scal = doc._scalars()
+            recs = obs.snapshot(since_ns=t_rec)
         assert int(scal[0]) == expect_vis
-        return {"prepare_s": round(prep_s, 4),
-                "commit_s": round(commit_s, 4),
-                "device_wait_s": round(wait_s, 4),
-                "final_sync_s": round(sync_s, 4)}
+        return {"prepare_s": round(
+                    obs.span_seconds(recs, "plan", "prepare_batch"), 4),
+                "commit_s": round(
+                    obs.span_seconds(recs, "commit", "batch"), 4),
+                "device_wait_s": round(
+                    obs.span_seconds(recs, "device", "wait"), 4),
+                "final_sync_s": round(
+                    obs.span_seconds(recs, "device", "final_sync"), 4)}
 
+    from automerge_tpu.engine import accounting
     stream()                        # warm-up: jit compiles at these shapes
-    runs = [stream() for _ in range(reps)]
+    labels0 = accounting.labeled_snapshot()["dispatch"]
+    runs = [stream(rep=r) for r in range(reps)]
+    # per-kernel dispatch histogram across the measured reps (ISSUE 6:
+    # dispatch counts decompose by kernel label, not two integers)
+    labels1 = accounting.labeled_snapshot()["dispatch"]
+    dispatch_labels = {
+        k: v["n"] - labels0.get(k, {"n": 0})["n"] for k, v in labels1.items()
+        if v["n"] - labels0.get(k, {"n": 0})["n"] > 0}
     times = [r[0] for r in runs]
     rates = [total_ops / t for t in times]
     med_rate = _median(rates)
@@ -669,6 +708,7 @@ def measure_pipeline(n_batches: int = 6, n_actors: int = 2_000,
         "n_batches": n_batches,
         "ops_per_batch": total_ops // n_batches,
         "ring": ring,
+        "dispatch_labels": dispatch_labels,
         "dispatches_per_batch_max": disp_max,
         "syncs_per_batch_max": sync_max,
         "serial_profile": profile,
@@ -692,6 +732,23 @@ def measure_pipeline(n_batches: int = 6, n_actors: int = 2_000,
     return rec
 
 
+def trace_requested() -> bool:
+    """`--trace` (or AMTPU_TRACE=1): record the whole run in the obs
+    flight recorder and dump Perfetto-loadable Chrome trace JSON."""
+    return "--trace" in sys.argv or obs.ENABLED
+
+
+def write_bench_trace(rec: dict) -> str:
+    """Dump the run's trace next to the repo (AMTPU_TRACE_OUT overrides)
+    and stamp the artifact path into the record."""
+    path = os.environ.get("AMTPU_TRACE_OUT", "bench_trace.json")
+    obs.write_trace(path)
+    rec["trace_path"] = path
+    print(f"bench.py: trace written to {path} "
+          "(load at https://ui.perfetto.dev)", file=sys.stderr)
+    return path
+
+
 def main_pipeline():
     """`bench.py --pipeline`: the streaming-tier headline entry point."""
     from benchmarks.common import preflight_device
@@ -700,7 +757,11 @@ def main_pipeline():
         print("bench.py --pipeline: no reachable jax device — refusing "
               "to hang", file=sys.stderr)
         return 3
+    if trace_requested():
+        obs.enable()
     rec = measure_pipeline(quick="--quick" in sys.argv)
+    if trace_requested():
+        write_bench_trace(rec)
     print(json.dumps(rec))
     if is_chip_platform(rec["platform"]):
         append_session_log(rec)
@@ -723,6 +784,8 @@ def main():
               "refusing to hang; no last-good on-chip record exists yet",
               file=sys.stderr)
         return 3
+    if trace_requested():
+        obs.enable()
     try:
         rec = _measure()
     except Exception as exc:
@@ -738,6 +801,8 @@ def main():
         if served is not None:
             return served
         raise
+    if trace_requested():
+        write_bench_trace(rec)
     print(json.dumps(rec))
     if is_chip_platform(rec["platform"]):
         # the committed session log gets EVERY live chip run, before any
@@ -859,4 +924,9 @@ def _measure() -> dict:
 
 
 if __name__ == "__main__":
-    sys.exit(main_pipeline() if "--pipeline" in sys.argv else main())
+    # `--quick` without `--pipeline` routes to the reduced streaming
+    # smoke (the CI trace-validation entry point): the full cfg5 default
+    # mode has no reduced shape, and `--quick --trace` needs one
+    sys.exit(main_pipeline()
+             if ("--pipeline" in sys.argv or "--quick" in sys.argv)
+             else main())

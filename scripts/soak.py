@@ -24,9 +24,20 @@ Usage:
   python scripts/soak.py [--profile all] [--sessions 30] [--seed-base 0]
   python scripts/soak.py --chaos [--sessions 50]     # chaos campaign
   python scripts/soak.py --checkpoint [--sessions 10]
+  python scripts/soak.py --chaos --trace             # + Perfetto trace
 
 Exit 0 iff every session converged; failures print their profile+seed so
 `--profile P --sessions 1 --seed-base SEED` reproduces one exactly.
+
+The final line is ONE JSON summary (the machine-readable artifact):
+profile, seed_base, per-seed failures, and the aggregated obs event
+counters (INTERNALS §11) — chaos injections (drops/dups/reorders/delays/
+partition drops), channel retransmits/dedups/window drops, and
+quarantine parks/evictions/releases — so a failing soak is diagnosable
+from the artifact alone: the seed reproduces it, the event mix says what
+the transport actually did. ``--trace`` additionally dumps the retained
+flight-recorder records as Chrome trace JSON (``soak_trace.json``;
+AMTPU_TRACE_OUT overrides).
 """
 
 import argparse
@@ -502,26 +513,65 @@ PROFILES = {"general": session_general, "conflict": session_conflict,
             "chaos": session_chaos, "checkpoint": session_checkpoint}
 
 
-def run(profile: str, sessions: int, seed_base: int) -> int:
+def run(profile: str, sessions: int, seed_base: int,
+        trace: bool = False) -> int:
+    import json
+
+    from automerge_tpu import obs
+
     failures = []
     t0 = time.perf_counter()
     names = list(PROFILES) if profile == "all" else [profile]
-    for name in names:
-        fn = PROFILES[name]
-        for s in range(sessions):
-            seed = seed_base + s
-            try:
-                fn(seed)
-            except Exception as exc:   # noqa: BLE001 — record + continue
-                failures.append((name, seed, repr(exc)))
-                print(f"FAIL {name} seed {seed}: {exc!r}", flush=True)
-    dt = time.perf_counter() - t0
-    total = len(names) * sessions
-    print(f"soak: {total - len(failures)}/{total} sessions converged "
-          f"({dt:.1f}s)", flush=True)
-    for name, seed, exc in failures:
-        print(f"  reproduce: python scripts/soak.py --profile {name} "
-              f"--sessions 1 --seed-base {seed}")
+    # the soak ALWAYS records (counters are exact across ring
+    # wraparound, so the summary is right even for long campaigns); the
+    # --trace flag only controls whether the ring is also exported
+    with obs.tracing():
+        # the summary reports THIS campaign's event delta: the recorder
+        # may outlive run() (a second campaign in-process, earlier traced
+        # tests), and counters are lifetime totals by design
+        ev0 = obs.metrics_snapshot()["counters"]
+        n0 = obs.metrics_snapshot()["emitted"]
+        for name in names:
+            fn = PROFILES[name]
+            for s in range(sessions):
+                seed = seed_base + s
+                try:
+                    fn(seed)
+                except Exception as exc:  # noqa: BLE001 — record + continue
+                    failures.append((name, seed, repr(exc)))
+                    print(f"FAIL {name} seed {seed}: {exc!r}", flush=True)
+        dt = time.perf_counter() - t0
+        total = len(names) * sessions
+        print(f"soak: {total - len(failures)}/{total} sessions converged "
+              f"({dt:.1f}s)", flush=True)
+        for name, seed, exc in failures:
+            print(f"  reproduce: python scripts/soak.py --profile {name} "
+                  f"--sessions 1 --seed-base {seed}")
+        snap = obs.metrics_snapshot()
+        events = {k: v - ev0.get(k, 0) for k, v in snap["counters"].items()
+                  if v - ev0.get(k, 0) > 0}
+        if trace:
+            path = os.environ.get("AMTPU_TRACE_OUT", "soak_trace.json")
+            obs.write_trace(path)
+            print(f"soak: trace written to {path} "
+                  "(load at https://ui.perfetto.dev)", file=sys.stderr)
+    # the machine-readable artifact: profile + SEEDS + event mix (the
+    # diagnosable-soak contract, ISSUE 6). Last line, valid JSON.
+    summary = {
+        "soak_profiles": names,
+        "sessions_per_profile": sessions,
+        "seed_base": seed_base,
+        "converged": total - len(failures),
+        "total": total,
+        "elapsed_s": round(dt, 1),
+        "failures": [{"profile": n, "seed": sd, "error": e}
+                     for n, sd, e in failures],
+        "events": events,
+        "obs_records": {"emitted": snap["emitted"] - n0,
+                        "retained": snap["retained"]},
+        **({"trace_path": path} if trace else {}),
+    }
+    print(json.dumps(summary, sort_keys=True), flush=True)
     return 1 if failures else 0
 
 
@@ -536,10 +586,13 @@ def main():
                          "mid-chaos + restart one peer from its bundle)")
     ap.add_argument("--sessions", type=int, default=30)
     ap.add_argument("--seed-base", type=int, default=0)
+    ap.add_argument("--trace", action="store_true",
+                    help="dump the obs flight recorder as Chrome trace "
+                         "JSON (Perfetto-loadable) after the campaign")
     args = ap.parse_args()
     profile = ("chaos" if args.chaos
                else "checkpoint" if args.checkpoint else args.profile)
-    return run(profile, args.sessions, args.seed_base)
+    return run(profile, args.sessions, args.seed_base, trace=args.trace)
 
 
 if __name__ == "__main__":
