@@ -297,6 +297,11 @@ class _DeviceCore:
         self.actor_rank: dict = {}           # actor -> dense rank (states order)
         self.pending: list = []              # fast-path local changes not
                                              # yet replayed into the engine
+        self._pending_routed: list = []      # aligned (change, by_obj,
+                                             # root_ops) routing triples,
+                                             # cached at fast-apply time so
+                                             # the flush replay never
+                                             # re-walks the ops
 
     def clock_vectors(self):
         """(actors list, per-actor applied-change counts as int64 vector),
@@ -542,6 +547,7 @@ class _DeviceCore:
         diffs = self._fast_execute(kind_, plan, wrapper, obj, ov, actor,
                                    rank)
         self.pending.append(change)
+        self._pending_routed.append((change, {obj: list(ops)}, []))
         return diffs
 
     def _covers_doc(self, change: dict, actor: str, seq: int) -> bool:
@@ -623,6 +629,14 @@ class _DeviceCore:
                 ov.writes[key] = _DELETED
             diffs.append(diff)
         self.pending.append(change)
+        by_obj: dict = {}
+        root_ops: list = []
+        for op in ops:
+            if op["obj"] == ROOT_ID:
+                root_ops.append(op)
+            else:
+                by_obj.setdefault(op["obj"], []).append(op)
+        self._pending_routed.append((change, by_obj, root_ops))
         return diffs
 
     def _fast_shape(self, ops, actor: str, wrapper: "_TextObj"):
@@ -817,7 +831,8 @@ class _DeviceCore:
         if not self.pending:
             return
         pending, self.pending = self.pending, []
-        touched, _ = self._distribute(pending, {})
+        routed, self._pending_routed = self._pending_routed, []
+        touched, _ = self._distribute(pending, {}, routed=routed)
         for oid in touched:
             w = self.root if oid == ROOT_ID else self.objects.get(oid)
             if isinstance(w, _TextObj):
@@ -931,17 +946,39 @@ class _DeviceCore:
         return {(a, i + 1): e["allDeps"]
                 for a, lst in self.states.items() for i, e in enumerate(lst)}
 
-    def _distribute(self, applied, creations):
+    def _distribute(self, applied, creations, routed=None):
         """Feed applied changes to the per-object device docs.
 
         Per-change windows (with empty sub-changes carrying causal
         bookkeeping) are built ONLY for objects the delivery touches or
         creates; every other object's causal state advances in bulk — one
         dict update per doc instead of per (doc x change) Python work
-        (the nested Trellis shape has many objects, few touched)."""
+        (the nested Trellis shape has many objects, few touched).
+
+        `routed` (the flush path, `flush_pending`): the per-change
+        (change, by_obj, root_ops) triples were already computed when
+        each fast-path round applied, so replaying pending rounds skips
+        the whole per-op routing walk — `creations` is empty there (the
+        fast path never serves makes) and `max_elem` was maintained at
+        fast-apply time."""
         if not applied:
             return set(), []
-        routed: list = []            # (change, by_obj, root_ops) per change
+        if routed is not None:
+            created_at: dict = {}
+            touched: set = set()
+            n_root_ops = 0
+            for _ch, by_obj, root_ops in routed:
+                touched |= by_obj.keys()
+                if root_ops:
+                    touched.add(ROOT_ID)
+                    n_root_ops += len(root_ops)
+            if len(applied) >= 4 and n_root_ops:
+                # same root pre-size as the walk below: a root-key-heavy
+                # flush must not grow the root map bucket by bucket
+                self.root.doc.reserve(n_root_ops + 16)
+            return self._distribute_routed(applied, routed, created_at,
+                                           touched)
+        routed = []                  # (change, by_obj, root_ops) per change
         op_totals = None             # per-obj op counts, for creation sizing
 
         def totals() -> dict:
@@ -963,9 +1000,9 @@ class _DeviceCore:
             # creation hint, but a root-key-heavy load would otherwise
             # grow it through every bucket, one XLA compile per shape
             self.root.doc.reserve(totals().get(ROOT_ID, 0) + 16)
-        created_at: dict = {}        # obj -> index of its creating change
+        created_at = {}              # obj -> index of its creating change
         # (insertion-ordered: doubles as the created-object list)
-        touched: set = set()
+        touched = set()
         for idx, ch in enumerate(applied):
             by_obj: dict = {}
             root_ops: list = []
@@ -1018,7 +1055,16 @@ class _DeviceCore:
             touched |= by_obj.keys()
             if root_ops:
                 touched.add(ROOT_ID)
+        return self._distribute_routed(applied, routed, created_at,
+                                       touched)
 
+    def _distribute_routed(self, applied, routed, created_at: dict,
+                           touched: set):
+        """Apply a routed delivery to the per-object engine docs: the
+        stacked multi-object path when eligible (one dispatch per causal
+        round across ALL touched objects — engine/stacked.py,
+        AMTPU_STACKED_ROUNDS), the per-object window loop otherwise
+        (kept verbatim: it is the stacked tier's parity comparator)."""
         # engine application stales any overlay on a touched object (the
         # single choke point: every path that mutates an object's engine
         # state goes through here)
@@ -1027,17 +1073,51 @@ class _DeviceCore:
             if w is not None:
                 w.ov = None
 
-        if ROOT_ID in touched:
-            self.root.doc.apply_changes(
-                [_sub_change(ch, root_ops) for ch, _, root_ops in routed])
         window_ids = (touched | set(created_at)) - {ROOT_ID}
-        for oid in self.obj_order:
-            if oid not in window_ids:
-                continue
-            start = created_at.get(oid, 0)
-            self.objects[oid].doc.apply_changes(
-                [_sub_change(ch, by_obj.get(oid, []))
-                 for ch, by_obj, _ in routed[start:]])
+        stacked_done = False
+        if len(window_ids) + (ROOT_ID in touched) >= 2:
+            from ..engine import stacked as _stacked
+            # cheap pre-gates from the already-routed triples, BEFORE
+            # paying the per-object window construction: the common
+            # small interactive flush must not build `items` twice
+            # (once for a declined stacked attempt, once per-object)
+            n_wire = 0
+            op_objs: set = set()
+            for _ch, by_obj, root_ops in routed:
+                for o, ops_l in by_obj.items():
+                    if ops_l:
+                        op_objs.add(o)
+                        n_wire += len(ops_l)
+                if root_ops:
+                    op_objs.add(ROOT_ID)
+                    n_wire += len(root_ops)
+            if (_stacked.stacked_rounds_enabled()
+                    and _stacked.worth_trying(n_wire, len(op_objs))):
+                items = []
+                if ROOT_ID in touched:
+                    items.append((self.root.doc,
+                                  [_sub_change(ch, root_ops)
+                                   for ch, _, root_ops in routed]))
+                for oid in self.obj_order:
+                    if oid in window_ids:
+                        start = created_at.get(oid, 0)
+                        items.append(
+                            (self.objects[oid].doc,
+                             [_sub_change(ch, by_obj.get(oid, []))
+                              for ch, by_obj, _ in routed[start:]]))
+                stacked_done = _stacked.apply_stacked(items)
+        if not stacked_done:
+            if ROOT_ID in touched:
+                self.root.doc.apply_changes(
+                    [_sub_change(ch, root_ops)
+                     for ch, _, root_ops in routed])
+            for oid in self.obj_order:
+                if oid not in window_ids:
+                    continue
+                start = created_at.get(oid, 0)
+                self.objects[oid].doc.apply_changes(
+                    [_sub_change(ch, by_obj.get(oid, []))
+                     for ch, by_obj, _ in routed[start:]])
 
         # bulk causal advance for everything the delivery never touched:
         # clock entries + shared (read-only) allDeps rows, needed for
@@ -1336,7 +1416,7 @@ class _DeviceCore:
         for slot in ("states", "history", "queue", "clock", "deps",
                      "undo_pos", "undo_stack", "redo_stack", "objects",
                      "obj_order", "root", "commands", "_cv", "actor_rank",
-                     "pending"):
+                     "pending", "_pending_routed"):
             setattr(self, slot, getattr(clean, slot))
 
     def graduate(self, version: int) -> _OracleState:

@@ -1172,10 +1172,23 @@ class CausalDeviceDoc:
         rows aligned with `slots` — pre-gathered by the ingest kernel's
         packed slow_info output, so resolution costs zero extra device
         round trips beyond the one write-back scatter."""
-        import jax.numpy as jnp
-        from ..ops.ingest import bucket, scatter_registers
+        wb = self._resolve_slow_host(b, slots, kinds, values, actor_ranks,
+                                     seqs, slot_cap, reg_state)
+        self._scatter_slow(wb)
 
-        dev = self._dev
+    def _resolve_slow_host(self, b, slots, kinds, values, actor_ranks,
+                           seqs, slot_cap: int, reg_state) -> np.ndarray:
+        """HOST half of the slow register path: oracle-mirroring register
+        resolution (winner = highest actor rank, survivors -> conflicts,
+        `inc` folds into covered counters), mutating only host state
+        (conflicts, value pool). Returns the packed (6, S) writeback
+        matrix (ops/ingest.py WB_* row layout; padding rows carry
+        `slot_cap`, the out-of-bounds drop sentinel). The device half is
+        `_scatter_slow` on the solo path; the stacked executor
+        (engine/stacked.py) re-pads every doc's matrix to a common width
+        and writes them back as ONE vmapped scatter instead."""
+        from ..ops.ingest import bucket
+
         slots = np.asarray(slots)
         kinds = np.asarray(kinds)
         values = np.asarray(values)
@@ -1332,6 +1345,23 @@ class CausalDeviceDoc:
             else:
                 self.conflicts.pop(s, None)
 
+        wb = np.zeros((6, S), np.int32)
+        wb[0] = slots_p
+        wb[1] = w_v
+        wb[2] = w_h
+        wb[3] = w_wa
+        wb[4] = w_ws
+        wb[5] = w_wc
+        return wb
+
+    def _scatter_slow(self, wb: np.ndarray):
+        """DEVICE half of the slow register path: write the resolved
+        winners back over the live register tables (one packed upload, or
+        the legacy six-column comparator)."""
+        import jax.numpy as jnp
+        from ..ops.ingest import scatter_registers
+
+        dev = self._dev
         regs_in = (dev["value"], dev["has_value"], dev["win_actor"],
                    dev["win_seq"], dev["win_counter"])
         self._count_dispatch(label="scatter_registers")
@@ -1344,13 +1374,6 @@ class CausalDeviceDoc:
                 from ..ops.ingest import (donation_enabled,
                                           scatter_registers_packed,
                                           scatter_registers_packed_donated)
-                wb = np.zeros((6, S), np.int32)
-                wb[0] = slots_p
-                wb[1] = w_v
-                wb[2] = w_h
-                wb[3] = w_wa
-                wb[4] = w_ws
-                wb[5] = w_wc
                 fn = (scatter_registers_packed_donated
                       if self.donate_buffers and donation_enabled()
                       else scatter_registers_packed)
@@ -1359,9 +1382,10 @@ class CausalDeviceDoc:
                 # legacy per-column upload (parity comparator): six
                 # separate transfers, each paying per-transfer latency
                 out = scatter_registers(
-                    *regs_in, jnp.asarray(slots_p), jnp.asarray(w_v),
-                    jnp.asarray(w_h), jnp.asarray(w_wa), jnp.asarray(w_ws),
-                    jnp.asarray(w_wc))
+                    *regs_in, jnp.asarray(wb[0]), jnp.asarray(wb[1]),
+                    jnp.asarray(wb[2].astype(bool)), jnp.asarray(wb[3]),
+                    jnp.asarray(wb[4]),
+                    jnp.asarray(wb[5].astype(bool)))
         except BaseException:
             # same donation invariant as the commit kernels (INTERNALS
             # §9.3): a raising donated writeback that CONSUMED the live

@@ -13,6 +13,14 @@ causally ready (the overwhelming bulk-sync shape). A document whose batch
 needs the general machinery (residual ops, queueing, conflicts) permanently
 *graduates* to its own `DeviceTextDoc` built from its table slices —
 correctness never depends on the fast path applying.
+
+The GENERAL multi-doc execution engine this tier pioneered now lives in
+`engine/stacked.py` (INTERNALS §12): it runs the full mixed map/text
+round machinery — residuals, slow registers, conflicts, multi-round
+causal chains — as vmapped stacked programs with no graduation cliff,
+and backs the nested-document backend path. This homogeneous tier
+remains the sync DocSet's bulk fast path; unifying the two is the
+recorded follow-up (ROADMAP item 1).
 """
 
 from __future__ import annotations

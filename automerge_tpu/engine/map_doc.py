@@ -91,14 +91,20 @@ class DeviceMapDoc(CausalDeviceDoc):
     # round ingestion
     # ------------------------------------------------------------------
 
-    def _ingest(self, b: MapChangeBatch, mask):
-        import jax.numpy as jnp
-        from ..ops.ingest import apply_map_round, bucket
+    def _plan_map_round(self, b: MapChangeBatch, mask):
+        """HOST planning of one causally-ready round of map ops: key
+        interning + resolved op columns, zero device work. Returns None
+        for an empty round; otherwise the dict both the solo `_ingest`
+        dispatch and the stacked multi-object executor
+        (engine/stacked.py) consume. `val64` keeps the unclipped values
+        the host slow path needs (pool refs survive clipping anyway;
+        plain int64 magnitudes do not)."""
+        from ..ops.ingest import bucket
 
         kind = np.ascontiguousarray(b.op_kind[mask])
         n_ops = len(kind)
         if n_ops == 0:
-            return
+            return None
         op_key = b.op_key[mask]
         val64 = b.op_value[mask]
         op_row = b.op_change[mask]
@@ -108,8 +114,24 @@ class DeviceMapDoc(CausalDeviceDoc):
         row_actor_rank = np.asarray(
             [self._actor_rank[a] for a in b.actors], np.int32)
         row_seq = np.asarray(b.seqs, np.int32)
+        return {
+            "n_ops": n_ops, "kind": kind, "slot": slot,
+            "value": np.clip(val64, -2**31, 2**31 - 1).astype(np.int32),
+            "win_actor": row_actor_rank[op_row],
+            "win_seq": row_seq[op_row], "val64": val64,
+            "out_cap": max(bucket(len(self.key_table)), self._cap),
+        }
 
-        out_cap = max(bucket(len(self.key_table)), self._cap)
+    def _ingest(self, b: MapChangeBatch, mask):
+        import jax.numpy as jnp
+        from ..ops.ingest import apply_map_round, bucket
+
+        p = self._plan_map_round(b, mask)
+        if p is None:
+            return
+        n_ops = p["n_ops"]
+        kind = p["kind"]
+        out_cap = p["out_cap"]
         dev = self._ensure_dev()
         M = bucket(n_ops, 128)
 
@@ -127,9 +149,9 @@ class DeviceMapDoc(CausalDeviceDoc):
         (value_n, has_n, wa_n, ws_n, wc_n, slow_info) = apply_map_round(
             dev["value"], dev["has_value"], dev["win_actor"],
             dev["win_seq"], dev["win_counter"],
-            padm(kind, -1, np.int8), padm(slot, out_cap),
-            padm(np.clip(val64, -2**31, 2**31 - 1), 0),
-            padm(row_actor_rank[op_row], 0), padm(row_seq[op_row], 0),
+            padm(kind, -1, np.int8), padm(p["slot"], out_cap),
+            padm(p["value"], 0),
+            padm(p["win_actor"], 0), padm(p["win_seq"], 0),
             jnp.asarray(conflict_slots), out_cap=out_cap)
 
         self._dev = {"value": value_n, "has_value": has_n, "win_actor": wa_n,
@@ -146,8 +168,8 @@ class DeviceMapDoc(CausalDeviceDoc):
         if info[0].any():
             idxs = np.nonzero(info[0])[0]
             self._apply_slow(
-                b, info[1][idxs], kind[idxs], val64[idxs],
-                row_actor_rank[op_row[idxs]], row_seq[op_row[idxs]],
+                b, info[1][idxs], kind[idxs], p["val64"][idxs],
+                p["win_actor"][idxs], p["win_seq"][idxs],
                 slot_cap=self._cap,
                 reg_state=tuple(info[r][idxs] for r in range(2, 7)))
 

@@ -136,6 +136,14 @@ class DeviceTextDoc(CausalDeviceDoc):
 
     batch_type = TextChangeBatch
 
+    # How `_plan_round` ships its packed device inputs. The default stages
+    # each buffer h2d immediately (the solo/pipelined path); the stacked
+    # multi-object executor (engine/stacked.py) swaps in an identity
+    # stager so plans come back as HOST matrices, which it re-pads and
+    # uploads as ONE (D, ...) block per round across every object —
+    # per-object device_puts are exactly the cfg4 ceiling being removed.
+    _stager = staticmethod(stage_h2d)
+
     def _decode_wire(self, changes):
         """Wire deliveries decode through the columnar protocol-boundary
         decoder (engine/wire_columns.py): vectorized numpy decode for
@@ -255,6 +263,8 @@ class DeviceTextDoc(CausalDeviceDoc):
                                   RES_WIN_ACTOR, RES_WIN_SEQ, bucket)
 
         base_elems, base_index, base_cap, base_mirror = shadow
+        st = self._stager          # h2d stager (identity on the stacked
+        staged_mode = st is stage_h2d  # path: plans stay host matrices)
         kind = np.ascontiguousarray(b.op_kind[mask])
         n_ops = len(kind)
         if n_ops == 0:
@@ -466,17 +476,18 @@ class DeviceTextDoc(CausalDeviceDoc):
             # h2d once per batch and reuse the (immutable, never-donated)
             # device buffer across every application — at headline scale
             # it is the plan's largest transfer
-            sb = getattr(b, "_staged_blob", None) if full_round else None
+            sb = (getattr(b, "_staged_blob", None)
+                  if full_round and staged_mode else None)
             if sb is not None and sb[0] == N:
                 blob_dev = sb[1]
             else:
                 blob = np.zeros(N, np.uint8 if plan.blob_lt_256
                                 else np.int32)
                 blob[:n_pairs] = plan.blob
-                blob_dev = stage_h2d(blob)
-                if full_round:
+                blob_dev = st(blob)
+                if full_round and staged_mode:
                     b._staged_blob = (N, blob_dev)
-            desc_dev = stage_h2d(desc)
+            desc_dev = st(desc)
 
         res_dev = res_host = None
         n_res = len(rpos)
@@ -501,7 +512,7 @@ class DeviceTextDoc(CausalDeviceDoc):
             res[RES_VALUE, :n_res] = np.clip(res_vals, -2**31, 2**31 - 1)
             res[RES_WIN_ACTOR, :n_res] = row_actor_rank[op_row[rpos]]
             res[RES_WIN_SEQ, :n_res] = row_seq[op_row[rpos]]
-            res_dev = stage_h2d(res)
+            res_dev = st(res)
             # host columns the slow register path needs at execute time
             res_host = (res_kind, res_vals, row_actor_rank[op_row[rpos]],
                         row_seq[op_row[rpos]])
@@ -538,7 +549,7 @@ class DeviceTextDoc(CausalDeviceDoc):
             touch[0, : len(arr_p)] = arr_p
             touch[1, : len(arr_p)] = np.concatenate(ins_ctr)
             touch[2, : len(arr_p)] = np.concatenate(ins_act)
-            touch_dev = stage_h2d(touch)
+            touch_dev = st(touch)
 
         # --- host segment mirror: the round's structural effect (new heads
         # + chain breaks) is fully known here; thread it through the shadow
@@ -603,14 +614,15 @@ class DeviceTextDoc(CausalDeviceDoc):
             try:
                 seg_S = bucket(mirror_after.n_segs + 2, 64)
                 sp_key = (seg_S, n_elems_after)
-                if mc_entry is not None and sp_key in mc_entry[2]:
+                if (mc_entry is not None and staged_mode
+                        and sp_key in mc_entry[2]):
                     # the staged (immutable, never-donated) segplan device
                     # buffer is shared across applications outright
                     seg_plan_dev = mc_entry[2][sp_key]
                 else:
-                    seg_plan_dev = stage_h2d(
+                    seg_plan_dev = st(
                         mirror_after.plan(seg_S, n_elems_after))
-                    if mc_entry is not None:
+                    if mc_entry is not None and staged_mode:
                         mc_entry[2][sp_key] = seg_plan_dev
             except Exception:
                 logger.warning(
@@ -631,11 +643,49 @@ class DeviceTextDoc(CausalDeviceDoc):
             blob=blob_dev, res=res_dev, touch=touch_dev,
             ascii_clear=ascii_clear, res_host=res_host,
             seg_inc=3 * (n_runs + n_res_ins) + 2,
-            n_elems_dev=jnp.asarray(np.int32(n_elems_after)),
+            n_elems_dev=(jnp.asarray(np.int32(n_elems_after))
+                         if staged_mode else None),
             mirror_after=mirror_after, seg_plan=seg_plan_dev, seg_S=seg_S,
             touched_slots=touched)
         return exec_plan, (n_elems_after, merged_index, out_cap,
                            mirror_after)
+
+    def _begin_round_host(self, plan: "_RoundExec"):
+        """Pre-dispatch host bookkeeping of one committed round, shared by
+        the solo `_execute_plan` and the stacked multi-object executor
+        (engine/stacked.py)."""
+        self.index = plan.index_after
+        self.seg_mirror = plan.mirror_after
+        self._mat_keep_gen = None  # a new round stales any prior fused cache
+
+    def _finish_round_host(self, plan: "_RoundExec"):
+        """Post-dispatch host bookkeeping of one committed round (counts,
+        ascii/caches, segment bound, dirty-span feed, invalidation) —
+        shared by `_execute_plan` and the stacked executor."""
+        self.n_elems = plan.n_elems_after
+        # staged device mirror of the element count (solo path only; the
+        # stacked planner skips the per-doc scalar upload and the next
+        # materialize re-stages it)
+        self._n_elems_dev = ((plan.n_elems_after, plan.n_elems_dev)
+                             if plan.n_elems_dev is not None else None)
+        if plan.ascii_clear:
+            self.all_ascii = False
+            # incremental pulls are ascii-gated for good: drop the cache
+            # now or the dead entry would keep the touched-slot feed
+            # growing for the rest of the document's life
+            self._text_cache = None
+            self._touched_old = []
+        # every inserted run/element can split at most one existing segment;
+        # with a live mirror the exact count is known
+        if plan.mirror_after is not None:
+            self._seg_bound = max(plan.mirror_after.n_segs, 1)
+        else:
+            self._seg_bound += plan.seg_inc
+        if plan.touched_slots is not None and self._text_cache is not None:
+            # assign targets are pre-round slots: the text-cache spans they
+            # fall in must re-pull (visibility/content may have changed)
+            self._touched_old.append(plan.touched_slots)
+        self._invalidate()
 
     def _execute_plan(self, b: TextChangeBatch, plan: "_RoundExec"):
         """Commit a planned round: index/count bookkeeping + device
@@ -645,9 +695,7 @@ class DeviceTextDoc(CausalDeviceDoc):
         from ..ops.ingest import bucket, donation_enabled
 
         out_cap = plan.out_cap
-        self.index = plan.index_after
-        self.seg_mirror = plan.mirror_after
-        self._mat_keep_gen = None  # a new round stales any prior fused cache
+        self._begin_round_host(plan)
         dev = self._ensure_dev()
         tables = tuple(dev[k] for k in self._TABLE_KEYS)
 
@@ -742,28 +790,7 @@ class DeviceTextDoc(CausalDeviceDoc):
 
         self._dev = dict(zip(self._TABLE_KEYS, tables))
         self._cap = out_cap
-        self.n_elems = plan.n_elems_after
-        # staged device mirror of the element count: materialize dispatches
-        # with it instead of uploading a fresh host scalar
-        self._n_elems_dev = (plan.n_elems_after, plan.n_elems_dev)
-        if plan.ascii_clear:
-            self.all_ascii = False
-            # incremental pulls are ascii-gated for good: drop the cache
-            # now or the dead entry would keep the touched-slot feed
-            # growing for the rest of the document's life
-            self._text_cache = None
-            self._touched_old = []
-        # every inserted run/element can split at most one existing segment;
-        # with a live mirror the exact count is known
-        if plan.mirror_after is not None:
-            self._seg_bound = max(plan.mirror_after.n_segs, 1)
-        else:
-            self._seg_bound += plan.seg_inc
-        if plan.touched_slots is not None and self._text_cache is not None:
-            # assign targets are pre-round slots: the text-cache spans they
-            # fall in must re-pull (visibility/content may have changed)
-            self._touched_old.append(plan.touched_slots)
-        self._invalidate()
+        self._finish_round_host(plan)
         if fused_mat is not None:
             # the fused program already materialized codes for this state;
             # the seed-generation stamp lets it survive the batch driver's

@@ -563,8 +563,7 @@ def _register_fast_path(value_n, has_n, wa_n, ws_n, wc_n, kind, is_assign,
     return value_n, has_n, wa_n, ws_n, wc_n, slow_info
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
-def apply_map_round(
+def _apply_map_round(
     # register tables, capacity K
     value, has_value, win_actor, win_seq, win_counter,
     # op columns, capacity M (padding: kind=-1, slot=out_cap)
@@ -591,6 +590,9 @@ def apply_map_round(
     return _register_fast_path(
         value_n, has_n, wa_n, ws_n, wc_n, kind, is_assign, op_slot,
         op_value, op_win_actor, op_win_seq, conflict_slots, out_cap)
+
+
+apply_map_round = jax.jit(_apply_map_round, static_argnames=("out_cap",))
 
 
 def _merge_and_materialize_dense(
@@ -1091,3 +1093,146 @@ def _scatter_registers_packed(value, has_value, win_actor, win_seq,
 
 scatter_registers_packed, scatter_registers_packed_donated = _jit_pair(
     _scatter_registers_packed, _REG_ARGNUMS)
+
+
+# ---------------------------------------------------------------------------
+# Stacked multi-object rounds (engine/stacked.py; INTERNALS §12)
+#
+# The nested-document production shape is MANY SMALL objects: a Trellis
+# board fans one causal round across ~21 per-object engine docs, and the
+# per-(object, round) programs plus their h2d staging dominate the merge
+# (docs/MEASUREMENTS.md, cfg4 profile). These kernels execute one causal
+# round across EVERY participating object as a constant number of
+# programs: per-object tables pad to a common capacity and stack along a
+# leading doc axis, and the existing round kernels run under `jax.vmap` —
+# the padded-stack shape the DocSet tier already uses for homogeneous
+# text docs (engine/doc_set.py), generalized to the mixed map/text
+# workload. Padded stacking was chosen over a doc-id column in shared
+# flat tables because the run-expansion kernels write one contiguous
+# slot window per document (`expand_runs_dense`'s base_slot contract),
+# which a doc-id column cannot express without per-doc windows; vmap
+# keeps every doc's slot space intact and the kernels unchanged.
+# ---------------------------------------------------------------------------
+
+# fill values per table column when padding to the common stacked width
+_REG_FILLS = (0, False, -1, 0, False)
+_ELEM_FILLS = (0, 0, 0, 0, False, -1, 0, False, False)
+
+# row layout of the packed (D, 5, M) stacked map-op upload
+MOP_KIND, MOP_SLOT, MOP_VALUE, MOP_WIN_ACTOR, MOP_WIN_SEQ = range(5)
+
+
+def _stack_padded(tables, fills, out_cap):
+    return tuple(
+        jnp.stack([_ext(doc[k], fills[k], out_cap) for doc in tables])
+        for k in range(len(fills)))
+
+
+def _stack_register_tables(tables, remaps, *, out_cap: int):
+    """Per-doc register tables -> stacked (D, out_cap) columns.
+
+    `tables` is a tuple of per-doc 5-tuples (value, has_value, win_actor,
+    win_seq, win_counter); `remaps` a (D, L) int32 matrix of pending
+    actor-rank remaps (identity rows for unaffected docs), folded into
+    the gather so a reordering intern costs zero extra programs instead
+    of one `remap_ranks` dispatch per document."""
+    value, has_value, win_actor, win_seq, win_counter = _stack_padded(
+        tables, _REG_FILLS, out_cap)
+    hi = remaps.shape[1] - 1
+    win_actor = jnp.where(
+        win_actor >= 0,
+        jnp.take_along_axis(remaps, jnp.clip(win_actor, 0, hi), axis=1),
+        win_actor)
+    return value, has_value, win_actor, win_seq, win_counter
+
+
+stack_register_tables = jax.jit(_stack_register_tables,
+                                static_argnames=("out_cap",))
+
+
+def _stack_element_tables(tables, remaps, n_elems, *, out_cap: int):
+    """Per-doc element tables -> stacked (D, out_cap) columns with each
+    doc's pending actor-rank remap folded in (`remap_actors` semantics
+    per row: live slots 1..n_elems re-rank `actor`, any slot re-ranks a
+    non-negative `win_actor`)."""
+    (parent, ctr, actor, value, has_value, win_actor, win_seq,
+     win_counter, chain) = _stack_padded(tables, _ELEM_FILLS, out_cap)
+    hi = remaps.shape[1] - 1
+    idx = jnp.arange(out_cap, dtype=jnp.int32)[None, :]
+    live = (idx >= 1) & (idx <= n_elems[:, None])
+    actor = jnp.where(live, jnp.take_along_axis(
+        remaps, jnp.clip(actor, 0, hi), axis=1), actor)
+    win_actor = jnp.where(win_actor >= 0, jnp.take_along_axis(
+        remaps, jnp.clip(win_actor, 0, hi), axis=1), win_actor)
+    return (parent, ctr, actor, value, has_value, win_actor, win_seq,
+            win_counter, chain)
+
+
+stack_element_tables = jax.jit(_stack_element_tables,
+                               static_argnames=("out_cap",))
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def stacked_map_round(value, has_value, win_actor, win_seq, win_counter,
+                      ops, conflict_slots, *, out_cap: int):
+    """`apply_map_round` vmapped over the doc axis: one program merges
+    one causal round of EVERY participating map/table object. `ops`
+    carries the whole round's op columns as one (D, 5, M) int32 upload
+    (MOP_* rows; padding kind=-1, slot=out_cap), `conflict_slots` one
+    (D, K) matrix. Returns the 5 stacked tables + (D, 7, M) slow_info."""
+    def one(v, h, wa, ws, wc, o, cs):
+        return _apply_map_round(
+            v, h, wa, ws, wc, o[MOP_KIND].astype(jnp.int8), o[MOP_SLOT],
+            o[MOP_VALUE], o[MOP_WIN_ACTOR], o[MOP_WIN_SEQ], cs,
+            out_cap=out_cap)
+    return jax.vmap(one)(value, has_value, win_actor, win_seq,
+                         win_counter, ops, conflict_slots)
+
+
+@partial(jax.jit,
+         static_argnames=("out_cap", "expand_kind", "with_res",
+                          "with_touch"))
+def stacked_mixed_round(parent, ctr, actor, value, has_value, win_actor,
+                        win_seq, win_counter, chain, desc, blob, res,
+                        conflict_slots, touch, *, out_cap: int,
+                        expand_kind: str, with_res: bool,
+                        with_touch: bool):
+    """`apply_mixed_round` vmapped over the doc axis: one program for one
+    causal round of every text/list object sharing the group's static
+    shape flags. Stacked operands: desc (D, 9, R), blob (D, N), res
+    (D, 8, M), conflict_slots (D, K), touch (D, 3, T). Inactive docs
+    ride with padding rows — their dense write window lands past their
+    live region, exactly the DocSet convention (engine/doc_set.py)."""
+    fn = partial(_apply_mixed_round, out_cap=out_cap,
+                 expand_kind=expand_kind, with_res=with_res,
+                 with_touch=with_touch)
+    return jax.vmap(fn)(parent, ctr, actor, value, has_value, win_actor,
+                        win_seq, win_counter, chain, desc, blob, res,
+                        conflict_slots, touch)
+
+
+@jax.jit
+def stacked_scatter_registers(value, has_value, win_actor, win_seq,
+                              win_counter, wb):
+    """`scatter_registers_packed` vmapped over the doc axis: every doc's
+    host-resolved slow-register writeback lands as ONE (D, 6, S) upload
+    + one program (padding rows carry an OOB slot and drop)."""
+    return jax.vmap(_scatter_registers_packed)(
+        value, has_value, win_actor, win_seq, win_counter, wb)
+
+
+@jax.jit
+def stacked_pack_rows(*tables):
+    """vmapped `pack_rows`: stacked (D, cap) columns -> one (D, K, cap)
+    int32 matrix, so ONE d2h fetch re-seeds every participating doc's
+    host mirror after a stacked apply."""
+    return jnp.stack([t.astype(jnp.int32) for t in tables], axis=1)
+
+
+@jax.jit
+def unstack_rows(cols):
+    """Split stacked (D, cap) columns back into per-doc row tuples — one
+    program with D x K outputs, so re-binding every doc's tables after a
+    stacked apply costs one dispatch, not one slice per (doc, table)."""
+    D = cols[0].shape[0]
+    return tuple(tuple(c[d] for c in cols) for d in range(D))

@@ -132,31 +132,27 @@ def config3_docset(n_docs: int = 1000, n_actors: int = 10,
          threshold=TRACKING_ONLY)
 
 
-def config4_trellis(n_actors: int = 1000, quick: bool = False):
-    """Trellis-style nested cards[]/tasks[]: n_actors concurrent actors do
-    mixed insert/update/delete on a shared board, merged on the DEVICE
-    nested-document tier (asserted: no graduation)."""
+def trellis_changes(n_actors: int, n_cards: int = 10):
+    """The cfg4 workload: a shared nested board + n_actors concurrent
+    mixed edits (task appends, title retitles, task deletes), minted on
+    the oracle tier (the emitted change JSON is backend-independent, and
+    building n_actors peers on the device tier would pay thousands of
+    tunnel round trips in untimed setup). Returns (base doc, flattened
+    changes, n_ops). Shared with benchmarks/cfg4_smoke.py so the CI
+    smoke and the recorded config can never measure different shapes."""
     import automerge_tpu as am
-    from automerge_tpu import frontend as Frontend
-    from automerge_tpu.backend import device as device_backend
+    from automerge_tpu.backend import facade as oracle_backend
 
-    if quick:
-        n_actors = 100
     base = am.change(am.init("base"), lambda d: d.update(
         {"cards": [{"title": f"card{i}", "tasks": [f"t{j}" for j in range(3)]}
-                   for i in range(10)]}))
-    # peer-change GENERATION runs on the oracle tier: the emitted change
-    # JSON is backend-independent, and building n_actors peers on the
-    # device tier would pay thousands of (tunnel) device round trips in
-    # untimed setup. The timed merge below still runs the device tier.
-    from automerge_tpu.backend import facade as oracle_backend
+                   for i in range(n_cards)]}))
     base_changes = am.get_all_changes(base)
-    changes_per_actor = []
+    all_changes = []
     for a in range(n_actors):
         peer = am.apply_changes(
             am.init({"actorId": f"actor-{a:05d}",
                      "backend": oracle_backend.Backend}), base_changes)
-        k = a % 10
+        k = a % n_cards
         if a % 3 == 0:
             peer2 = am.change(peer, lambda d, k=k: d["cards"][k]["tasks"]
                               .append(f"new-{a}"))
@@ -166,14 +162,46 @@ def config4_trellis(n_actors: int = 1000, quick: bool = False):
         else:
             peer2 = am.change(peer, lambda d, k=k: d["cards"][k]["tasks"]
                               .__delitem__(0))
-        changes_per_actor.append(am.get_changes(base, peer2))
-    all_changes = [c for cs in changes_per_actor for c in cs]
+        all_changes.extend(am.get_changes(base, peer2))
     n_ops = sum(len(c["ops"]) for c in all_changes)
+    return base, all_changes, n_ops
+
+
+def config4_trellis(n_actors: int = 1000, quick: bool = False):
+    """Trellis-style nested cards[]/tasks[]: n_actors concurrent actors do
+    mixed insert/update/delete on a shared board, merged on the DEVICE
+    nested-document tier (asserted: no graduation). Since the stacked
+    multi-object tier (engine/stacked.py, INTERNALS §12) the row also
+    records the merge's device-dispatch terms — dispatch_per_op and the
+    per-round stacked stats — so the old ~270-device_put per-object
+    ceiling and its removal are both machine-visible, and the stacked
+    path's object-count-independent budget is ASSERTED in the run."""
+    import automerge_tpu as am
+    from automerge_tpu import frontend as Frontend
+    from automerge_tpu.backend import device as device_backend
+    from automerge_tpu.engine import accounting, stacked
+
+    if quick:
+        n_actors = 100
+    base, all_changes, n_ops = trellis_changes(n_actors)
 
     device_backend.GRADUATION_STATS.clear()
+    acct: dict = {}
 
     def run():
-        merged = am.apply_changes(base, all_changes)
+        from automerge_tpu.engine.accounting import labeled_snapshot
+        stacked.LAST_STATS.clear()
+        before = labeled_snapshot()["dispatch"]
+        with accounting.track() as tr:
+            merged = am.apply_changes(base, all_changes)
+        after = labeled_snapshot()["dispatch"]
+        acct["merge_dispatches"] = tr.thread_stats["dispatches"]
+        acct["merge_syncs"] = tr.thread_stats["syncs"]
+        acct["labels"] = {
+            lbl: agg["n"] - before.get(lbl, {}).get("n", 0)
+            for lbl, agg in after.items()
+            if agg["n"] - before.get(lbl, {}).get("n", 0) > 0}
+        acct["stacked"] = dict(stacked.LAST_STATS)
         assert len(am.to_json(merged)["cards"]) == 10
         # path assertion: the nested board was served by the device tier
         assert isinstance(Frontend.get_backend_state(merged),
@@ -181,8 +209,32 @@ def config4_trellis(n_actors: int = 1000, quick: bool = False):
         assert device_backend.GRADUATION_STATS == {}
 
     dt = timed(run, warmups=0, reps=1)
+    st = acct["stacked"]
+    extra = {}
+    if st:
+        # the tentpole's acceptance criterion, enforced in the recorded
+        # run itself: dispatches <= 8 + 16/round, object-count-independent
+        stacked.assert_round_budget(st)
+        extra["stacked"] = st
+        extra["dispatch_per_round"] = round(
+            st["dispatches"] / max(1, st["rounds"]), 2)
+        extra["dispatch_budget"] = (
+            "asserted in code: stacked merge <= "
+            f"{stacked.APPLY_DISPATCH_BASE} + "
+            f"{stacked.PASS_DISPATCH_BUDGET} device programs per "
+            "round-pass (>= 1 pass per causal round), independent of "
+            "object count (engine/stacked.py)")
+    else:
+        extra["dispatch_budget"] = ("per-object comparator "
+                                    "(AMTPU_STACKED_ROUNDS=0): unbudgeted")
     emit(f"cfg4_trellis_nested_{n_actors}_actors", n_ops / dt, "ops/s",
-         tier="device", threshold=TRACKING_ONLY)
+         tier="device",
+         merge_dispatch_total=acct["merge_dispatches"],
+         dispatch_per_op=round(acct["merge_dispatches"] / n_ops, 4),
+         merge_sync_total=acct["merge_syncs"],
+         dispatch_labels=acct["labels"],
+         **extra,
+         threshold=TRACKING_ONLY)
 
 
 def config6_conflict_heavy(n_actors: int = 200, n_targets: int = 500):

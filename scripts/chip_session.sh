@@ -98,6 +98,11 @@ if [ "$LOG_LINES_AFTER" -le "$LOG_LINES_BEFORE" ] && [ "${AMTPU_SESSION_DRYRUN:-
   echo "WARNING: headline steps appended nothing to $SESSIONS_LOG (tunnel drop mid-run?); these runs are NOT promotable" >> "$LOG"
 fi
 run "planned_ab" 900 python profile_bench.py --planned
+# cfg4 stacked-rounds A/B (ISSUE 7 re-measure hook): dispatch-count AND
+# wall-clock delta of one-dispatch-per-round vs per-(object, round) on a
+# real accelerator, appended to BENCH_SESSIONS.jsonl (the cpu rows only
+# prove the dispatch cut; the time payoff is per-dispatch link overhead)
+run "cfg4_stacked_ab" 600 python -m benchmarks.cfg4_smoke --record-session
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu

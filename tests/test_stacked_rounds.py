@@ -1,0 +1,311 @@
+"""Stacked multi-object rounds vs the per-object path (INTERNALS §12).
+
+The stacked executor (engine/stacked.py, the AMTPU_STACKED_ROUNDS
+default) must produce EXACTLY the per-object path's committed state on
+every nested-document delivery: same materialized document, same
+serialized change log, same per-object engine registers/conflicts/
+clocks — across out-of-order chunked deliveries, duplicates, mixed
+map+text objects, multi-round causal chains, and BOTH host planners
+(AMTPU_COLUMNAR_PLAN 0/1). Plus the tentpole's accounting contract:
+a cfg4-shaped commit dispatches a constant number of device programs
+per causal round, independent of object count."""
+
+import json
+import os
+import random
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import frontend as Frontend
+from automerge_tpu._common import ROOT_ID
+from automerge_tpu.backend import device as device_backend
+from automerge_tpu.backend import facade as oracle_backend
+from automerge_tpu.engine import stacked
+from automerge_tpu.engine.map_doc import DeviceMapDoc
+
+
+@pytest.fixture(autouse=True)
+def _small_gate(monkeypatch):
+    """Engage the stacked path at test scale (the production gate skips
+    tiny interactive rounds)."""
+    monkeypatch.setenv("AMTPU_STACKED_MIN_OPS", "1")
+
+
+# ---------------------------------------------------------------------------
+# randomized nested-board generation (oracle-minted, so every delivery
+# is valid; parity shares ONE change set across both paths)
+# ---------------------------------------------------------------------------
+
+
+def make_board(n_cards=4):
+    return am.change(am.init("base"), lambda d: d.update(
+        {"cards": [{"title": f"card{i}", "meta": {"prio": i},
+                    "tasks": [f"t{j}" for j in range(3)]}
+                   for i in range(n_cards)],
+         "name": "board"}))
+
+
+def rand_peer_changes(rng, base, n_actors=10, n_cards=4, chained=False):
+    """Concurrent peer edits over the shared board: task appends/inserts/
+    deletes (text-tier lists), title/meta register writes and deletes
+    (map tier), root-key writes — the cfg4 mixed shape. `chained` makes
+    every peer emit TWO causally chained changes, forcing multi-round
+    stacked schedules."""
+    base_changes = am.get_all_changes(base)
+    out = []
+    for a in range(n_actors):
+        peer = am.apply_changes(
+            am.init({"actorId": f"actor-{a:05d}",
+                     "backend": oracle_backend.Backend}), base_changes)
+        k = rng.randrange(n_cards)
+        r = rng.random()
+        if r < 0.3:
+            p2 = am.change(peer, lambda d, k=k, a=a:
+                           d["cards"][k]["tasks"].append(f"new-{a}"))
+        elif r < 0.45:
+            p2 = am.change(peer, lambda d, k=k, a=a:
+                           d["cards"][k]["tasks"].insert(0, f"front-{a}"))
+        elif r < 0.6:
+            p2 = am.change(peer, lambda d, k=k:
+                           d["cards"][k]["tasks"].__delitem__(0))
+        elif r < 0.75:
+            p2 = am.change(peer, lambda d, k=k, a=a:
+                           d["cards"][k].__setitem__("title", f"re-{a}"))
+        elif r < 0.85:
+            p2 = am.change(peer, lambda d, k=k, a=a:
+                           d["cards"][k]["meta"].__setitem__("prio", a))
+        else:
+            p2 = am.change(peer, lambda d, a=a:
+                           d.__setitem__("name", f"board-{a}"))
+        if chained:
+            p2 = am.change(p2, lambda d, k=k, a=a:
+                           d["cards"][k]["tasks"].append(f"second-{a}"))
+        out.append(am.get_changes(base, p2))
+    return out
+
+
+def engine_state(doc):
+    """Everything the committed per-object device state consists of."""
+    state = Frontend.get_backend_state(doc)
+    assert isinstance(state, device_backend.DeviceBackendState), \
+        "document unexpectedly graduated off the device tier"
+    core = state._core
+    core.flush_pending()
+    out = {"clock": dict(core.clock), "deps": dict(core.deps),
+           "order": list(core.obj_order)}
+    wrappers = {ROOT_ID: core.root}
+    wrappers.update(core.objects)
+    for oid, w in wrappers.items():
+        d = w.doc
+        if isinstance(d, DeviceMapDoc):
+            out[oid] = {
+                "kind": w.kind,
+                "items": d.to_dict(),
+                "conflicts": {k: d.conflicts_for(k) for k in d._key_slot
+                              if d.conflicts_for(k)},
+                "clock": dict(d.clock),
+            }
+        else:
+            out[oid] = {
+                "kind": w.kind,
+                "values": d.values(),
+                "elem_ids": d.elem_ids(),
+                "conflicts": {i: d.conflicts_at(i)
+                              for i in range(len(d))
+                              if d.conflicts_at(i)},
+                "clock": dict(d.clock),
+            }
+    return out
+
+
+def apply_with(flag, base, deliveries, monkeypatch):
+    monkeypatch.setenv("AMTPU_STACKED_ROUNDS", flag)
+    doc = base
+    for chunk in deliveries:
+        doc = am.apply_changes(doc, chunk)
+    return doc
+
+
+def canon(doc):
+    return json.dumps(am.to_json(doc), sort_keys=True, default=str)
+
+
+# ---------------------------------------------------------------------------
+# parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("columnar", ["1", "0"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_board_parity(seed, columnar, monkeypatch):
+    """Randomized mixed map+text board merges: stacked and per-object
+    paths commit byte-identical state under both host planners."""
+    monkeypatch.setenv("AMTPU_COLUMNAR_PLAN", columnar)
+    rng = random.Random(seed)
+    base = make_board()
+    changes = [c for cs in rand_peer_changes(rng, base, n_actors=12)
+               for c in cs]
+    deliveries = [list(changes)]
+    stacked.LAST_STATS.clear()
+    d1 = apply_with("1", base, deliveries, monkeypatch)
+    assert stacked.LAST_STATS, "stacked path did not engage"
+    d0 = apply_with("0", base, deliveries, monkeypatch)
+    assert canon(d1) == canon(d0)
+    assert am.save(d1) == am.save(d0)
+    assert engine_state(d1) == engine_state(d0)
+    stacked.assert_round_budget()
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_out_of_order_dup_chunked_parity(seed, monkeypatch):
+    """Shuffled chunked deliveries with duplicates: core admission queues
+    premature changes and skips dups; the stacked engine must commit the
+    same state as the per-object path through every partial apply."""
+    rng = random.Random(seed)
+    base = make_board()
+    per_peer = rand_peer_changes(rng, base, n_actors=10, chained=True)
+    changes = [c for cs in per_peer for c in cs]
+    rng.shuffle(changes)                       # out-of-order delivery
+    for _ in range(3):                         # duplicated deliveries
+        changes.insert(rng.randrange(len(changes) + 1),
+                       dict(rng.choice(changes)))
+    chunks = []
+    i = 0
+    while i < len(changes):
+        n = rng.randrange(1, 8)
+        chunks.append(changes[i: i + n])
+        i += n
+    d1 = apply_with("1", base, chunks, monkeypatch)
+    d0 = apply_with("0", base, chunks, monkeypatch)
+    assert canon(d1) == canon(d0)
+    assert am.save(d1) == am.save(d0)
+    assert engine_state(d1) == engine_state(d0)
+
+
+def test_multi_round_causal_chains_parity(monkeypatch):
+    """Every peer emits two causally chained changes in one delivery:
+    per-object admission schedules >= 2 rounds and the stacked engine
+    must execute them as ordered stacked passes."""
+    rng = random.Random(7)
+    base = make_board()
+    changes = [c for cs in rand_peer_changes(rng, base, n_actors=8,
+                                             chained=True)
+               for c in cs]
+    stacked.LAST_STATS.clear()
+    d1 = apply_with("1", base, [changes], monkeypatch)
+    assert stacked.LAST_STATS.get("rounds", 0) >= 2
+    d0 = apply_with("0", base, [changes], monkeypatch)
+    assert canon(d1) == canon(d0)
+    assert engine_state(d1) == engine_state(d0)
+    stacked.assert_round_budget()
+
+
+def test_interactive_then_flush_parity(monkeypatch):
+    """Write-behind fast-path rounds (cached routing triples) followed by
+    a remote delivery that flushes them: the flush replays through
+    `_distribute(routed=...)` without re-walking ops, on both paths."""
+    def run(flag):
+        monkeypatch.setenv("AMTPU_STACKED_ROUNDS", flag)
+        base = make_board()
+        doc = am.change(base, lambda d: d["cards"][0]
+                        .__setitem__("title", "local-edit"))
+        doc = am.change(doc, lambda d: d["cards"][1]["meta"]
+                        .__setitem__("prio", 99))
+        core = Frontend.get_backend_state(doc)._core
+        assert core.pending, "fast path did not engage"
+        assert len(core._pending_routed) == len(core.pending)
+        peer = am.apply_changes(
+            am.init({"actorId": "remote-peer",
+                     "backend": oracle_backend.Backend}),
+            am.get_all_changes(base))
+        p2 = am.change(peer, lambda d: d["cards"][2]["tasks"]
+                       .append("remote-task"))
+        doc = am.apply_changes(doc, am.get_changes(base, p2))
+        core = Frontend.get_backend_state(doc)._core
+        assert not core._pending_routed
+        return doc
+    d1, d0 = run("1"), run("0")
+    assert canon(d1) == canon(d0)
+
+
+# ---------------------------------------------------------------------------
+# the accounting contract (the tentpole's acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def _board_merge_stats(n_cards, n_actors, monkeypatch):
+    monkeypatch.setenv("AMTPU_STACKED_ROUNDS", "1")
+    base = make_board(n_cards=n_cards)
+    base_changes = am.get_all_changes(base)
+    changes = []
+    for a in range(n_actors):
+        peer = am.apply_changes(
+            am.init({"actorId": f"actor-{a:05d}",
+                     "backend": oracle_backend.Backend}), base_changes)
+        k = a % n_cards
+        if a % 2:
+            p2 = am.change(peer, lambda d, k=k, a=a:
+                           d["cards"][k]["tasks"].append(f"n-{a}"))
+        else:
+            p2 = am.change(peer, lambda d, k=k, a=a:
+                           d["cards"][k].__setitem__("title", f"r-{a}"))
+        changes.extend(am.get_changes(base, p2))
+    stacked.LAST_STATS.clear()
+    am.apply_changes(base, changes)
+    assert stacked.LAST_STATS, "stacked path did not engage"
+    return dict(stacked.LAST_STATS)
+
+
+def test_dispatch_budget_object_count_independent(monkeypatch):
+    """THE acceptance criterion: a cfg4-shaped commit executes <= a
+    constant number of device dispatches per causal round, independent
+    of object count — tripling the board's object population must not
+    change the dispatch count at all (same round/shape structure)."""
+    small = _board_merge_stats(n_cards=4, n_actors=8, monkeypatch=monkeypatch)
+    large = _board_merge_stats(n_cards=12, n_actors=24,
+                               monkeypatch=monkeypatch)
+    assert large["docs"] > 2 * small["docs"]
+    assert small["passes"] == large["passes"] == 1
+    assert large["dispatches"] == small["dispatches"], (
+        f"dispatches scaled with object count: "
+        f"{small['docs']} objs -> {small['dispatches']}, "
+        f"{large['docs']} objs -> {large['dispatches']}")
+    for s in (small, large):
+        limit = (stacked.APPLY_DISPATCH_BASE
+                 + stacked.PASS_DISPATCH_BUDGET * s["passes"])
+        assert s["dispatches"] <= limit
+        assert s["syncs"] <= 2 + 2 * s["passes"]
+
+
+def test_stacked_spans_recorded(monkeypatch):
+    """The new path is observable: plan/stack + commit/stacked_round
+    spans and the stacked kernel dispatch counters reach the flight
+    recorder (PR-6 tier)."""
+    from automerge_tpu import obs
+    monkeypatch.setenv("AMTPU_STACKED_ROUNDS", "1")
+    rng = random.Random(11)
+    base = make_board()
+    changes = [c for cs in rand_peer_changes(rng, base, n_actors=8)
+               for c in cs]
+    with obs.tracing():
+        am.apply_changes(base, changes)
+        rec = obs.recorder()
+        names = {(r[obs.CAT], r[obs.NAME]) for r in rec.snapshot()}
+        counters = obs.metrics_snapshot()["counters"]
+    assert ("plan", "stack") in names
+    assert ("commit", "stacked_round") in names
+    assert any(k.startswith("device.dispatch:stacked_")
+               for k in counters), counters
+
+
+def test_per_object_comparator_unchanged(monkeypatch):
+    """AMTPU_STACKED_ROUNDS=0 never enters the stacked engine."""
+    monkeypatch.setenv("AMTPU_STACKED_ROUNDS", "0")
+    rng = random.Random(13)
+    base = make_board()
+    changes = [c for cs in rand_peer_changes(rng, base, n_actors=6)
+               for c in cs]
+    stacked.LAST_STATS.clear()
+    am.apply_changes(base, changes)
+    assert not stacked.LAST_STATS
