@@ -38,9 +38,15 @@ Category taxonomy (full schema in docs/INTERNALS.md §11):
   ring    PipelinedIngestor slot lifecycle (plan/commit spans,
           fallback/serial/abort events, gen + slot tags)
   pull    text materialization pulls (mode + byte counts)
-  chan    ResilientChannel (retransmit / dup_drop / window_drop ...)
+  chan    ResilientChannel (retransmit / dup_drop / window_drop /
+          backpressure / dead ...)
   chaos   ChaosLink fault injections (drop / dup / reorder / delay ...)
-  quar    quarantine admits / evictions / releases
+  quar    quarantine admits / evictions (incl. tenant-attributed
+          evict_pressure + dead-peer evict_peer) / releases
+  sync    hub snapshot bootstrap (snapshot_capture / serve_cached —
+          the join-storm coalescing ratio)
+  svc     service tier: tick spans, shed / defer / suspect / evict /
+          join / rejoin / protocol_error events (INTERNALS §13)
   ckpt    checkpoint writer (grab spans, conflicts, degrades)
   bench   harness-side regions (stream reps, explicit device waits)
 """

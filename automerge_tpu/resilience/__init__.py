@@ -15,7 +15,7 @@ Four pieces (see docs/INTERNALS.md §7):
   ``{docId, clock, changes?}`` protocol survive it.
 """
 
-from .errors import CheckpointError, ProtocolError  # noqa: F401
+from .errors import CheckpointError, PeerDeadError, ProtocolError  # noqa: F401
 from .validation import (  # noqa: F401
     validate_change, validate_changes, validate_clock, validate_msg,
     validate_op, validate_save_payload,

@@ -30,7 +30,7 @@ import operator
 import numpy as np
 
 from .. import obs
-from .errors import ProtocolError
+from .errors import PeerDeadError, ProtocolError
 
 ENVELOPE_KINDS = ("data", "ack")
 
@@ -65,42 +65,68 @@ def validate_envelope(env) -> dict:
 #: in-order release drains the window.
 RECV_WINDOW = 1024
 
+#: Default retransmit budget PER ENVELOPE. With exponential backoff this
+#: spans hundreds of rounds of sustained silence — far beyond any fault
+#: the chaos profiles inject against a live peer — so a legitimate slow
+#: or partitioned peer never trips it, while a vanished peer stops
+#: costing timer work and send-window memory in bounded time. The
+#: service tier configures a tighter cap (its heartbeat path usually
+#: declares death first; this is the backstop).
+MAX_RETRIES = 64
+
 
 class ResilientChannel:
     def __init__(self, send_raw, deliver, *, seed: int = 0,
                  base_rto: int = 2, max_rto: int = 16,
-                 recv_window: int = RECV_WINDOW):
+                 recv_window: int = RECV_WINDOW,
+                 max_retries: int = MAX_RETRIES,
+                 on_dead=None, admit=None):
         self._send_raw = send_raw
         self._deliver = deliver
         self._rng = np.random.default_rng(seed)
         self._base_rto = base_rto
         self._max_rto = max_rto
         self._recv_window = recv_window
+        self._max_retries = max_retries
+        self._on_dead = on_dead
+        self._admit = admit           # credit gate: un-acked drop when falsy
         self._round = 0
         self._next_seq = 1
-        self._unacked: dict = {}      # seq -> {"payload", "due", "rto"}
+        self._unacked: dict = {}      # seq -> {"payload","due","rto","tries"}
         self._recv_high = 0           # highest contiguously delivered seq
         self._recv_buf: dict = {}     # out-of-order seq -> payload
+        self.dead = False
         self.stats = {"sent": 0, "retransmits": 0, "acks_sent": 0,
                       "dup_dropped": 0, "held_out_of_order": 0,
                       "window_dropped": 0, "delivered": 0,
-                      "deliver_errors": 0}
+                      "deliver_errors": 0, "backpressured": 0,
+                      "dead": False}
 
     # -- outbound -------------------------------------------------------
 
     def send(self, payload):
+        if self.dead:
+            raise PeerDeadError(
+                "channel is dead (retransmit cap exhausted); reconnect "
+                "with a fresh channel")
         seq = self._next_seq
         self._next_seq += 1
         self._unacked[seq] = {"payload": payload,
                               "due": self._round + self._base_rto,
-                              "rto": self._base_rto}
+                              "rto": self._base_rto, "tries": 0}
         self.stats["sent"] += 1
         self._send_raw({"kind": "data", "seq": seq,
                         "ack": self._recv_high, "payload": payload})
 
     def tick(self):
         """Advance one time round; retransmit overdue unacked envelopes
-        with exponential backoff + deterministic jitter."""
+        with exponential backoff + deterministic jitter. An envelope that
+        exhausts ``max_retries`` declares the PEER dead: retransmission
+        stops, the send window is dropped (bounded-memory reclaim), and
+        the death surfaces through ``on_dead`` when installed, else as a
+        typed :class:`PeerDeadError` — never a silent retry-forever."""
+        if self.dead:
+            return
         self._round += 1
         for seq in sorted(self._unacked):
             # a synchronous transport can ack DURING this loop (the
@@ -110,6 +136,10 @@ class ResilientChannel:
             entry = self._unacked.get(seq)
             if entry is None or entry["due"] > self._round:
                 continue
+            if entry["tries"] >= self._max_retries:
+                self._declare_dead(seq, entry["tries"])
+                return
+            entry["tries"] += 1
             entry["rto"] = min(entry["rto"] * 2, self._max_rto)
             jitter = int(self._rng.integers(0, max(2, entry["rto"] // 2)))
             entry["due"] = self._round + entry["rto"] + jitter
@@ -120,6 +150,19 @@ class ResilientChannel:
             self._send_raw({"kind": "data", "seq": seq,
                             "ack": self._recv_high,
                             "payload": entry["payload"]})
+
+    def _declare_dead(self, seq: int, tries: int):
+        self.dead = True
+        self.stats["dead"] = True
+        self._unacked.clear()         # no resurrection: reclaim the window
+        if obs.ENABLED:
+            obs.event("chan", "dead", args={"seq": seq, "tries": tries})
+        if self._on_dead is not None:
+            self._on_dead(self)
+        else:
+            raise PeerDeadError(
+                f"peer unresponsive: envelope seq={seq} retransmitted "
+                f"{tries} times without an ack")
 
     # -- inbound --------------------------------------------------------
 
@@ -143,6 +186,16 @@ class ResilientChannel:
             self.stats["window_dropped"] += 1
             if obs.ENABLED:
                 obs.event("chan", "window_drop", args={"seq": seq})
+            return
+        elif self._admit is not None and not self._admit(env):
+            # credit-based flow control (the service tier's backpressure
+            # path): no credit -> the frame drops UN-acked, so the
+            # sender's own retransmit timer redelivers it once credit
+            # frees — the over-budget peer slows down instead of growing
+            # an unbounded server-side queue
+            self.stats["backpressured"] += 1
+            if obs.ENABLED:
+                obs.event("chan", "backpressure", args={"seq": seq})
             return
         else:
             self._recv_buf[seq] = env["payload"]

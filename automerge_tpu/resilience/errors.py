@@ -25,6 +25,19 @@ class ProtocolError(ValueError):
     """
 
 
+class PeerDeadError(ProtocolError):
+    """A peer exhausted its retransmit budget and was declared dead.
+
+    Raised by :class:`~.channel.ResilientChannel` when one envelope has
+    been retransmitted ``max_retries`` times without an ack (or surfaced
+    through the channel's ``on_dead`` callback instead, when one is
+    installed — the service tier's peer-health path). A dead channel
+    stops retransmitting and drops its send window, so a vanished peer
+    cannot pin memory or timer work forever; recovery is a NEW channel
+    (peer reconnect / service rejoin), never resurrection of this one.
+    """
+
+
 class CheckpointError(ProtocolError):
     """A checkpoint bundle failed structural or integrity validation.
 

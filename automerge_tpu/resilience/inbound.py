@@ -88,28 +88,66 @@ class InboundGate:
         self._n_parked = 0                # total across all docs
         self._busy: set = set()           # re-entrancy guard (doc ids)
         self.stats = {"delivered": 0, "parked_rejected": 0,
-                      "global_evicted": 0}   # per-doc quarantine stats
+                      "global_evicted": 0,
+                      "peak_parked": 0}      # per-doc quarantine stats
         # live on the queues (see quarantine_stats)
 
     # -- public entry points -------------------------------------------
 
-    def deliver(self, doc_id: str, changes, validated: bool = False):
+    def deliver(self, doc_id: str, changes, validated: bool = False,
+                sender=None):
         """Apply one inbound delivery; returns the (possibly unchanged)
         document. Premature changes park; parked changes whose deps this
-        delivery satisfied apply in the same call."""
+        delivery satisfied apply in the same call.
+
+        ``sender`` attributes the delivery to a transport peer / service
+        tenant for quarantine accounting: either one id for the whole
+        batch, or a list aligned with `changes` (the service tier's
+        grouped cross-tenant admission). Attribution powers the
+        ``quar/evict_pressure`` events and dead-peer reclamation
+        (:meth:`evict_sender`)."""
         if not validated:
             changes = validate_changes(changes, strict=True)
+        senders = self._sender_map(changes, sender)
         if doc_id in self._busy:
             # re-entrant delivery (a change handler fed back into the
             # gate): park everything; the outer drain picks it up
             for change in changes:
-                self._park(doc_id, change)
+                self._park(doc_id, change, sender=senders.get(id(change)))
             return self._doc_set.get_doc(doc_id)
         self._busy.add(doc_id)
         try:
-            return self._drain_loop(doc_id, changes)
+            return self._drain_loop(doc_id, changes, senders)
         finally:
             self._busy.discard(doc_id)
+
+    @staticmethod
+    def _sender_map(changes, sender) -> dict:
+        """id(change) -> sender for this delivery (objects are alive for
+        the whole call, so identity keys are safe for unhashable change
+        dicts)."""
+        if sender is None:
+            return {}
+        if isinstance(sender, (list, tuple)):
+            return {id(c): s for c, s in zip(changes, sender)}
+        return {id(c): sender for c in changes}
+
+    def evict_sender(self, sender) -> int:
+        """Reclaim every parked change attributed to `sender` across all
+        docs (dead-peer eviction). Empty queues drop with their
+        bookkeeping; returns the number of changes reclaimed."""
+        dropped = 0
+        for doc_id in list(self._quarantine):
+            q = self._quarantine[doc_id]
+            dropped += q.drop_sender(sender)
+            if not len(q):
+                del self._quarantine[doc_id]
+        if dropped:
+            self._n_parked -= dropped
+            if obs.ENABLED:
+                obs.event("quar", "evict_peer",
+                          args={"tenant": sender, "n": dropped}, n=dropped)
+        return dropped
 
     def release(self, doc_id: str):
         """Retry parked changes for a doc whose clock advanced outside the
@@ -161,7 +199,8 @@ class InboundGate:
         state = Frontend.get_backend_state(doc)
         return dict(state.clock) if state is not None else {}
 
-    def _park(self, doc_id: str, change: dict, requeue: bool = False):
+    def _park(self, doc_id: str, change: dict, requeue: bool = False,
+              sender=None):
         q = self._quarantine.get(doc_id)
         if q is None:
             q = self._quarantine[doc_id] = QuarantineQueue(self._capacity)
@@ -180,36 +219,43 @@ class InboundGate:
             if not len(victim) and victim_id != doc_id:
                 del self._quarantine[victim_id]
         before = len(q)
-        q.park(change, requeue=requeue)
+        q.park(change, requeue=requeue, sender=sender)
         self._n_parked += len(q) - before
+        if self._n_parked > self.stats["peak_parked"]:
+            self.stats["peak_parked"] = self._n_parked
 
-    def _drain_loop(self, doc_id: str, incoming):
+    def _drain_loop(self, doc_id: str, incoming, senders=None):
         """Drain until quiescent: a change handler may feed further
         deliveries for the SAME doc back into the gate mid-apply (they
         park via the re-entrancy branch), and the batch just applied can
         make them ready — so keep draining while progress is made and the
         quarantine is non-empty."""
-        doc, applied = self._drain(doc_id, incoming)
+        senders = senders or {}
+        doc, applied = self._drain(doc_id, incoming, senders)
         while applied:
             q = self._quarantine.get(doc_id)
             if q is None or not len(q):
                 break
-            doc, applied = self._drain(doc_id, ())
+            doc, applied = self._drain(doc_id, (), {})
         q = self._quarantine.get(doc_id)
         if q is not None and not len(q) \
                 and len(self._quarantine) > _MAX_IDLE_QUEUES:
             del self._quarantine[doc_id]   # keep the tracking dict bounded
         return doc
 
-    def _drain(self, doc_id: str, incoming):
+    def _drain(self, doc_id: str, incoming, senders):
         pool = list(incoming)
         q = self._quarantine.get(doc_id)
         drained_keys: set = set()
         if q is not None and len(q):
-            drained = q.drain()
+            drained = q.drain_items()
             self._n_parked -= len(drained)
-            drained_keys = {(c["actor"], c["seq"]) for c in drained}
-            pool.extend(drained)
+            drained_keys = {(c["actor"], c["seq"]) for c, _ in drained}
+            senders = dict(senders)
+            for change, sender in drained:
+                pool.append(change)
+                if sender is not None:
+                    senders[id(change)] = sender
         # one admission pass: a change is ready when the doc clock plus the
         # changes already admitted from this pool cover its deps (the
         # backends' own fixpoint drain, run here so the leftovers can park
@@ -235,7 +281,8 @@ class InboundGate:
         for change in rest:
             self._park(doc_id, change,
                        requeue=(change["actor"],
-                                change["seq"]) in drained_keys)
+                                change["seq"]) in drained_keys,
+                       sender=senders.get(id(change)))
         if not ready:
             return self._doc_set.get_doc(doc_id), 0
         try:
@@ -244,7 +291,7 @@ class InboundGate:
             # only backend REJECTION triggers isolation; a handler
             # exception (non-ProtocolError) means the batch applied and
             # must propagate as-is, never re-applied
-            return self._isolate(doc_id, ready, drained_keys)
+            return self._isolate(doc_id, ready, drained_keys, senders)
         if drained_keys:
             released = sum(1 for c in ready
                            if (c["actor"], c["seq"]) in drained_keys)
@@ -256,7 +303,8 @@ class InboundGate:
         self.stats["delivered"] += len(ready)
         return doc, len(ready)
 
-    def _isolate(self, doc_id: str, ready: list, drained_keys: set):
+    def _isolate(self, doc_id: str, ready: list, drained_keys: set,
+                 senders=None):
         """A rejected batch: salvage every valid change, drop only the
         poison. Transports ack on first delivery and the hub advances
         believed clocks optimistically on send, so a valid change lost to
@@ -271,13 +319,15 @@ class InboundGate:
         (valid) sender."""
         n_ok = 0
         incoming_err = None
+        senders = senders or {}
         for change in ready:
             key = (change["actor"], change["seq"])
             if not _ready_under(change, self._clock(doc_id)):
                 # its dep was rejected above: premature again, park it
                 # (never feed it to the backend, whose internal queue is
                 # unbounded)
-                self._park(doc_id, change, requeue=key in drained_keys)
+                self._park(doc_id, change, requeue=key in drained_keys,
+                           sender=senders.get(id(change)))
                 continue
             try:
                 self._apply(doc_id, [change])

@@ -1,0 +1,503 @@
+"""Multi-tenant sync service front end (INTERNALS §13).
+
+``SyncService`` turns the in-process sync stack — ``SyncHub`` fan-out,
+``ResilientChannel`` transport reliability, the validated + quarantined
+``InboundGate`` — into a serving tier that multiplexes thousands of tenant
+sessions, where every resource is explicitly bounded and every failure mode
+has a typed, observable, per-tenant degradation path.
+
+Architecture decisions (the why, not just the what):
+
+- **Rooms shard the hub.** One global ``SyncHub`` over N thousand peers is
+  architecturally impossible: its ``ClockMatrix`` is DENSE over
+  (peers x docs x actors), so 1000 peers x 250 docs x 1000 actors is
+  terabytes. A *room* (one doc group) carries its own DocSet + hub +
+  inbound gate, bounding each matrix to the room's members and making
+  tenant eviction a room-local operation. Cross-room tenants are just
+  multiple sessions.
+- **Backpressure lives on the ack path.** A tenant's channel frames are
+  admitted against inbox credit (``TenantBudget.inbox_cap``); beyond it
+  they drop UN-acked, so the sender's own retransmit backoff throttles it.
+  The server never queues unboundedly on behalf of a peer — over-budget
+  tenants slow down; nobody else notices.
+- **One tick, one flush, one decode.** Admission across tenants batches
+  per (room, doc): all changes admitted this tick deliver through the
+  gate as ONE batch (a single backend apply, which is a single columnar
+  wire decode on the >=64-op engine path), and every room hub runs the
+  tick inside ``hub.batched()`` so N deliveries + clock reveals cost one
+  vectorized flush per room — the PR-5 planner amortized across tenants.
+- **Degradation ladder** (each rung typed + counted + obs-evented, and
+  strictly per-tenant): budget deferral (``svc/defer``) -> deadline shed
+  of the lowest-priority tail (``svc/shed``) -> credit exhaustion
+  (``chan/backpressure``) -> quarantine pressure eviction
+  (``quar/evict_pressure``) -> peer-death declaration and full state
+  reclamation (``svc/evict``: hub peer + ClockMatrix slot + quarantined
+  changes attributed to the tenant).
+- **Peer health is a state machine**, not a timeout scattered across call
+  sites: LIVE -> SUSPECT (owed acks + silence) -> DEAD (grace expired),
+  with the channel's retransmit cap (``PeerDeadError`` path) as the
+  backstop that can jump straight to DEAD. Rejoins are first-class: a
+  dead tenant reconnects fresh and bootstraps from the hub's cached
+  snapshot bundle — one encode serves a whole join storm.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from contextlib import ExitStack
+
+from .. import obs
+from ..resilience.channel import ResilientChannel
+from ..resilience.errors import ProtocolError
+from ..resilience.inbound import InboundGate
+from ..resilience.validation import validate_msg
+from ..sync.doc_set import DocSet
+from ..sync.hub import SyncHub
+from .budget import ServiceConfig, TenantBudget, approx_msg_bytes
+
+LIVE, SUSPECT, DEAD = "live", "suspect", "dead"
+
+
+class Room:
+    """One doc group's serving shard: DocSet + hub + bounded gate."""
+
+    __slots__ = ("room_id", "doc_set", "hub", "gate", "tenants")
+
+    def __init__(self, room_id: str, config: ServiceConfig):
+        self.room_id = room_id
+        self.doc_set = DocSet()
+        self.gate = InboundGate(
+            self.doc_set, capacity=config.quarantine_capacity,
+            global_capacity=config.quarantine_global_capacity)
+        self.doc_set._inbound_gate = self.gate   # the one shared gate
+        self.hub = SyncHub(self.doc_set)
+        self.doc_set._sync_hub = self.hub        # Connection-compat cache
+        self.hub.open()
+        self.tenants: set = set()
+
+
+class TenantSession:
+    """One tenant's server-side endpoint: channel + inbox + health."""
+
+    __slots__ = ("tenant_id", "room_id", "budget", "channel", "inbox",
+                 "inbox_bytes", "last_inbound_tick", "state", "suspect_at",
+                 "starved_streak", "pending_dead", "stats", "_svc")
+
+    def __init__(self, svc: "SyncService", tenant_id: str, room_id: str,
+                 budget: TenantBudget):
+        self._svc = svc
+        self.tenant_id = tenant_id
+        self.room_id = room_id
+        self.budget = budget
+        self.channel = None            # installed by SyncService.connect
+        self.inbox: deque = deque()    # (msg, nbytes, nops)
+        self.inbox_bytes = 0
+        self.last_inbound_tick = svc._tick_no
+        self.state = LIVE
+        self.suspect_at = 0
+        self.starved_streak = 0
+        self.pending_dead = None       # reason string once doomed
+        self.stats = {"admitted_msgs": 0, "admitted_ops": 0,
+                      "admitted_bytes": 0, "shed": 0, "deferred": 0,
+                      "protocol_errors": 0, "last_admit_tick": 0}
+
+    # the transport-facing inbound entry point for this tenant
+    def on_wire(self, env):
+        # ANY frame — even a bare ack, even one the credit gate then
+        # rejects — proves the peer is alive
+        self.last_inbound_tick = self._svc._tick_no
+        if self.state == SUSPECT:
+            self.state = LIVE
+            if obs.ENABLED:
+                obs.event("svc", "recover", args={"tenant": self.tenant_id})
+        try:
+            self.channel.on_wire(env)
+            rb = len(self.channel._recv_buf)
+            if rb > self._svc.stats["peak_recv_buf"]:
+                self._svc.stats["peak_recv_buf"] = rb
+        except ProtocolError as exc:
+            # per-tenant typed degradation: one malformed message (or a
+            # poison change batch the gate rejected) is counted against
+            # ITS sender and dropped; it never tears down the session,
+            # the tick, or another tenant
+            self.stats["protocol_errors"] += 1
+            self._svc.stats["protocol_errors"] += 1
+            if obs.ENABLED:
+                obs.event("svc", "protocol_error",
+                          args={"tenant": self.tenant_id,
+                                "error": str(exc)[:120]})
+
+    def _admit_frame(self, env) -> bool:
+        """The channel's credit gate: inbox slots are the credit."""
+        if self.pending_dead or self.state == DEAD:
+            return False
+        return len(self.inbox) < self.budget.inbox_cap
+
+    def _enqueue(self, payload):
+        """Channel deliver callback: validate at the service boundary,
+        meter, and queue for the tick scheduler."""
+        msg = validate_msg(payload)
+        changes = msg.get("changes")
+        nops = sum(len(c.get("ops") or []) for c in changes) if changes \
+            else 1
+        nbytes = approx_msg_bytes(msg)
+        self.inbox.append((msg, nbytes, max(1, nops)))
+        self.inbox_bytes += nbytes
+        svc_stats = self._svc.stats
+        if len(self.inbox) > svc_stats["peak_inbox"]:
+            svc_stats["peak_inbox"] = len(self.inbox)
+
+
+class SyncService:
+    def __init__(self, config: ServiceConfig = None):
+        self.config = config or ServiceConfig()
+        self._rooms: dict = {}          # room_id -> Room
+        self._tenants: dict = {}        # tenant_id -> TenantSession
+        self._order: list = []          # admission rotation (tenant ids)
+        self._tick_no = 0
+        self._tick_ms = deque(maxlen=self.config.tick_ring)
+        self.stats = {"ticks": 0, "admitted_msgs": 0, "admitted_ops": 0,
+                      "admitted_bytes": 0, "deferrals": 0, "shed_total": 0,
+                      "evictions": 0, "joins": 0, "rejoins": 0,
+                      "protocol_errors": 0, "max_starved_streak": 0,
+                      "peak_inbox": 0, "peak_parked": 0, "peak_recv_buf": 0,
+                      "backpressured_closed": 0, "retransmits_closed": 0}
+
+    # -- lifecycle ------------------------------------------------------
+
+    def room(self, room_id: str) -> Room:
+        r = self._rooms.get(room_id)
+        if r is None:
+            r = self._rooms[room_id] = Room(room_id, self.config)
+        return r
+
+    def seed_doc(self, room_id: str, doc, doc_id: str = None):
+        """Install an authoritative replica for a room's doc (doc_id
+        defaults to the room id)."""
+        self.room(room_id).doc_set.set_doc(doc_id or room_id, doc)
+
+    def connect(self, tenant_id: str, room_id: str, send_raw, *,
+                budget: TenantBudget = None, seed: int = 0) -> TenantSession:
+        """Attach a tenant session; returns it (feed inbound transport
+        frames to ``session.on_wire``). A same-id reconnect evicts the
+        stale session first — the REJOIN path: the fresh hub peer
+        bootstraps from the cached snapshot bundle like any joiner."""
+        rejoin = tenant_id in self._tenants
+        if rejoin:
+            self.evict(tenant_id, reason="rejoin")
+        cfg = self.config
+        room = self.room(room_id)
+        sess = TenantSession(self, tenant_id, room_id,
+                             budget or cfg.default_budget)
+        sess.channel = ResilientChannel(
+            send_raw, sess._enqueue, seed=seed,
+            base_rto=cfg.base_rto, max_rto=cfg.max_rto,
+            recv_window=cfg.recv_window, max_retries=cfg.max_retries,
+            on_dead=lambda ch, s=sess: self._mark_dead(s, "retransmit_cap"),
+            admit=sess._admit_frame)
+        self._tenants[tenant_id] = sess
+        self._order.append(tenant_id)
+        room.tenants.add(tenant_id)
+        room.hub.add_peer(tenant_id, sess.channel.send)
+        self.stats["rejoins" if rejoin else "joins"] += 1
+        if obs.ENABLED:
+            obs.event("svc", "rejoin" if rejoin else "join",
+                      args={"tenant": tenant_id, "room": room_id})
+        return sess
+
+    def disconnect(self, tenant_id: str):
+        """Graceful leave: same full reclamation as a death eviction."""
+        self.evict(tenant_id, reason="disconnect")
+
+    def _mark_dead(self, sess: TenantSession, reason: str):
+        if sess.pending_dead is None:
+            sess.pending_dead = reason
+
+    def evict(self, tenant_id: str, reason: str):
+        """Reclaim EVERYTHING the tenant pinned: hub peer, ClockMatrix
+        slot (recycled), quarantined changes it delivered, its inbox and
+        channel windows. After this, :meth:`reclaimed` is true."""
+        sess = self._tenants.pop(tenant_id, None)
+        if sess is None:
+            return
+        try:
+            self._order.remove(tenant_id)
+        except ValueError:
+            pass
+        room = self._rooms.get(sess.room_id)
+        dropped = 0
+        if room is not None:
+            room.hub.remove_peer(tenant_id)      # releases the matrix slot
+            dropped = room.gate.evict_sender(tenant_id)
+            room.tenants.discard(tenant_id)
+        self.stats["backpressured_closed"] += \
+            sess.channel.stats["backpressured"]
+        self.stats["retransmits_closed"] += sess.channel.stats["retransmits"]
+        sess.inbox.clear()
+        sess.inbox_bytes = 0
+        sess.state = DEAD
+        self.stats["evictions"] += 1
+        if obs.ENABLED:
+            obs.event("svc", "evict",
+                      args={"tenant": tenant_id, "reason": reason,
+                            "quarantine_dropped": dropped})
+
+    # -- the tick scheduler ---------------------------------------------
+
+    def tick(self):
+        """One scheduler round: budgeted cross-tenant admission (grouped
+        per doc), retransmission, peer-health escalation, evictions, and
+        one deferred hub flush per room."""
+        t0 = obs.now() if obs.ENABLED else 0
+        t_start = time.perf_counter()
+        self._tick_no += 1
+        cfg = self.config
+        deadline = (t_start + cfg.tick_budget_ms / 1e3) \
+            if cfg.tick_budget_ms else None
+        groups: dict = {}       # (room_id, doc_id) -> [changes, senders]
+        shed = 0
+        with ExitStack() as stack:
+            # every room hub defers its flushes to ONE flush per room at
+            # stack exit — the tick's cross-tenant amortization
+            for room in list(self._rooms.values()):
+                stack.enter_context(room.hub.batched())
+            for i, sess in enumerate(self._admission_order()):
+                if sess.pending_dead:
+                    continue
+                backlog = len(sess.inbox)
+                if i and deadline is not None \
+                        and time.perf_counter() >= deadline:
+                    # deadline pressure: the tail of the order — lowest
+                    # priority, modulo the starvation boost — defers
+                    # wholesale to the next tick (work postponed, never
+                    # dropped: the inbox is bounded and credit-gated).
+                    # The FIRST tenant of the rotation is exempt: even a
+                    # pathologically small tick budget admits one tenant
+                    # per tick, so rotation + the starvation boost still
+                    # reach everyone — shed degrades, it never wedges
+                    if backlog:
+                        shed += backlog
+                        sess.stats["shed"] += backlog
+                        self._starve(sess)
+                    continue
+                admitted = self._admit_tenant(sess, groups)
+                if admitted:
+                    sess.starved_streak = 0
+                    sess.stats["last_admit_tick"] = self._tick_no
+                elif backlog:
+                    self._starve(sess)
+            if shed:
+                self.stats["shed_total"] += shed
+                if obs.ENABLED:
+                    obs.event("svc", "shed",
+                              args={"msgs": shed, "tick": self._tick_no},
+                              n=shed)
+            # grouped admission: ONE gate delivery (one backend apply /
+            # columnar decode) per (room, doc) for the whole tick
+            for (room_id, doc_id), (changes, senders) in groups.items():
+                room = self._rooms.get(room_id)
+                if room is None:
+                    continue
+                try:
+                    room.gate.deliver(doc_id, changes, validated=True,
+                                      sender=senders)
+                except ProtocolError as exc:
+                    # the gate already salvaged every valid change and
+                    # parked/dropped the poison with per-sender stats;
+                    # the service just counts the rejection
+                    self.stats["protocol_errors"] += 1
+                    if obs.ENABLED:
+                        obs.event("svc", "reject",
+                                  args={"doc": doc_id,
+                                        "error": str(exc)[:120]})
+            # retransmission (may declare peers dead via on_dead)
+            for sess in list(self._tenants.values()):
+                if not sess.pending_dead:
+                    sess.channel.tick()
+            self._health_pass()
+            for sess in [s for s in list(self._tenants.values())
+                         if s.pending_dead]:
+                self.evict(sess.tenant_id, sess.pending_dead)
+        self._track_bounds()
+        self.stats["ticks"] += 1
+        dt_ms = (time.perf_counter() - t_start) * 1e3
+        self._tick_ms.append(dt_ms)
+        if obs.ENABLED:
+            obs.span("svc", "tick", t0,
+                     args={"tick": self._tick_no, "shed": shed,
+                           "tenants": len(self._tenants)})
+
+    def _starve(self, sess: TenantSession):
+        sess.starved_streak += 1
+        if sess.starved_streak > self.stats["max_starved_streak"]:
+            self.stats["max_starved_streak"] = sess.starved_streak
+
+    def _admission_order(self) -> list:
+        """Rotated round-robin, highest priority first, starvation boost
+        in front: rotation makes the deadline cut fall on a different
+        tenant each tick within a priority class; the boost guarantees a
+        backlogged tenant is visited early after `starvation_boost_ticks`
+        dry ticks regardless of class."""
+        n = len(self._order)
+        if not n:
+            return []
+        off = self._tick_no % n
+        rotated = [self._tenants[t] for t in
+                   self._order[off:] + self._order[:off]
+                   if t in self._tenants]
+        boost_at = self.config.starvation_boost_ticks
+        starved = [s for s in rotated if s.starved_streak >= boost_at]
+        rest = [s for s in rotated if s.starved_streak < boost_at]
+        rest.sort(key=lambda s: -s.budget.priority)   # stable within class
+        return starved + rest
+
+    def _admit_tenant(self, sess: TenantSession, groups: dict) -> int:
+        b = sess.budget
+        ops_left, bytes_left = b.ops_per_tick, b.bytes_per_tick
+        admitted = 0
+        while sess.inbox:
+            msg, nbytes, nops = sess.inbox[0]
+            if admitted and (nops > ops_left or nbytes > bytes_left):
+                # budget exhausted: the remainder defers to later ticks.
+                # (The FIRST message of a visit always admits, so an
+                # oversized message costs one whole tick, never a wedge.)
+                # Both counters count deferral EVENTS (one per tenant per
+                # tick), not backlog sizes — a message waiting N ticks
+                # must not inflate the stat N times over
+                sess.stats["deferred"] += 1
+                self.stats["deferrals"] += 1
+                if obs.ENABLED:
+                    obs.event("svc", "defer",
+                              args={"tenant": sess.tenant_id,
+                                    "backlog": len(sess.inbox)})
+                break
+            sess.inbox.popleft()
+            sess.inbox_bytes -= nbytes
+            self._admit_msg(sess, msg, groups)
+            ops_left -= nops
+            bytes_left -= nbytes
+            admitted += 1
+            sess.stats["admitted_msgs"] += 1
+            sess.stats["admitted_ops"] += nops
+            sess.stats["admitted_bytes"] += nbytes
+            self.stats["admitted_msgs"] += 1
+            self.stats["admitted_ops"] += nops
+            self.stats["admitted_bytes"] += nbytes
+        return admitted
+
+    def _admit_msg(self, sess: TenantSession, msg: dict, groups: dict):
+        room = self._rooms[sess.room_id]
+        changes = msg.get("changes")
+        if changes and msg.get("checkpoint") is None \
+                and not msg.get("noSnapshot"):
+            # strip changes for the cross-tenant per-doc group; record
+            # the revealed clock NOW (ordering is free — flush reads the
+            # post-apply doc state at tick end either way)
+            if msg.get("clock") is not None:
+                room.hub.note_clock(sess.tenant_id, msg["docId"],
+                                    msg["clock"])
+            changes_l, senders = groups.setdefault(
+                (sess.room_id, msg["docId"]), ([], []))
+            changes_l.extend(changes)
+            senders.extend([sess.tenant_id] * len(changes))
+        else:
+            # metadata (clock reveal / advertisement), or a snapshot-
+            # bearing message — a checkpoint+tail bootstrap from a
+            # tenant serving a doc the server requested must dispatch on
+            # its checkpoint FIRST (hub._receive order; stripping the
+            # tail for grouped admission would park every tail change as
+            # premature, its deps living inside the discarded bundle).
+            # Full hub semantics, flush deferred by the tick's batched()
+            try:
+                room.hub._receive(sess.tenant_id, msg, validated=True)
+            except ProtocolError as exc:
+                sess.stats["protocol_errors"] += 1
+                self.stats["protocol_errors"] += 1
+                if obs.ENABLED:
+                    obs.event("svc", "protocol_error",
+                              args={"tenant": sess.tenant_id,
+                                    "error": str(exc)[:120]})
+
+    # -- peer health ----------------------------------------------------
+
+    def _health_pass(self):
+        cfg = self.config
+        for sess in self._tenants.values():
+            if sess.pending_dead:
+                continue
+            if sess.channel.dead:
+                self._mark_dead(sess, "retransmit_cap")
+                continue
+            owed = sess.channel.in_flight > 0
+            silent = self._tick_no - sess.last_inbound_tick
+            if sess.state == LIVE:
+                if owed and silent >= cfg.heartbeat_ticks:
+                    sess.state = SUSPECT
+                    sess.suspect_at = self._tick_no
+                    if obs.ENABLED:
+                        obs.event("svc", "suspect",
+                                  args={"tenant": sess.tenant_id,
+                                        "silent_ticks": silent})
+            elif sess.state == SUSPECT:
+                if not owed or silent < cfg.heartbeat_ticks:
+                    sess.state = LIVE   # acked up / spoke up: recovered
+                elif self._tick_no - sess.suspect_at \
+                        >= cfg.suspect_grace_ticks:
+                    self._mark_dead(sess, "heartbeat_timeout")
+
+    # -- introspection --------------------------------------------------
+
+    def _track_bounds(self):
+        # inbox / recv-buf peaks are exact (tracked at enqueue); the
+        # per-room quarantine peak is the gate's own exact counter
+        s = self.stats
+        for room in self._rooms.values():
+            if room.gate.stats["peak_parked"] > s["peak_parked"]:
+                s["peak_parked"] = room.gate.stats["peak_parked"]
+
+    @property
+    def tenants(self) -> dict:
+        return dict(self._tenants)
+
+    def session(self, tenant_id: str):
+        return self._tenants.get(tenant_id)
+
+    def idle(self) -> bool:
+        """No queued admission work and no channel in flight anywhere."""
+        return all(not s.inbox and s.channel.idle
+                   for s in self._tenants.values())
+
+    def metrics(self) -> dict:
+        ring = sorted(self._tick_ms)
+        pct = (lambda p: round(ring[min(len(ring) - 1,
+                                        int(p * len(ring)))], 3)) \
+            if ring else (lambda p: 0.0)
+        bp = self.stats["backpressured_closed"] + sum(
+            s.channel.stats["backpressured"]
+            for s in self._tenants.values())
+        rt = self.stats["retransmits_closed"] + sum(
+            s.channel.stats["retransmits"] for s in self._tenants.values())
+        return {**{k: v for k, v in self.stats.items()
+                   if not k.endswith("_closed")},
+                "live_tenants": len(self._tenants),
+                "rooms": len(self._rooms),
+                "backpressured_total": bp, "retransmits_total": rt,
+                "p50_tick_ms": pct(0.50), "p99_tick_ms": pct(0.99),
+                "max_tick_ms": round(ring[-1], 3) if ring else 0.0}
+
+    def reclaimed(self, tenant_id: str) -> bool:
+        """True iff no service-side state remains for an evicted tenant:
+        session, hub peer, ClockMatrix slot, quarantine attribution (the
+        dead-peer reclamation contract the soak asserts)."""
+        if tenant_id in self._tenants:
+            return False
+        for room in self._rooms.values():
+            if tenant_id in room.hub._peers:
+                return False
+            if tenant_id in room.hub._matrix._peers.idx:
+                return False
+            for q in room.gate._quarantine.values():
+                if any(s == tenant_id for _, s in q._items.values()):
+                    return False
+        return True

@@ -16,17 +16,39 @@ import numpy as np
 
 
 class _Interner:
-    __slots__ = ("idx", "items")
+    """Key -> dense slot, with slot recycling: a removed key's slot goes
+    to a free list and is handed to the next NEW key, so the dense axis
+    is bounded by the PEAK live population, not the lifetime total —
+    500 add/remove churn cycles on a 3-peer hub cost 3 slots, not 500
+    (the churn-storm memory bound)."""
+
+    __slots__ = ("idx", "items", "free")
 
     def __init__(self):
         self.idx: dict = {}
         self.items: list = []
+        self.free: list = []
 
     def __call__(self, key) -> int:
         i = self.idx.get(key)
         if i is None:
-            i = self.idx[key] = len(self.items)
-            self.items.append(key)
+            if self.free:
+                i = self.free.pop()
+                self.items[i] = key
+            else:
+                i = len(self.items)
+                self.items.append(key)
+            self.idx[key] = i
+        return i
+
+    def remove(self, key):
+        """Free a key's slot for reuse; returns the slot (or None). The
+        caller must zero the matrix rows it indexed — the next occupant
+        inherits the slot, never the data."""
+        i = self.idx.pop(key, None)
+        if i is not None:
+            self.items[i] = None
+            self.free.append(i)
         return i
 
     def __len__(self):
@@ -116,6 +138,21 @@ class ClockMatrix:
             self._theirs[pi] = 0
         if pi is not None and pi < self._active.shape[0]:
             self._active[pi] = False
+
+    def release_peer(self, peer_id: str):
+        """reset_peer + recycle the peer's matrix slot (the churn bound:
+        add/remove N peers holds the peer axis at the PEAK concurrent
+        count — a removed peer costs nothing once released; a same-id
+        reconnect interns fresh, possibly into a recycled slot whose rows
+        were zeroed here)."""
+        self.reset_peer(peer_id)
+        self._peers.remove(peer_id)
+
+    @property
+    def peer_slots(self) -> int:
+        """Width of the dense peer axis (live + recycled-free slots) —
+        what the churn-storm regression test bounds."""
+        return len(self._peers)
 
     def pending(self) -> list:
         """All ACTIVE (peer_id, doc_id) pairs where the peer is missing

@@ -15,9 +15,11 @@ from __future__ import annotations
 
 import logging
 import os
+from contextlib import contextmanager
 
 from ..backend import default as Backend
 from .. import frontend as Frontend
+from .. import obs
 from .._common import less_or_equal
 from ..resilience.inbound import absorb_msg, inbound_gate
 from ..resilience.validation import validate_msg
@@ -73,7 +75,9 @@ class SyncHub:
         # keeps the equivalent ourClock per Connection instance, so a
         # reconnected peer starts fresh)
         self._n_auto_ids = 0
-        self._ckpt_cache: dict = {}   # doc -> (Checkpoint, history_len)
+        self._ckpt_cache: dict = {}   # doc -> [Checkpoint, history_len, b64]
+        self._defer_depth = 0         # batched(): >0 defers flush()
+        self._flush_wanted = False
         self._no_snapshot: set = set()   # (peer, doc): peer declined a
         # bundle this session (corrupt restore or policy) — serve plain
         # changes for the rest of the add_peer..remove_peer lifetime
@@ -96,9 +100,11 @@ class SyncHub:
         return peer
 
     def remove_peer(self, peer_id: str):
-        """Drop a peer; a later add_peer with the same id starts fresh."""
+        """Drop a peer; a later add_peer with the same id starts fresh.
+        The peer's ClockMatrix slot is RELEASED (recycled), so add/remove
+        churn bounds the matrix at the peak concurrent peer count."""
         self._peers.pop(peer_id, None)
-        self._matrix.reset_peer(peer_id)
+        self._matrix.release_peer(peer_id)
         self._revealed = {pd for pd in self._revealed if pd[0] != peer_id}
         self._advertised = {pd: c for pd, c in self._advertised.items()
                             if pd[0] != peer_id}
@@ -162,12 +168,31 @@ class SyncHub:
             if (peer_id, doc_id) not in self._revealed:
                 self._advertise(peer_id, doc_id)
 
+    @contextmanager
+    def batched(self):
+        """Defer every flush() inside the block to ONE flush at exit (the
+        service tick's cross-tenant amortization: N tenant deliveries +
+        clock reveals in a tick trigger a single vectorized comparison
+        and one change extraction per (doc, clock) group, not N flush
+        loops). Nests; only the outermost exit flushes."""
+        self._defer_depth += 1
+        try:
+            yield self
+        finally:
+            self._defer_depth -= 1
+            if not self._defer_depth and self._flush_wanted:
+                self._flush_wanted = False
+                self.flush()
+
     def flush(self):
         """One batched comparison; send changes for every flagged pair.
 
         Change extraction is shared: flagged pairs with the same
         (doc, believed clock) — the common case when one local change
         fans out to N caught-up peers — run `get_missing_changes` once."""
+        if self._defer_depth:
+            self._flush_wanted = True
+            return
         extracted: dict = {}
         for peer_id, doc_id in self._matrix.pending():
             if peer_id not in self._peers:
@@ -207,20 +232,26 @@ class SyncHub:
                 # whole log. A failed capture just serves plain changes.
                 snap = self._doc_checkpoint(doc_id, state)
                 if snap is not None:
-                    ck, tail = snap
+                    ck_b64, tail = snap
                     msg = {"docId": doc_id, "clock": clock,
-                           "checkpoint": ck.to_base64(), "changes": tail}
+                           "checkpoint": ck_b64, "changes": tail}
             self._peers[peer_id].send_msg(msg)
 
     def _doc_checkpoint(self, doc_id: str, state):
-        """(Checkpoint, tail changes) for a doc, cached per doc and
+        """(base64 bundle, tail changes) for a doc, cached per doc and
         recaptured once the tail past the cached frontier itself exceeds
         the snapshot threshold. None when capture fails (the caller falls
-        back to plain change extraction)."""
+        back to plain change extraction).
+
+        Both the capture AND its base64 encode are cached, so a join
+        storm — N peers bootstrapping the same doc in one flush window —
+        costs ONE snapshot encode serving all N (the coalescing the
+        service tier's rejoin path leans on; `sync/snapshot_*` obs
+        events make the capture-vs-served ratio visible)."""
         from ..checkpoint import Checkpoint, capture_state
         cached = self._ckpt_cache.get(doc_id)
         if cached is not None:
-            ck, cap_len = cached
+            ck, cap_len, _ = cached
             stale = (state.history_len - cap_len >= self.snapshot_min_changes
                      or not less_or_equal(ck.clock, dict(state.clock)))
             if stale:
@@ -233,13 +264,29 @@ class SyncHub:
                                "serving plain changes", doc_id,
                                exc_info=True)
                 return None
-            cached = (ck, state.history_len)
+            cached = [ck, state.history_len, ck.to_base64()]
             self._ckpt_cache[doc_id] = cached
-        ck = cached[0]
+            if obs.ENABLED:
+                obs.event("sync", "snapshot_capture", args={"doc": doc_id})
+        elif obs.ENABLED:
+            obs.event("sync", "snapshot_serve_cached", args={"doc": doc_id})
+        ck, _, ck_b64 = cached
         tail = Backend.get_missing_changes(state, ck.clock)
-        return ck, tail
+        return ck_b64, tail
 
     # -- inbound --------------------------------------------------------
+
+    def note_clock(self, peer_id: str, doc_id: str, clock: dict):
+        """Clock-reveal bookkeeping ALONE — no doc requests, no change
+        application, no flush. The service tier's grouped admission
+        strips `changes` out of tenant messages for batched per-doc
+        delivery and records the revealed clock here (exactly the clock
+        branch of `_receive`)."""
+        if peer_id not in self._peers:
+            return
+        self._revealed.add((peer_id, doc_id))
+        self._matrix.set_active(peer_id, doc_id)
+        self._matrix.update_theirs(peer_id, doc_id, clock)
 
     def _receive(self, peer_id: str, msg: dict, validated: bool = False):
         if not validated:
@@ -279,10 +326,12 @@ class SyncHub:
             return self._receive_snapshot(peer_id, doc_id, msg)
         if msg.get("changes"):
             # validated + quarantined application: premature changes park
-            # in the bounded per-doc quarantine; duplicates dedup
-            # idempotently in the backend admission layer
+            # in the bounded per-doc quarantine (attributed to this peer
+            # for pressure-eviction observability and dead-peer
+            # reclamation); duplicates dedup idempotently in the backend
+            # admission layer
             return inbound_gate(self._doc_set).deliver(
-                doc_id, msg["changes"], validated=True)
+                doc_id, msg["changes"], validated=True, sender=peer_id)
         if self._doc_set.get_doc(doc_id) is not None:
             self._matrix.update_ours(
                 doc_id, Frontend.get_backend_state(
@@ -312,7 +361,7 @@ class SyncHub:
             # peer's bootstrap): take only the tail, through the gate
             if msg.get("changes"):
                 return inbound_gate(self._doc_set).deliver(
-                    doc_id, msg["changes"], validated=True)
+                    doc_id, msg["changes"], validated=True, sender=peer_id)
             return self._doc_set.get_doc(doc_id)
         try:
             ck = Checkpoint.from_base64(msg["checkpoint"])

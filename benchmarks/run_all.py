@@ -15,6 +15,7 @@ nested Trellis document stayed on the DEVICE tier with zero graduations;
 cfg6 asserts the residual/slow register path actually ran).
 """
 
+import json
 import sys
 import time
 
@@ -283,6 +284,138 @@ def config6_conflict_heavy(n_actors: int = 200, n_targets: int = 500):
     assert doc.conflicts, "conflict-heavy config minted no conflicts"
     emit(f"cfg6_conflict_heavy_{n_actors}x{n_targets}", n_ops / dt, "ops/s",
          n_conflicts=len(doc.conflicts), threshold=TRACKING_ONLY)
+
+
+def config11_service(n_sessions: int = 200, room_size: int = 5,
+                     n_rounds: int = 10, quick: bool = False,
+                     record_session: bool = False):
+    """Multi-tenant sync service throughput (automerge_tpu/service,
+    INTERNALS §13) — the ISSUE 8 service bench row (specified there as
+    "cfg6"; cfg6 was already the conflict-heavy config, so the service
+    row is cfg11). N tenant sessions over lossless queue transports into
+    one tick-scheduled SyncService, every client editing each round;
+    measured from first edit to full quiescence (admission + grouped
+    gate deliveries + hub fan-out + client applies all inside dt).
+    Records the acceptance terms: sessions, aggregate_ops_per_sec,
+    shed_total, evictions, p99_tick_ms (+ deferrals and the bound
+    peaks). Chaos/churn live in scripts/soak.py --service; this row is
+    the clean-path capacity number."""
+    import time as _time
+    from collections import deque
+
+    import automerge_tpu as am
+    from automerge_tpu import Connection, DocSet, Text
+    from automerge_tpu.resilience import ResilientChannel
+    from automerge_tpu.service import ServiceConfig, SyncService, \
+        TenantBudget
+
+    if quick:
+        n_sessions, n_rounds = 50, 6
+
+    class Client:
+        def __init__(self, svc, tid, room_id, base):
+            self.svc, self.tid, self.room_id = svc, tid, room_id
+            self.to_server, self.to_client = deque(), deque()
+            self.ds = DocSet()
+            self.ds.set_doc(room_id,
+                            am.apply_changes(am.init(f"c-{tid}"), base))
+            svc.connect(tid, room_id, self.to_client.append)
+            self.chan = ResilientChannel(self.to_server.append, None)
+            self.conn = Connection(self.ds, self.chan.send)
+            self.chan._deliver = self.conn.receive_msg
+            self.conn.open()
+
+        def pump(self):
+            while self.to_server:
+                env = self.to_server.popleft()
+                sess = self.svc.session(self.tid)
+                if sess is not None:
+                    sess.on_wire(env)
+            while self.to_client:
+                self.chan.on_wire(self.to_client.popleft())
+            self.chan.tick()
+
+    svc = SyncService(ServiceConfig(
+        default_budget=TenantBudget(ops_per_tick=256, inbox_cap=64)))
+    n_rooms = max(1, n_sessions // room_size)
+    bases = {}
+    for g in range(n_rooms):
+        rid = f"room-{g}"
+        doc0 = am.change(am.init(f"{rid}-origin"), lambda d: (
+            d.__setitem__("t", Text("svc")), d.__setitem__("m", {})))
+        bases[rid] = am.get_all_changes(doc0)
+        svc.seed_doc(rid, am.apply_changes(am.init(f"server-{g}"),
+                                           bases[rid]))
+    clients = [Client(svc, f"t{i}", f"room-{i % n_rooms}",
+                      bases[f"room-{i % n_rooms}"])
+               for i in range(n_sessions)]
+
+    def settle(max_ticks=800):
+        for _ in range(max_ticks):
+            for c in clients:
+                c.pump()
+            svc.tick()
+            if svc.idle() and all(c.chan.idle and not c.to_server
+                                  and not c.to_client for c in clients):
+                return
+        raise AssertionError(f"service bench never quiesced: "
+                             f"{svc.metrics()}")
+
+    settle()                                 # join handshake off the clock
+    ops_before = svc.stats["admitted_ops"]
+    t0 = _time.perf_counter()
+    for r in range(n_rounds):
+        for i, c in enumerate(clients):
+            c.ds.set_doc(c.room_id, am.change(
+                c.ds.get_doc(c.room_id),
+                lambda d, r=r, i=i: d["m"].__setitem__(f"k{i}", r)))
+            c.pump()
+        svc.tick()
+    settle()
+    dt = _time.perf_counter() - t0
+    admitted = svc.stats["admitted_ops"] - ops_before
+    assert admitted >= n_sessions * n_rounds, (admitted, svc.metrics())
+    # convergence sanity: one spot-check room, server vs every member
+    rid = "room-0"
+    canon = lambda d: json.dumps(am.to_json(d), sort_keys=True)  # noqa: E731
+    want = canon(svc.room(rid).doc_set.get_doc(rid))
+    for c in clients:
+        if c.room_id == rid:
+            assert canon(c.ds.get_doc(rid)) == want, "room-0 diverged"
+    m = svc.metrics()
+    emit(f"cfg11_service_{n_sessions}_sessions", admitted / dt, "ops/s",
+         sessions=n_sessions, aggregate_ops_per_sec=round(admitted / dt, 1),
+         shed_total=m["shed_total"], evictions=m["evictions"],
+         p99_tick_ms=m["p99_tick_ms"], p50_tick_ms=m["p50_tick_ms"],
+         deferrals=m["deferrals"], rooms=m["rooms"],
+         peak_inbox=m["peak_inbox"], peak_parked=m["peak_parked"],
+         admitted_ops=admitted,
+         threshold=TRACKING_ONLY)
+    if record_session:
+        import datetime
+
+        import bench as B
+        from benchmarks.common import RESULTS
+        row = dict(RESULTS[-1])
+        row["recorded_at_utc"] = datetime.datetime.now(
+            datetime.timezone.utc).isoformat()
+        row["git_sha"] = B._git_sha()
+        try:
+            import subprocess as _sp
+            if _sp.run(["git", "status", "--porcelain"],
+                       capture_output=True, text=True,
+                       timeout=10).stdout.strip():
+                row["git_dirty"] = True
+        except Exception:
+            pass
+        row["timed_region"] = (
+            f"{n_sessions} tenant sessions x {n_rounds} edit rounds "
+            "through SyncService.tick (budgeted admission -> grouped "
+            "per-doc gate delivery -> one hub flush per room -> client "
+            "applies over lossless queue transports); dt = first edit "
+            "-> full quiescence; value = admitted ops/s aggregate.")
+        B.append_session_log(row)
+        print(f"# appended to {B.SESSION_LOG_PATH}", file=sys.stderr)
 
 
 def config5b_residual_heavy(n_actors: int = 10_000, quick: bool = False):
@@ -1000,6 +1133,11 @@ def main():
               "refusing to hang", file=sys.stderr)
         sys.exit(3)
     quick = "--quick" in sys.argv
+    if "--service-session" in sys.argv:
+        # the chip_session.sh service step: ONLY the service row, full
+        # JSON appended to BENCH_SESSIONS.jsonl (PR-4 credibility rules)
+        config11_service(quick=quick, record_session=True)
+        return
     record_round = None
     record_path = None
     if "--record" in sys.argv:
@@ -1081,6 +1219,7 @@ def main():
         lambda: config9_sync_fanout(n_peers=8 if quick else 20,
                                     n_changes=20 if quick else 50),
         lambda: config10_save_load(n_changes=15 if quick else 40),
+        lambda: config11_service(quick=quick),
     ]
     if record_path is not None:
         steps.insert(0, fold_headline)

@@ -103,6 +103,13 @@ run "planned_ab" 900 python profile_bench.py --planned
 # real accelerator, appended to BENCH_SESSIONS.jsonl (the cpu rows only
 # prove the dispatch cut; the time payoff is per-dispatch link overhead)
 run "cfg4_stacked_ab" 600 python -m benchmarks.cfg4_smoke --record-session
+# service tier on a real accelerator (ISSUE 8): the 100-session chaos
+# smoke (convergence + bounds asserted inside the profile), then the
+# cfg11 clean-path capacity row appended to BENCH_SESSIONS.jsonl — the
+# cpu rows only prove the scheduler; aggregate ops/s and p99_tick_ms
+# are the chip numbers
+run "service_soak"  900 python scripts/soak.py --service --quick
+run "cfg11_service" 900 python -m benchmarks.run_all --service-session
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu

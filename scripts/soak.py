@@ -19,11 +19,24 @@ Profiles (each session is deterministic in its seed):
             mid-run RESTART of that peer from its latest checkpoint
             bundle (automerge_tpu.checkpoint) — byte-identical
             convergence after catch-up
+  service   the multi-tenant service tier (automerge_tpu.service,
+            INTERNALS §13) at scale: N client sessions over chaotic
+            links into one tick-scheduled SyncService (room-sharded
+            hubs, budgeted admission, credit backpressure), with
+            partitions, slow-peer injection, and kill/rejoin churn.
+            Asserts byte-identical convergence of every SURVIVOR with
+            its room's server replica, bounded memory (inbox / channel
+            reorder window / quarantine peaks never exceed the
+            configured caps), no tenant starvation, and full dead-peer
+            state reclamation (hub + ClockMatrix + quarantine) after
+            eviction.
 
 Usage:
   python scripts/soak.py [--profile all] [--sessions 30] [--seed-base 0]
   python scripts/soak.py --chaos [--sessions 50]     # chaos campaign
   python scripts/soak.py --checkpoint [--sessions 10]
+  python scripts/soak.py --service [--clients 1000]  # service-scale soak
+  python scripts/soak.py --service --quick           # CI smoke (100)
   python scripts/soak.py --chaos --trace             # + Perfetto trace
 
 Exit 0 iff every session converged; failures print their profile+seed so
@@ -508,13 +521,310 @@ def session_checkpoint(seed: int) -> None:
         f"checkpoint seed {seed}: change histories diverged after restart"
 
 
+#: metrics of the most recent service session (folded into the summary)
+LAST_SERVICE_METRICS: dict = {}
+
+
+class _SvcClient:
+    """One tenant-side endpoint: DocSet + Connection + ResilientChannel
+    over a pair of directed ChaosLinks into the service."""
+
+    __slots__ = ("tid", "room_id", "ds", "chan", "conn", "c2s", "s2c",
+                 "slow", "alive")
+
+    def __init__(self, am, svc, tid, room_id, base_changes, actor,
+                 link_seed, chaos, empty=False):
+        from automerge_tpu import Connection, DocSet
+        from automerge_tpu.resilience import ChaosLink, ResilientChannel
+        self.tid = tid
+        self.room_id = room_id
+        self.slow = 1          # pump every `slow` ticks
+        self.alive = True
+        self.ds = DocSet()
+        if not empty:
+            # a rejoiner starts EMPTY instead: it must bootstrap from the
+            # server (snapshot bundle when the history is long enough)
+            self.ds.set_doc(room_id,
+                            am.apply_changes(am.init(actor), base_changes))
+        # frames for an evicted tenant (no live session) die on the
+        # floor — exactly what a real listener does for a closed socket
+        self.c2s = ChaosLink(
+            lambda env: (svc.session(tid) is not None
+                         and svc.session(tid).on_wire(env)),
+            seed=link_seed, **chaos)
+        self.s2c = ChaosLink(lambda env: self.chan.on_wire(env),
+                             seed=link_seed + 1, **chaos)
+        sess = svc.connect(tid, room_id, self.s2c.send,
+                           seed=link_seed + 2)
+        assert sess is not None
+        self.chan = ResilientChannel(self.c2s.send, None,
+                                     seed=link_seed + 3)
+        self.conn = Connection(self.ds, self.chan.send)
+        self.chan._deliver = self.conn.receive_msg
+        self.conn.open()
+
+    def pump(self):
+        self.c2s.pump()
+        self.s2c.pump()
+        self.chan.tick()
+
+    def heal(self):
+        for ln in (self.c2s, self.s2c):
+            ln.heal()
+            ln.drop = ln.dup = ln.reorder = ln.delay = 0.0
+        self.slow = 1
+
+    def idle(self):
+        return self.chan.idle and self.c2s.idle and self.s2c.idle
+
+
+def session_service(seed: int, n_clients: int = 24, n_ticks: int = 30,
+                    room_size: int = 4, quiesce_ticks: int = 400) -> None:
+    """N concurrent tenant sessions against one SyncService under churn,
+    partitions, slow peers, and kill/rejoin — the service tier's honest
+    load test (ISSUE 8 acceptance run: ``--service --clients 1000``).
+
+    Fault schedule (all seeded): every client link carries drop/dup/
+    reorder/delay chaos; ~8% of clients get partitioned for a window;
+    ~8% run slow (pump every 4th tick); ~6% are KILLED mid-run (vanish
+    without a goodbye — the heartbeat/retransmit-cap ladder must declare
+    them dead and reclaim everything), and half the killed REJOIN later
+    as fresh sessions bootstrapped by the server (snapshot cache when
+    history is long enough, plain changes otherwise).
+
+    Asserted at the end (the acceptance bars):
+      1. every room's surviving clients render AND serialize
+         byte-identically to the server replica (change histories too);
+      2. bounded memory: peak inbox <= inbox_cap + recv_window, peak
+         channel reorder buffer <= recv_window, peak quarantine <= the
+         aggregate cap — and zero parked changes remain;
+      3. no tenant starved: max consecutive backlogged-but-unadmitted
+         ticks <= 2x the starvation boost threshold;
+      4. every killed-and-not-rejoined tenant was EVICTED and its hub /
+         ClockMatrix / quarantine state fully reclaimed."""
+    import json as _json
+    import math
+
+    am = _am()
+    from automerge_tpu import Text
+    from automerge_tpu.service import ServiceConfig, SyncService, \
+        TenantBudget
+
+    rng = np.random.default_rng(seed)
+    cfg = ServiceConfig(
+        heartbeat_ticks=12, suspect_grace_ticks=12, max_retries=24,
+        recv_window=256,
+        # a real admission deadline so deadline shedding and the
+        # starvation accounting are actually EXERCISED at scale — with
+        # the default 0.0 the _starve path is unreachable (the first
+        # message of a visit always admits) and the no-starvation
+        # acceptance bar would be vacuously true. Scaled with the
+        # population: the deadline bounds the admission LOOP, whose cost
+        # is O(tenants), so a flat sub-ms budget that sheds honestly at
+        # 100 clients starves everything at 1000 (measured: 972k sheds,
+        # zero drain progress) while a flat generous one never fires
+        tick_budget_ms=max(0.5, n_clients / 200.0),
+        default_budget=TenantBudget(ops_per_tick=64,
+                                    bytes_per_tick=32 * 1024,
+                                    inbox_cap=32))
+    svc = SyncService(cfg)
+
+    n_rooms = max(1, math.ceil(n_clients / room_size))
+    base_changes: dict = {}
+    for g in range(n_rooms):
+        room_id = f"room-{g}"
+        doc0 = am.change(am.init(f"{room_id}-origin"), lambda d: (
+            d.__setitem__("t", Text("start")), d.__setitem__("m", {})))
+        base_changes[room_id] = am.get_all_changes(doc0)
+        svc.seed_doc(room_id,
+                     am.apply_changes(am.init(f"server-{g}"),
+                                      base_changes[room_id]))
+        # small rooms have short histories; a lowered snapshot threshold
+        # keeps the rejoin path exercising the cached-bundle bootstrap
+        svc.room(room_id).hub.snapshot_min_changes = 8
+
+    chaos = {"drop": float(rng.uniform(0.02, 0.10)),
+             "dup": float(rng.uniform(0.0, 0.05)),
+             "reorder": float(rng.uniform(0.02, 0.10)),
+             "delay": float(rng.uniform(0.0, 0.10))}
+    clients: dict = {}
+    epoch: dict = {}          # tid -> rejoin epoch (fresh actor ids)
+
+    def wire(tid: str, room_id: str, empty: bool = False):
+        e = epoch.get(tid, 0)
+        clients[tid] = _SvcClient(
+            am, svc, tid, room_id, base_changes[room_id],
+            actor=f"c-{tid}-e{e}",
+            link_seed=seed * 104729 + int(tid.split("-")[-1]) * 13 + e * 7,
+            chaos=chaos, empty=empty)
+
+    for i in range(n_clients):
+        wire(f"{seed}-{i}", f"room-{i % n_rooms}")
+
+    ids = list(clients)
+    n_slow = max(1, n_clients // 12)
+    for tid in rng.choice(ids, n_slow, replace=False):
+        clients[str(tid)].slow = 4
+    # partitions: a window per victim inside the main loop
+    n_part = max(1, n_clients // 12)
+    part_victims = [str(t) for t in rng.choice(ids, n_part, replace=False)]
+    part_at = {t: int(rng.integers(3, max(4, n_ticks - 10)))
+               for t in part_victims}
+    part_len = {t: int(rng.integers(3, 9)) for t in part_victims}
+    # kills (never the last live member of a room) + later rejoins
+    n_kill = max(1, n_clients // 16)
+    kill_order = [str(t) for t in rng.choice(ids, n_kill, replace=False)]
+    kill_at = {t: int(rng.integers(6, max(7, n_ticks - 4)))
+               for t in kill_order}
+    rejoiners = set(kill_order[: len(kill_order) // 2])
+    rejoin_at = {t: kill_at[t] + int(rng.integers(4, 10))
+                 for t in rejoiners}
+    killed: set = set()
+    n_kills_done = 0
+    n_rejoins_done = 0
+
+    def live_room_members(room_id):
+        return [c for c in clients.values()
+                if c.room_id == room_id and c.alive]
+
+    def pump_all(tick_no: int):
+        for c in clients.values():
+            if c.alive and tick_no % c.slow == 0:
+                c.pump()
+        svc.tick()
+
+    for t in range(n_ticks):
+        for tid in part_victims:
+            c = clients[tid]
+            if t == part_at[tid] and c.alive:
+                c.c2s.partition()
+                c.s2c.partition()
+            if t == part_at[tid] + part_len[tid]:
+                c.c2s.heal()
+                c.s2c.heal()
+        for tid, at in kill_at.items():
+            c = clients[tid]
+            if t == at and c.alive and len(live_room_members(c.room_id)) > 1:
+                c.alive = False          # vanishes; no goodbye
+                killed.add(tid)
+                n_kills_done += 1
+        for tid, at in rejoin_at.items():
+            if t == at and tid in killed:
+                killed.discard(tid)
+                epoch[tid] = epoch.get(tid, 0) + 1
+                n_rejoins_done += 1
+                # fresh everything, EMPTY doc-set: the server must
+                # bootstrap the rejoiner (snapshot cache / plain changes)
+                wire(tid, clients[tid].room_id, empty=True)
+        # edits: a random slice of live clients each tick
+        n_edit = max(1, n_clients // 20)
+        for tid in rng.choice(ids, n_edit, replace=False):
+            c = clients[str(tid)]
+            if not c.alive:
+                continue
+            doc = c.ds.get_doc(c.room_id)
+            if doc is None:
+                continue    # a rejoiner still waiting on its bootstrap
+            if int(rng.integers(0, 3)) == 0:
+                doc = _text_edit(am, doc, rng)
+            else:
+                k = KEYS[int(rng.integers(0, len(KEYS)))]
+                v = int(rng.integers(0, 999))
+                doc = am.change(doc, lambda d, k=k, v=v:
+                                d["m"].__setitem__(k, v))
+            c.ds.set_doc(c.room_id, doc)
+        pump_all(t)
+
+    # ---- drain: heal everything, then run lossless until quiescent ----
+    for c in clients.values():
+        c.heal()
+    # rooms holding killed-but-unowed tenants get one server-side edit so
+    # the hub OWES the dead peer frames — the heartbeat ladder needs an
+    # outstanding debt to escalate on (an idle peer is not a dead peer)
+    for tid in killed:
+        room_id = clients[tid].room_id
+        room = svc.room(room_id)
+        doc = room.doc_set.get_doc(room_id)
+        if doc is not None:
+            room.doc_set.set_doc(room_id, am.change(
+                doc, lambda d: d["m"].__setitem__("_drain", 1)))
+    n_orphan_rejoins = 0
+    for q in range(quiesce_ticks):
+        # a slow/partitioned-but-live client is server-side
+        # indistinguishable from a vanished one, so the health ladder may
+        # evict it (a legitimate per-tenant degradation). Its recovery
+        # path is the client keepalive noticing the dead session and
+        # REJOINING fresh — eviction is degradation, never loss
+        for tid, c in list(clients.items()):
+            if c.alive and svc.session(tid) is None:
+                epoch[tid] = epoch.get(tid, 0) + 1
+                n_orphan_rejoins += 1
+                wire(tid, c.room_id, empty=True)
+        pump_all(q)
+        if svc.idle() \
+                and all(c.idle() for c in clients.values() if c.alive) \
+                and all(svc.session(tid) is None for tid in killed):
+            break
+    else:
+        raise AssertionError(
+            f"service seed {seed}: never quiesced "
+            f"(unevicted={[t for t in killed if svc.session(t)]}, "
+            f"metrics={svc.metrics()})")
+
+    # ---- the acceptance asserts ----
+    m = svc.metrics()
+    LAST_SERVICE_METRICS.clear()
+    LAST_SERVICE_METRICS.update(m, n_clients=n_clients, n_rooms=n_rooms,
+                                killed=n_kills_done,
+                                rejoined=n_rejoins_done,
+                                orphan_rejoins=n_orphan_rejoins)
+    # 1. byte-identical convergence of every survivor with its room
+    for g in range(n_rooms):
+        room_id = f"room-{g}"
+        server_doc = svc.room(room_id).doc_set.get_doc(room_id)
+        members = live_room_members(room_id)
+        if server_doc is None:
+            assert not members, f"room {room_id} lost its server replica"
+            continue
+        docs = [server_doc] + [c.ds.get_doc(room_id) for c in members]
+        ok, diff = _converged(am, docs)
+        assert ok, f"service seed {seed} room {room_id} diverged: {diff}"
+        hists = [sorted(_json.dumps(ch, sort_keys=True)
+                        for ch in am.get_all_changes(d)) for d in docs]
+        assert hists.count(hists[0]) == len(hists), \
+            f"service seed {seed} room {room_id}: histories diverged"
+    # 2. bounded memory, and nothing left parked
+    assert m["peak_inbox"] <= cfg.default_budget.inbox_cap \
+        + cfg.recv_window, m
+    assert m["peak_recv_buf"] <= cfg.recv_window, m
+    assert m["peak_parked"] <= cfg.quarantine_global_capacity, m
+    for g in range(n_rooms):
+        gate = svc.room(f"room-{g}").gate
+        assert gate._n_parked == 0, \
+            f"service seed {seed}: room-{g} quarantine not drained"
+    for c in clients.values():
+        if c.alive:
+            assert len(c.chan._recv_buf) <= 1024   # client RECV_WINDOW
+    # 3. no tenant starves
+    assert m["max_starved_streak"] <= 2 * cfg.starvation_boost_ticks, m
+    # 4. dead-peer state fully reclaimed
+    for tid in killed:
+        assert svc.reclaimed(tid), \
+            f"service seed {seed}: tenant {tid} not reclaimed after " \
+            f"eviction"
+    # every kill ends in exactly one eviction (health-ladder eviction for
+    # the vanished, or the rejoin path evicting the stale session first)
+    assert m["evictions"] >= n_kills_done, m
+
+
 PROFILES = {"general": session_general, "conflict": session_conflict,
             "lossy": session_lossy, "table": session_table,
-            "chaos": session_chaos, "checkpoint": session_checkpoint}
+            "chaos": session_chaos, "checkpoint": session_checkpoint,
+            "service": session_service}
 
 
 def run(profile: str, sessions: int, seed_base: int,
-        trace: bool = False) -> int:
+        trace: bool = False, clients: int = None) -> int:
     import json
 
     from automerge_tpu import obs
@@ -522,6 +832,13 @@ def run(profile: str, sessions: int, seed_base: int,
     failures = []
     t0 = time.perf_counter()
     names = list(PROFILES) if profile == "all" else [profile]
+    profiles = dict(PROFILES)
+    if clients is not None:
+        # the service profile at an explicit scale (--service --clients N):
+        # tick count grows mildly with scale so churn/partition windows
+        # stay proportionate
+        profiles["service"] = lambda seed: session_service(
+            seed, n_clients=clients, n_ticks=40 if clients >= 500 else 30)
     # the soak ALWAYS records (counters are exact across ring
     # wraparound, so the summary is right even for long campaigns); the
     # --trace flag only controls whether the ring is also exported
@@ -532,7 +849,7 @@ def run(profile: str, sessions: int, seed_base: int,
         ev0 = obs.metrics_snapshot()["counters"]
         n0 = obs.metrics_snapshot()["emitted"]
         for name in names:
-            fn = PROFILES[name]
+            fn = profiles[name]
             for s in range(sessions):
                 seed = seed_base + s
                 try:
@@ -569,6 +886,8 @@ def run(profile: str, sessions: int, seed_base: int,
         "events": events,
         "obs_records": {"emitted": snap["emitted"] - n0,
                         "retained": snap["retained"]},
+        **({"service_metrics": dict(LAST_SERVICE_METRICS)}
+           if "service" in names and LAST_SERVICE_METRICS else {}),
         **({"trace_path": path} if trace else {}),
     }
     print(json.dumps(summary, sort_keys=True), flush=True)
@@ -584,15 +903,35 @@ def main():
     ap.add_argument("--checkpoint", action="store_true",
                     help="shorthand for --profile checkpoint (snapshot "
                          "mid-chaos + restart one peer from its bundle)")
-    ap.add_argument("--sessions", type=int, default=30)
+    ap.add_argument("--service", action="store_true",
+                    help="shorthand for --profile service at scale "
+                         "(--clients concurrent sessions, default 1000; "
+                         "--sessions defaults to 1 seed)")
+    ap.add_argument("--clients", type=int, default=None,
+                    help="service profile: concurrent client sessions "
+                         "(default 1000 with --service)")
+    ap.add_argument("--quick", action="store_true",
+                    help="service profile: the CI smoke scale "
+                         "(100 clients)")
+    ap.add_argument("--sessions", type=int, default=None)
     ap.add_argument("--seed-base", type=int, default=0)
     ap.add_argument("--trace", action="store_true",
                     help="dump the obs flight recorder as Chrome trace "
                          "JSON (Perfetto-loadable) after the campaign")
     args = ap.parse_args()
     profile = ("chaos" if args.chaos
-               else "checkpoint" if args.checkpoint else args.profile)
-    return run(profile, args.sessions, args.seed_base, trace=args.trace)
+               else "checkpoint" if args.checkpoint
+               else "service" if args.service else args.profile)
+    clients = args.clients
+    if args.service and clients is None:
+        clients = 100 if args.quick else 1000
+    sessions = args.sessions
+    if sessions is None:
+        # one seed at service scale (a 1000-session scenario IS the
+        # campaign); 30 everywhere else (the historical default)
+        sessions = 1 if profile == "service" else 30
+    return run(profile, sessions, args.seed_base, trace=args.trace,
+               clients=clients)
 
 
 if __name__ == "__main__":

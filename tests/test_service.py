@@ -1,0 +1,727 @@
+"""Multi-tenant sync service tier (automerge_tpu/service, INTERNALS §13).
+
+The contracts under test (ISSUE 8):
+
+- ``ResilientChannel`` retransmission is BOUNDED: ``max_retries`` exhausted
+  surfaces a typed ``PeerDeadError`` (or the ``on_dead`` callback), drops
+  the send window, and marks ``dead`` in stats — never a silent
+  retry-forever;
+- hub/ClockMatrix peer churn is memory-bounded: 500 add/remove cycles hold
+  the dense peer axis at the PEAK concurrent population (slot recycling);
+- quarantine capacity evictions are tenant-attributed and observable
+  (``quar/evict_pressure``), and a dead peer's parked changes reclaim in
+  one sweep;
+- the ``SyncService`` tick scheduler: per-tenant budgets defer (never
+  lose), credit backpressure bounds server-side queueing, deadline
+  shedding degrades without wedging, the LIVE/SUSPECT/DEAD health ladder
+  evicts silent-but-owed peers and reclaims ALL their state, rejoins
+  bootstrap fresh sessions, and a join storm is served from ONE cached
+  snapshot encode.
+"""
+
+import json
+from collections import deque
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import Text, obs
+from automerge_tpu.resilience import PeerDeadError, ResilientChannel
+from automerge_tpu.resilience.inbound import InboundGate
+from automerge_tpu.resilience.quarantine import QuarantineQueue
+from automerge_tpu.service import ServiceConfig, SyncService, TenantBudget
+from automerge_tpu.sync import Connection, DocSet, SyncHub
+from automerge_tpu.sync.clock_index import ClockMatrix
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off():
+    obs.disable()
+    obs.clear()     # the recorder is retained across tracing() scopes
+    yield
+    obs.disable()
+
+
+def _counters():
+    return obs.metrics_snapshot()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: bounded retransmission -> typed peer death
+# ---------------------------------------------------------------------------
+
+
+class TestChannelRetransmitCap:
+    def test_cap_exhaustion_raises_typed_peer_dead(self):
+        """Into a black hole: after max_retries retransmits of one
+        envelope the channel raises PeerDeadError (typed, a
+        ProtocolError), drops its send window, and refuses new sends."""
+        chan = ResilientChannel(lambda env: None, lambda p: None,
+                                max_retries=3)
+        chan.send({"docId": "d", "clock": {}})
+        with pytest.raises(PeerDeadError):
+            for _ in range(500):
+                chan.tick()
+        assert chan.dead and chan.stats["dead"]
+        assert chan.in_flight == 0          # window reclaimed, not pinned
+        assert chan.stats["retransmits"] == 3
+        with pytest.raises(PeerDeadError):
+            chan.send({"docId": "d", "clock": {}})
+
+    def test_on_dead_callback_fires_instead_of_raise(self):
+        deaths = []
+        chan = ResilientChannel(lambda env: None, lambda p: None,
+                                max_retries=2, on_dead=deaths.append)
+        chan.send({"docId": "d", "clock": {}})
+        for _ in range(500):
+            chan.tick()                     # dead channel ticks are no-ops
+        assert deaths == [chan]
+        assert chan.dead
+
+    def test_default_cap_is_finite(self):
+        from automerge_tpu.resilience.channel import MAX_RETRIES
+        chan = ResilientChannel(lambda env: None, lambda p: None)
+        assert chan._max_retries == MAX_RETRIES
+        assert 0 < MAX_RETRIES < 10_000
+
+    def test_acked_traffic_never_trips_the_cap(self):
+        """A slow-but-alive peer: every retransmit eventually acks, so
+        tries never accumulate to the cap."""
+        a_to_b, b_to_a = deque(), deque()
+        a = ResilientChannel(a_to_b.append, lambda p: None, max_retries=4)
+        b = ResilientChannel(b_to_a.append, lambda p: None)
+        for i in range(20):
+            a.send({"docId": "d", "clock": {}, "n": i})
+            for _ in range(12):             # drop the 1st tx, ack the rest
+                a.tick()
+            if a_to_b:
+                a_to_b.popleft()            # lose one frame
+            while a_to_b:
+                b.on_wire(a_to_b.popleft())
+            while b_to_a:
+                a.on_wire(b_to_a.popleft())
+        assert not a.dead
+        assert a.idle
+
+    def test_admit_gate_drops_unacked_and_redelivers(self):
+        """Credit-based flow control: a frame refused by the admit gate
+        drops UN-acked; the sender retransmits it; once credit frees the
+        same frame admits — backpressure, not loss."""
+        wire, delivered, credit = deque(), [], [False]
+        server = ResilientChannel(lambda env: None, delivered.append,
+                                  admit=lambda env: credit[0])
+        client = ResilientChannel(wire.append, lambda p: None)
+        client.send({"docId": "d", "clock": {}})
+        server.on_wire(wire.popleft())
+        assert delivered == [] and server.stats["backpressured"] == 1
+        assert client.in_flight == 1        # no ack came back
+        for _ in range(10):
+            client.tick()                   # retransmit
+        credit[0] = True
+        while wire:
+            server.on_wire(wire.popleft())
+        assert len(delivered) == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: churn-storm memory bound (hub + ClockMatrix slot recycling)
+# ---------------------------------------------------------------------------
+
+
+class TestChurnStorm:
+    def test_release_peer_recycles_slot_and_zeroes_rows(self):
+        m = ClockMatrix()
+        m.update_ours("doc", {"a": 3})
+        m.update_theirs("p1", "doc", {"a": 3})
+        slots_before = m.peer_slots
+        m.release_peer("p1")
+        m.update_theirs("p2", "doc", {"a": 1})
+        assert m.peer_slots == slots_before          # slot reused
+        assert m.their_clock("p2", "doc") == {"a": 1}
+        # p1's data must not leak into the recycled slot
+        assert m.their_clock("p1", "doc") == {}
+
+    def test_500_peer_churn_bounds_matrix_and_interner(self):
+        """Add/remove 500 peers against a live hub: the dense peer axis
+        and the interner stay at the PEAK concurrent population, and the
+        backing arrays do not grow per churn cycle."""
+        ds = DocSet()
+        ds.set_doc("doc", am.change(am.init("srv"),
+                                    lambda d: d.__setitem__("k", 1)))
+        hub = SyncHub(ds)
+        hub.open()
+        keep = [hub.add_peer(f"keep-{i}", lambda m: None) for i in range(3)]
+        for i in range(500):
+            pid = f"churn-{i}"
+            hub.add_peer(pid, lambda m: None)
+            hub._receive(pid, {"docId": "doc", "clock": {}})
+            hub.flush()
+            hub.remove_peer(pid)
+        mat = hub._matrix
+        assert mat.peer_slots <= 4, \
+            f"peer axis grew with churn: {mat.peer_slots} slots"
+        assert len(mat._peers.idx) <= 4
+        assert mat._theirs.shape[0] <= 4
+        assert mat._active.shape[0] <= 4
+        # churned-out peers leave no hub bookkeeping behind
+        assert not any(pd[0].startswith("churn-") for pd in hub._revealed)
+        assert not any(pd[0].startswith("churn-") for pd in hub._advertised)
+        assert len(hub._peers) == len(keep)
+
+    def test_readd_after_release_interns_fresh(self):
+        ds = DocSet()
+        ds.set_doc("doc", am.change(am.init("srv"),
+                                    lambda d: d.__setitem__("k", 1)))
+        hub = SyncHub(ds)
+        hub.open()
+        hub.add_peer("p", lambda m: None)
+        hub._receive("p", {"docId": "doc", "clock": {"srv": 1}})
+        hub.remove_peer("p")
+        hub.add_peer("p", lambda m: None)
+        assert hub._matrix.their_clock("p", "doc") == {}
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: attributed quarantine pressure eviction
+# ---------------------------------------------------------------------------
+
+
+def _premature(actor, seq, key="x"):
+    return {"actor": actor, "seq": seq, "deps": {"ghost": 9},
+            "ops": [{"action": "set", "obj": am.ROOT_ID,
+                     "key": key, "value": seq}]}
+
+
+class TestQuarantinePressure:
+    def test_capacity_eviction_emits_attributed_pressure_event(self):
+        q = QuarantineQueue(capacity=2)
+        with obs.tracing():
+            q.park(_premature("a", 1), sender="tenant-a")
+            q.park(_premature("b", 1), sender="tenant-b")
+            q.park(_premature("c", 1), sender="tenant-c")  # evicts a's
+            counters = _counters()
+            recs = [r for r in obs.snapshot()
+                    if r[2] == "quar" and r[3] == "evict_pressure"]
+        assert counters.get("quar.evict_pressure") == 1
+        assert len(recs) == 1
+        assert recs[0][5]["tenant"] == "tenant-a"
+        assert recs[0][5]["actor"] == "a"
+        assert q.stats["evicted"] == 1
+
+    def test_eviction_under_storm_attributes_the_flooder(self):
+        """One tenant floods a small gate with premature changes: every
+        pressure eviction names the flooding tenant; peak_parked tracks
+        the gate-wide high-water mark against the configured cap."""
+        ds = DocSet()
+        ds.set_doc("doc", am.init("srv"))
+        gate = InboundGate(ds, capacity=4, global_capacity=8)
+        with obs.tracing():
+            for seq in range(2, 30):        # seq 1 missing: all premature
+                gate.deliver("doc", [_premature("flood", seq)],
+                             validated=True, sender="tenant-flood")
+            recs = [r for r in obs.snapshot()
+                    if r[2] == "quar" and r[3] == "evict_pressure"]
+        assert recs, "capacity evictions under storm must be evented"
+        assert all(r[5]["tenant"] == "tenant-flood" for r in recs)
+        assert gate._n_parked <= 8
+        assert gate.stats["peak_parked"] <= 8
+        assert gate.stats["peak_parked"] >= gate._n_parked
+
+    def test_drop_sender_reclaims_only_that_tenant(self):
+        q = QuarantineQueue(capacity=64)
+        q.park(_premature("a", 2), sender="t1")
+        q.park(_premature("a", 3), sender="t1")
+        q.park(_premature("b", 2), sender="t2")
+        q.park(_premature("c", 2))                   # unattributed
+        assert q.drop_sender("t1") == 2
+        assert len(q) == 2
+        assert q.drop_sender("t1") == 0
+
+    def test_gate_evict_sender_sweeps_all_docs(self):
+        ds = DocSet()
+        ds.set_doc("d1", am.init("s1"))
+        ds.set_doc("d2", am.init("s2"))
+        gate = InboundGate(ds, capacity=16)
+        gate.deliver("d1", [_premature("a", 2)], validated=True, sender="t")
+        gate.deliver("d2", [_premature("b", 2)], validated=True, sender="t")
+        gate.deliver("d2", [_premature("c", 2)], validated=True,
+                     sender="other")
+        assert gate.evict_sender("t") == 2
+        assert gate._n_parked == 1
+
+    def test_requeue_preserves_attribution(self):
+        """A drained-but-still-premature change re-parks WITH its sender,
+        so a later pressure eviction still names the right tenant."""
+        ds = DocSet()
+        ds.set_doc("doc", am.init("srv"))
+        gate = InboundGate(ds, capacity=8)
+        gate.deliver("doc", [_premature("a", 3)], validated=True, sender="t")
+        # an unrelated delivery drains + re-parks the premature change
+        doc = am.change(am.init("w"), lambda d: d.__setitem__("y", 1))
+        gate.deliver("doc", am.get_all_changes(doc), validated=True,
+                     sender="other")
+        assert gate.evict_sender("t") == 1
+
+
+# ---------------------------------------------------------------------------
+# the service tier
+# ---------------------------------------------------------------------------
+
+
+class _Client:
+    """Lossless queue-transport tenant client (the soak's chaotic twin).
+
+    ``base`` is the room's shared founding change history; a non-empty
+    client applies it onto its OWN actor id (members must share history
+    but never an actor). ``base=None`` joins empty (the bootstrap path).
+    """
+
+    def __init__(self, svc, tid, room_id, base=None):
+        self.svc, self.tid, self.room_id = svc, tid, room_id
+        self.to_server: deque = deque()
+        self.to_client: deque = deque()
+        self.ds = DocSet()
+        if base is not None:
+            self.ds.set_doc(room_id,
+                            am.apply_changes(am.init(f"c-{tid}"), base))
+        self.sess = svc.connect(tid, room_id, self.to_client.append)
+        self.chan = ResilientChannel(self.to_server.append, None)
+        self.conn = Connection(self.ds, self.chan.send)
+        self.chan._deliver = self.conn.receive_msg
+        self.conn.open()
+
+    def pump(self):
+        while self.to_server:
+            env = self.to_server.popleft()
+            sess = self.svc.session(self.tid)
+            if sess is not None:
+                sess.on_wire(env)
+        while self.to_client:
+            self.chan.on_wire(self.to_client.popleft())
+        self.chan.tick()
+
+    def doc(self):
+        return self.ds.get_doc(self.room_id)
+
+    def edit(self, key, value):
+        self.ds.set_doc(self.room_id, am.change(
+            self.doc(), lambda d: d["m"].__setitem__(key, value)))
+
+
+def _room_doc(actor="origin"):
+    return am.change(am.init(actor), lambda d: (
+        d.__setitem__("t", Text("start")), d.__setitem__("m", {})))
+
+
+def _seed(svc, room_id="r", actor="origin"):
+    """Seed a room's server replica; returns the founding change history
+    every non-empty member must share."""
+    changes = am.get_all_changes(_room_doc(actor))
+    svc.seed_doc(room_id, am.apply_changes(am.init(f"server-{room_id}"),
+                                           changes))
+    return changes
+
+
+def _settle(svc, clients, max_ticks=300):
+    for _ in range(max_ticks):
+        for c in clients:
+            c.pump()
+        svc.tick()
+        if svc.idle() and all(c.chan.idle and not c.to_server
+                              and not c.to_client for c in clients):
+            return
+    raise AssertionError(f"service never quiesced: {svc.metrics()}")
+
+
+def _same_doc(am_docs):
+    dumps = [json.dumps(am.to_json(d), sort_keys=True) for d in am_docs]
+    return dumps.count(dumps[0]) == len(dumps)
+
+
+class TestServiceBasics:
+    def test_two_tenants_converge_through_ticks(self):
+        svc = SyncService()
+        base = _seed(svc)
+        a = _Client(svc, "a", "r", base)
+        b = _Client(svc, "b", "r", base)
+        a.edit("alpha", 1)
+        b.edit("beta", 2)
+        _settle(svc, [a, b])
+        server = svc.room("r").doc_set.get_doc("r")
+        assert _same_doc([server, a.doc(), b.doc()])
+        assert am.to_json(server)["m"] == {"alpha": 1, "beta": 2}
+
+    def test_grouped_admission_one_gate_delivery_per_doc_per_tick(self):
+        """Changes from N tenants queued in one tick deliver through the
+        gate as ONE batch (one backend apply / columnar decode)."""
+        from unittest import mock
+        svc = SyncService()
+        base = _seed(svc)
+        clients = [_Client(svc, f"t{i}", "r", base)
+                   for i in range(4)]
+        _settle(svc, clients)               # drain the join handshake
+        for i, c in enumerate(clients):
+            c.edit(f"k{i}", i)
+            c.pump()                        # frames -> inboxes, no tick yet
+        gate = svc.room("r").gate
+        with mock.patch.object(gate, "deliver",
+                               wraps=gate.deliver) as spy:
+            svc.tick()
+        deliveries = [c for c in spy.call_args_list]
+        assert len(deliveries) == 1
+        args, kwargs = deliveries[0]
+        assert len(args[1]) == 4            # all four tenants' changes
+        assert sorted(set(kwargs["sender"])) == [f"t{i}" for i in range(4)]
+        _settle(svc, clients)
+        assert _same_doc([svc.room("r").doc_set.get_doc("r")]
+                         + [c.doc() for c in clients])
+
+    def test_metrics_surface(self):
+        svc = SyncService()
+        base = _seed(svc)
+        c = _Client(svc, "a", "r", base)
+        _settle(svc, [c])
+        m = svc.metrics()
+        for key in ("ticks", "admitted_msgs", "shed_total", "evictions",
+                    "p50_tick_ms", "p99_tick_ms", "live_tenants",
+                    "peak_inbox", "peak_parked", "max_starved_streak"):
+            assert key in m
+        assert m["live_tenants"] == 1 and m["rooms"] == 1
+
+
+class TestBudgetsAndBackpressure:
+    def test_budget_deferral_is_not_loss(self):
+        """A tenant whose burst exceeds ops_per_tick admits across
+        several ticks — deferred work is counted and eventually all of
+        it lands."""
+        svc = SyncService(ServiceConfig(
+            default_budget=TenantBudget(ops_per_tick=1, inbox_cap=64)))
+        base = _seed(svc)
+        c = _Client(svc, "a", "r", base)
+        _settle(svc, [c])
+        for i in range(6):                  # 6 msgs, 1 op each
+            c.edit(f"k{i}", i)
+        c.pump()
+        assert len(c.sess.inbox) == 6
+        svc.tick()                          # budget: 1 op -> 1 msg admits
+        assert c.sess.stats["deferred"] > 0
+        assert svc.stats["deferrals"] > 0
+        _settle(svc, [c])
+        server = svc.room("r").doc_set.get_doc("r")
+        assert am.to_json(server)["m"]["k5"] == 5
+        assert c.sess.stats["admitted_msgs"] >= 6
+
+    def test_oversized_first_message_still_admits(self):
+        """One message bigger than the whole per-tick budget costs one
+        tick; it can never wedge the tenant."""
+        svc = SyncService(ServiceConfig(
+            default_budget=TenantBudget(ops_per_tick=2,
+                                        bytes_per_tick=64)))
+        base = _seed(svc)
+        c = _Client(svc, "a", "r", base)
+        _settle(svc, [c])
+        doc = c.doc()
+        for i in range(20):                 # one big multi-op change
+            doc = am.change(doc, lambda d, i=i:
+                            d["m"].__setitem__(f"big{i}", i))
+        c.ds.set_doc("r", doc)
+        _settle(svc, [c])
+        server = svc.room("r").doc_set.get_doc("r")
+        assert am.to_json(server)["m"]["big19"] == 19
+
+    def test_inbox_credit_backpressures_instead_of_queueing(self):
+        """inbox_cap=1: a burst is throttled by un-acked drops + sender
+        retransmission; the server-side queue never exceeds the credit
+        and nothing is lost."""
+        svc = SyncService(ServiceConfig(
+            default_budget=TenantBudget(ops_per_tick=1, inbox_cap=1)))
+        base = _seed(svc)
+        c = _Client(svc, "a", "r", base)
+        _settle(svc, [c])
+        for i in range(5):
+            c.edit(f"k{i}", i)
+        _settle(svc, [c])
+        assert c.sess.channel.stats["backpressured"] > 0
+        assert svc.stats["peak_inbox"] <= 1 + svc.config.recv_window
+        server = svc.room("r").doc_set.get_doc("r")
+        assert am.to_json(server)["m"] == {f"k{i}": i for i in range(5)}
+
+
+class TestSheddingAndStarvation:
+    def test_deadline_shed_degrades_and_recovers(self):
+        """A pathologically small tick budget: every tick admits at
+        least the head of the rotation (minimum progress), sheds the
+        backlogged tail with counted svc/shed events, and rotation still
+        drains everyone — overload adds latency, never loss or wedge."""
+        svc = SyncService(ServiceConfig(
+            tick_budget_ms=1e-6,
+            default_budget=TenantBudget(ops_per_tick=4, inbox_cap=64)))
+        base = _seed(svc)
+        clients = [_Client(svc, f"t{i}", "r", base)
+                   for i in range(5)]
+        _settle(svc, clients, max_ticks=600)
+        for i, c in enumerate(clients):
+            c.edit(f"k{i}", i)
+            c.pump()
+        with obs.tracing():
+            for _ in range(3):
+                svc.tick()
+            assert _counters().get("svc.shed", 0) > 0
+        assert svc.stats["shed_total"] > 0
+        _settle(svc, clients, max_ticks=600)
+        server = svc.room("r").doc_set.get_doc("r")
+        assert am.to_json(server)["m"] == {f"k{i}": i for i in range(5)}
+        assert all(c.sess.stats["last_admit_tick"] > 0 for c in clients)
+
+    def test_low_priority_is_bounded_latency_not_never(self):
+        """Under permanent deadline pressure the starvation boost
+        front-runs a backlogged low-priority tenant past the highs."""
+        cfg = ServiceConfig(tick_budget_ms=1e-6, starvation_boost_ticks=3)
+        svc = SyncService(cfg)
+        base = _seed(svc)
+        lo = _Client(svc, "lo", "r", base)
+        lo_sess = svc.connect("lo", "r", lo.to_client.append,
+                              budget=TenantBudget(priority=-5))
+        lo.sess = lo_sess                   # reconnect with low priority
+        lo.conn.close()
+        lo.chan = ResilientChannel(lo.to_server.append, None)
+        lo.conn = Connection(lo.ds, lo.chan.send)
+        lo.chan._deliver = lo.conn.receive_msg
+        lo.conn.open()
+        highs = [_Client(svc, f"hi{i}", "r", base)
+                 for i in range(4)]
+        _settle(svc, [lo] + highs, max_ticks=600)
+        lo.edit("lo_key", 1)
+        for i, c in enumerate(highs):
+            c.edit(f"hi{i}", i)
+        _settle(svc, [lo] + highs, max_ticks=600)
+        assert svc.stats["max_starved_streak"] \
+            <= 2 * cfg.starvation_boost_ticks
+        server = svc.room("r").doc_set.get_doc("r")
+        assert am.to_json(server)["m"]["lo_key"] == 1
+
+
+class TestPeerHealthLadder:
+    def _svc(self, **kw):
+        cfg = ServiceConfig(**{"heartbeat_ticks": 3,
+                               "suspect_grace_ticks": 3,
+                               "max_retries": 1000, **kw})
+        svc = SyncService(cfg)
+        return svc, _seed(svc)
+
+    def test_silent_owed_peer_escalates_suspect_dead_evicted(self):
+        from automerge_tpu.service import DEAD, SUSPECT
+        svc, base = self._svc()
+        c = _Client(svc, "ghost", "r", base)
+        _settle(svc, [c])
+        # server owes the peer frames; the peer goes silent (no pumps)
+        room = svc.room("r")
+        room.doc_set.set_doc("r", am.change(
+            room.doc_set.get_doc("r"),
+            lambda d: d["m"].__setitem__("x", 1)))
+        assert c.sess.channel.in_flight > 0
+        states = set()
+        for _ in range(20):
+            svc.tick()
+            s = svc.session("ghost")
+            if s is None:
+                break
+            states.add(s.state)
+        assert SUSPECT in states
+        assert svc.session("ghost") is None
+        assert svc.stats["evictions"] == 1
+        assert svc.reclaimed("ghost")
+        assert c.sess.state == DEAD
+
+    def test_idle_unowed_peer_is_never_suspected(self):
+        from automerge_tpu.service import LIVE
+        svc, base = self._svc()
+        c = _Client(svc, "quiet", "r", base)
+        _settle(svc, [c])
+        for _ in range(30):                 # silent but nothing owed
+            svc.tick()
+        assert svc.session("quiet").state == LIVE
+
+    def test_any_frame_recovers_a_suspect(self):
+        from automerge_tpu.service import LIVE, SUSPECT
+        svc, base = self._svc()
+        c = _Client(svc, "laggy", "r", base)
+        _settle(svc, [c])
+        room = svc.room("r")
+        room.doc_set.set_doc("r", am.change(
+            room.doc_set.get_doc("r"),
+            lambda d: d["m"].__setitem__("x", 1)))
+        while svc.session("laggy").state != SUSPECT:
+            svc.tick()
+        c.pump()                            # drain frames, queue the ack
+        c.pump()                            # the ack reaches the server
+        assert svc.session("laggy").state == LIVE
+        _settle(svc, [c])
+        assert svc.session("laggy") is not None
+
+    def test_retransmit_cap_is_the_dead_backstop(self):
+        svc, base = self._svc(heartbeat_ticks=10_000, max_retries=2)
+        c = _Client(svc, "void", "r", base)
+        _settle(svc, [c])
+        room = svc.room("r")
+        room.doc_set.set_doc("r", am.change(
+            room.doc_set.get_doc("r"),
+            lambda d: d["m"].__setitem__("x", 1)))
+        for _ in range(200):
+            svc.tick()
+            if svc.session("void") is None:
+                break
+        assert svc.session("void") is None
+        assert svc.reclaimed("void")
+
+    def test_eviction_reclaims_quarantined_changes(self):
+        svc, base = self._svc()
+        c = _Client(svc, "parker", "r", base)
+        _settle(svc, [c])
+        gate = svc.room("r").gate
+        gate.deliver("r", [_premature("a", 7)], validated=True,
+                     sender="parker")
+        assert gate._n_parked == 1
+        svc.evict("parker", reason="test")
+        assert gate._n_parked == 0
+        assert svc.reclaimed("parker")
+
+    def test_matrix_slots_bounded_across_tenant_churn(self):
+        svc, base = self._svc()
+        stable = _Client(svc, "stable", "r", base)
+        _settle(svc, [stable])
+        for i in range(50):
+            c = _Client(svc, f"churn-{i}", "r", base)
+            _settle(svc, [stable, c])
+            svc.disconnect(f"churn-{i}")
+        mat = svc.room("r").hub._matrix
+        assert mat.peer_slots <= 3
+
+
+class TestRejoin:
+    def test_same_id_reconnect_evicts_stale_and_bootstraps(self):
+        svc = SyncService()
+        base = _seed(svc)
+        c1 = _Client(svc, "t", "r", base)
+        c2 = _Client(svc, "peer", "r", base)
+        c1.edit("pre", 1)
+        _settle(svc, [c1, c2])
+        # t vanishes and reconnects EMPTY (a rejoiner bootstraps from
+        # the server; its old session is evicted first)
+        c1b = _Client(svc, "t", "r")
+        assert svc.stats["rejoins"] == 1
+        assert svc.stats["evictions"] == 1
+        _settle(svc, [c1b, c2])
+        server = svc.room("r").doc_set.get_doc("r")
+        assert c1b.doc() is not None
+        assert _same_doc([server, c1b.doc(), c2.doc()])
+
+    def test_join_storm_served_from_one_snapshot_encode(self):
+        """N empty joiners bootstrapping a long-history doc: ONE
+        snapshot capture serves the whole storm (the rest hit the cached
+        bundle), and everyone converges byte-identically."""
+        svc = SyncService()
+        doc = _room_doc()
+        for i in range(12):
+            doc = am.change(doc, lambda d, i=i:
+                            d["m"].__setitem__(f"h{i}", i))
+        svc.seed_doc("r", doc)
+        hub = svc.room("r").hub
+        hub.snapshot_min_changes = 4
+        with obs.tracing():
+            storm = [_Client(svc, f"j{i}", "r") for i in range(8)]
+            _settle(svc, storm)
+            counters = _counters()
+        assert counters.get("sync.snapshot_capture") == 1
+        assert counters.get("sync.snapshot_serve_cached", 0) >= 7
+        server = svc.room("r").doc_set.get_doc("r")
+        docs = [server] + [c.doc() for c in storm]
+        assert all(d is not None for d in docs)
+        assert _same_doc(docs)
+        saves = {am.save(d) for d in docs}
+        assert len(saves) == 1              # byte-identical serialization
+
+
+class TestInboundSnapshot:
+    def test_tenant_served_checkpoint_installs_not_parks(self):
+        """The reverse bootstrap: the server requests a doc it does not
+        hold and the tenant answers checkpoint+tail. The message must
+        dispatch on its checkpoint (full hub semantics), NOT have the
+        tail stripped into grouped admission — the tail's deps live
+        inside the bundle, so stripping would park every tail change as
+        premature forever."""
+        from automerge_tpu.sync.hub import shared_hub
+        svc = SyncService()
+        doc = _room_doc()
+        for i in range(16):
+            doc = am.change(doc, lambda d, i=i:
+                            d["m"].__setitem__(f"h{i}", i))
+        c = _Client(svc, "holder", "r")
+        c.ds.set_doc("r", doc)              # tenant advertises the doc
+        shared_hub(c.ds).snapshot_min_changes = 4   # force the ckpt path
+        _settle(svc, [c])
+        server_doc = svc.room("r").doc_set.get_doc("r")
+        assert server_doc is not None, "server never installed the doc"
+        assert am.save(server_doc) == am.save(c.doc())
+        assert svc.room("r").gate._n_parked == 0
+        # the hard case: the tenant's snapshot cache is now primed; two
+        # more edits (< the staleness threshold) mean the NEXT requester
+        # gets the CACHED bundle + a non-empty tail whose deps live
+        # inside the bundle — the tail must ride the checkpoint, not be
+        # stripped into grouped admission (where it would park forever)
+        for i in range(2):
+            c.edit(f"tail{i}", i)
+        svc2 = SyncService()
+        c2 = _Client.__new__(_Client)
+        c2.svc, c2.tid, c2.room_id = svc2, "holder2", "r"
+        c2.to_server, c2.to_client = deque(), deque()
+        c2.ds = c.ds                        # same replica, second service
+        svc2.connect("holder2", "r", c2.to_client.append)
+        c2.chan = ResilientChannel(c2.to_server.append, None)
+        c2.conn = Connection(c2.ds, c2.chan.send)
+        c2.chan._deliver = c2.conn.receive_msg
+        with obs.tracing():
+            c2.conn.open()
+            _settle(svc2, [c2])
+            counters = _counters()
+        assert counters.get("sync.snapshot_serve_cached", 0) >= 1, \
+            "scenario failed to exercise the cached-bundle + tail path"
+        server2 = svc2.room("r").doc_set.get_doc("r")
+        assert server2 is not None
+        assert am.save(server2) == am.save(c.doc())
+        assert svc2.room("r").gate._n_parked == 0
+
+
+class TestFailureIsolation:
+    def test_malformed_payload_counts_against_its_sender_only(self):
+        svc = SyncService()
+        base = _seed(svc)
+        good = _Client(svc, "good", "r", base)
+        bad = _Client(svc, "bad", "r", base)
+        _settle(svc, [good, bad])
+        bad.chan.send({"docId": "r", "changes": ["not a change"]})
+        good.edit("ok", 1)
+        _settle(svc, [good, bad])
+        assert svc.session("bad").stats["protocol_errors"] == 1
+        assert svc.session("good").stats["protocol_errors"] == 0
+        assert svc.session("bad") is not None    # degraded, not torn down
+        server = svc.room("r").doc_set.get_doc("r")
+        assert am.to_json(server)["m"]["ok"] == 1
+        # the bad tenant still syncs afterwards
+        bad.edit("still_works", 2)
+        _settle(svc, [good, bad])
+        assert am.to_json(svc.room("r").doc_set.get_doc("r"))[
+            "m"]["still_works"] == 2
+
+    def test_rooms_isolate_tenants(self):
+        svc = SyncService()
+        base1 = _seed(svc, "r1", "o1")
+        base2 = _seed(svc, "r2", "o2")
+        a = _Client(svc, "a", "r1", base1)
+        b = _Client(svc, "b", "r2", base2)
+        a.edit("only_r1", 1)
+        _settle(svc, [a, b])
+        assert "only_r1" not in am.to_json(
+            svc.room("r2").doc_set.get_doc("r2"))["m"]
+        assert b.doc() is not None
+        assert "only_r1" not in am.to_json(b.doc())["m"]
