@@ -63,6 +63,7 @@ from .recorder import (  # noqa: F401  (re-exported for consumers/tests)
     ARGS, CAT, DUR, EVENT_DUR, NAME, TID, TS, FlightRecorder,
     span_seconds, span_totals,
 )
+from .telemetry import Telemetry  # noqa: F401  (re-exported)
 
 #: THE fast-path gate. Instrumented call sites read this module attribute
 #: directly (`if obs.ENABLED:`) so a disabled process pays one dict
@@ -70,6 +71,7 @@ from .recorder import (  # noqa: F401  (re-exported for consumers/tests)
 ENABLED = False
 
 _recorder: Optional[FlightRecorder] = None
+_telemetry: Optional[Telemetry] = None
 
 now = time.perf_counter_ns   # monotonic ns — the span clock
 
@@ -83,13 +85,25 @@ def recorder() -> Optional[FlightRecorder]:
     return _recorder
 
 
+def telemetry() -> Optional[Telemetry]:
+    """The live rolling-telemetry store (None when tracing never
+    enabled). Created and cleared in lockstep with the recorder; fed at
+    emit time by span()/event()/counter(), so its aggregates stay exact
+    across trace-ring wraparound (INTERNALS §14)."""
+    return _telemetry
+
+
 def enable(capacity: Optional[int] = None) -> FlightRecorder:
-    """Turn tracing on (idempotent). A recorder is created on first
-    enable and retained across disable() so late readers can still
-    export; pass `capacity` (records per stripe) to size a fresh one."""
-    global ENABLED, _recorder
+    """Turn tracing on (idempotent). A recorder (and its telemetry
+    sibling) is created on first enable and retained across disable()
+    so late readers can still export; pass `capacity` (records per
+    stripe) to size a fresh pair."""
+    global ENABLED, _recorder, _telemetry
     if _recorder is None or capacity is not None:
         _recorder = FlightRecorder(capacity)
+        _telemetry = Telemetry()
+    elif _telemetry is None:
+        _telemetry = Telemetry()
     ENABLED = True
     return _recorder
 
@@ -127,8 +141,11 @@ def span(cat: str, name: str, t0_ns: int, args: Optional[dict] = None,
     if rec is None or not t0_ns:
         return
     end = t1_ns if t1_ns is not None else time.perf_counter_ns()
-    rec.emit((t0_ns, max(0, end - t0_ns), cat, name,
-              threading.get_ident(), args))
+    dur = max(0, end - t0_ns)
+    rec.emit((t0_ns, dur, cat, name, threading.get_ident(), args))
+    tel = _telemetry
+    if tel is not None:
+        tel.observe_span(cat, name, dur, ts_ns=t0_ns)
 
 
 def event(cat: str, name: str, args: Optional[dict] = None, n: int = 1):
@@ -136,9 +153,12 @@ def event(cat: str, name: str, args: Optional[dict] = None, n: int = 1):
     rec = _recorder
     if rec is None:
         return
-    rec.emit((time.perf_counter_ns(), EVENT_DUR, cat, name,
-              threading.get_ident(), args))
+    ts = time.perf_counter_ns()
+    rec.emit((ts, EVENT_DUR, cat, name, threading.get_ident(), args))
     rec.bump((cat, name), n)
+    tel = _telemetry
+    if tel is not None:
+        tel.observe_count(cat, name, n, ts_ns=ts)
 
 
 def counter(cat: str, name: str, n: int = 1):
@@ -147,6 +167,9 @@ def counter(cat: str, name: str, n: int = 1):
     rec = _recorder
     if rec is not None:
         rec.bump((cat, name), n)
+        tel = _telemetry
+        if tel is not None:
+            tel.observe_count(cat, name, n)
 
 
 @contextmanager
@@ -174,21 +197,32 @@ def snapshot(since_ns: int = 0) -> list:
 
 def metrics_snapshot(since_ns: int = 0) -> dict:
     """Aggregate view of the session: exact counters (wrap-proof) plus
-    per-(cat, name) span histograms from the retained ring records.
+    per-(cat, name) span aggregates.
 
         {"counters": {"chaos.drop": 12, ...},
          "spans": {"plan.prepare_batch": {"count", "total_ns",
                                           "min_ns", "max_ns"}, ...},
          "emitted": <total records ever>, "retained": <in ring now>}
+
+    Span aggregates come from the telemetry store (fed at emit time),
+    so they stay EXACT after trace-ring wraparound — the ISSUE 9 bug
+    class. A `since_ns` query falls back to the retained ring records
+    (windowed queries belong to `telemetry().windows()`); the ring view
+    is also always available directly via `span_totals(snapshot())`.
     """
     if _recorder is None:
         return {"counters": {}, "spans": {}, "emitted": 0, "retained": 0}
-    records = _recorder.snapshot(since_ns)
+    if since_ns == 0 and _telemetry is not None:
+        spans = {f"{c}.{n}": dict(agg) for (c, n), agg
+                 in sorted(_telemetry.span_aggregates().items())}
+    else:
+        spans = {f"{c}.{n}": agg for (c, n), agg
+                 in sorted(span_totals(_recorder.snapshot(since_ns))
+                           .items())}
     return {
         "counters": {f"{c}.{n}": v
                      for (c, n), v in sorted(_recorder.counters().items())},
-        "spans": {f"{c}.{n}": agg
-                  for (c, n), agg in sorted(span_totals(records).items())},
+        "spans": spans,
         "emitted": _recorder.n_emitted,
         "retained": _recorder.n_retained,
     }
@@ -197,6 +231,8 @@ def metrics_snapshot(since_ns: int = 0) -> dict:
 def clear():
     if _recorder is not None:
         _recorder.clear()
+    if _telemetry is not None:
+        _telemetry.clear()
 
 
 def write_trace(path: str, since_ns: int = 0) -> str:

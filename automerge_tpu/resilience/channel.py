@@ -237,3 +237,18 @@ class ResilientChannel:
     @property
     def in_flight(self) -> int:
         return len(self._unacked)
+
+    @property
+    def buffered(self) -> int:
+        """Frames held in the out-of-order reorder buffer (bounded by
+        the receive window) — credit-occupancy introspection."""
+        return len(self._recv_buf)
+
+    def pending_payloads(self) -> list:
+        """The payloads of every un-acked outbound frame, send order —
+        what the peer has NOT durably received yet. The service tier's
+        lag probe counts the change batches in here as the wire
+        component of replication lag (the hub's believed clocks advance
+        optimistically at send time, so the matrix alone can't see
+        in-flight loss)."""
+        return [self._unacked[s]["payload"] for s in sorted(self._unacked)]

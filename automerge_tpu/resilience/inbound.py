@@ -177,6 +177,21 @@ class InboundGate:
         q = self._quarantine.get(doc_id)
         return len(q) if q else 0
 
+    def quarantine_items(self, doc_id: str = None) -> list:
+        """Non-destructive snapshot of everything parked (one doc, or
+        all): [(doc_id, actor, seq, sender)]. The public face of the
+        per-doc queues for the service tier's reclamation check and the
+        postmortem dump — callers never touch ``_quarantine``."""
+        docs = ([doc_id] if doc_id is not None
+                else list(self._quarantine))
+        out = []
+        for d in docs:
+            q = self._quarantine.get(d)
+            if q is not None:
+                out.extend((d, a, s, sender)
+                           for a, s, sender in q.entries())
+        return out
+
     def quarantine_stats(self, doc_id: str = None) -> dict:
         """Per-doc stats, or the aggregate across every quarantined doc."""
         if doc_id is not None:
@@ -184,7 +199,7 @@ class InboundGate:
             return dict(q.stats) if q is not None else \
                 {"parked": 0, "evicted": 0, "released": 0, "peak": 0}
         agg = {"parked": 0, "evicted": 0, "released": 0, "peak": 0}
-        for q in self._quarantine.values():
+        for q in list(self._quarantine.values()):
             for k in agg:
                 agg[k] += q.stats[k]
         return agg

@@ -110,6 +110,14 @@ class QuarantineQueue:
         self.stats["evicted"] += len(keys)
         return len(keys)
 
+    def entries(self) -> list:
+        """Non-destructive snapshot of the parked population:
+        [(actor, seq, sender)] in admission order — the public face of
+        ``_items`` for introspection (service reclamation checks, the
+        postmortem dump)."""
+        return [(a, s, sender)
+                for (a, s), (_, sender) in list(self._items.items())]
+
     def drain_items(self) -> list:
         """Remove and return every parked ``(change, sender)`` pair in
         admission order. The caller re-parks whatever is still premature

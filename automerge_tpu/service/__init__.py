@@ -18,7 +18,10 @@ Quickstart (in-process transport; see README "Running the sync service"):
     ...                      # transport feeds frames to sess.on_wire
     svc.tick()               # one scheduler round (admission -> health
                              #  -> eviction -> one flush per room)
-    print(svc.metrics())     # p99_tick_ms, shed_total, evictions, peaks
+    print(svc.metrics())     # p99_tick_ms, shed_total, evictions, peaks,
+                             #  max_lag_ops/ticks (INTERNALS §14.2)
+    srv = svc.serve_metrics(port=9464)   # Prometheus /metrics + the
+    print(svc.describe())    # black-box postmortem dump    # /describe
 """
 
 from .budget import ServiceConfig, TenantBudget, approx_msg_bytes  # noqa: F401

@@ -71,13 +71,26 @@ class ServiceConfig:
       nothing for this many consecutive ticks jumps the priority order
       on its next visit (the no-tenant-starves guarantee).
     - ``tick_ring``: how many tick durations the p50/p99 metrics window
-      retains.
+      retains (the bounded history the percentiles are computed over —
+      a long-lived service never accumulates unbounded timings).
+    - ``lag_probe_ticks``: replication-lag probe cadence (every N ticks;
+      0 disables). Each probe is one vectorized ClockMatrix comparison
+      per room plus a bounded un-acked-frame scan per tenant
+      (INTERNALS §14.2).
+    - ``event_log``: how many degradation events (defer / shed /
+      suspect / evict / protocol_error ...) the black-box ring retains
+      for ``SyncService.describe()`` — the postmortem dump works with
+      tracing OFF, so the service keeps its own bounded ring.
+    - ``prom_lag_series``: at most this many per-tenant lag gauge
+      series on the scrape page (worst-lagging first); aggregates are
+      always exported, so the page stays bounded at any tenant count.
     """
 
     __slots__ = ("tick_budget_ms", "heartbeat_ticks", "suspect_grace_ticks",
                  "max_retries", "base_rto", "max_rto", "recv_window",
                  "quarantine_capacity", "quarantine_global_capacity",
-                 "starvation_boost_ticks", "tick_ring", "default_budget")
+                 "starvation_boost_ticks", "tick_ring", "default_budget",
+                 "lag_probe_ticks", "event_log", "prom_lag_series")
 
     def __init__(self, *, tick_budget_ms: float = 0.0,
                  heartbeat_ticks: int = 30, suspect_grace_ticks: int = 30,
@@ -86,7 +99,9 @@ class ServiceConfig:
                  quarantine_capacity: int = DEFAULT_CAPACITY,
                  quarantine_global_capacity: int = 4 * DEFAULT_CAPACITY,
                  starvation_boost_ticks: int = 8, tick_ring: int = 4096,
-                 default_budget: TenantBudget = None):
+                 default_budget: TenantBudget = None,
+                 lag_probe_ticks: int = 1, event_log: int = 256,
+                 prom_lag_series: int = 64):
         self.tick_budget_ms = tick_budget_ms
         self.heartbeat_ticks = heartbeat_ticks
         self.suspect_grace_ticks = suspect_grace_ticks
@@ -99,6 +114,9 @@ class ServiceConfig:
         self.starvation_boost_ticks = starvation_boost_ticks
         self.tick_ring = tick_ring
         self.default_budget = default_budget or TenantBudget()
+        self.lag_probe_ticks = lag_probe_ticks
+        self.event_log = event_log
+        self.prom_lag_series = prom_lag_series
 
 
 def approx_msg_bytes(msg) -> int:

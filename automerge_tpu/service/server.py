@@ -43,11 +43,13 @@ Architecture decisions (the why, not just the what):
 
 from __future__ import annotations
 
+import math
 import time
 from collections import deque
 from contextlib import ExitStack
 
 from .. import obs
+from ..obs.telemetry import Telemetry
 from ..resilience.channel import ResilientChannel
 from ..resilience.errors import ProtocolError
 from ..resilience.inbound import InboundGate
@@ -82,7 +84,8 @@ class TenantSession:
 
     __slots__ = ("tenant_id", "room_id", "budget", "channel", "inbox",
                  "inbox_bytes", "last_inbound_tick", "state", "suspect_at",
-                 "starved_streak", "pending_dead", "stats", "_svc")
+                 "starved_streak", "pending_dead", "stats", "_svc",
+                 "lag_ops", "lag_wire_ops", "lag_since_tick")
 
     def __init__(self, svc: "SyncService", tenant_id: str, room_id: str,
                  budget: TenantBudget):
@@ -98,6 +101,9 @@ class TenantSession:
         self.suspect_at = 0
         self.starved_streak = 0
         self.pending_dead = None       # reason string once doomed
+        self.lag_ops = 0               # last probed replication lag
+        self.lag_wire_ops = 0          # ... of which un-acked on the wire
+        self.lag_since_tick = 0        # first tick of the current lag run
         self.stats = {"admitted_msgs": 0, "admitted_ops": 0,
                       "admitted_bytes": 0, "shed": 0, "deferred": 0,
                       "protocol_errors": 0, "last_admit_tick": 0}
@@ -109,6 +115,7 @@ class TenantSession:
         self.last_inbound_tick = self._svc._tick_no
         if self.state == SUSPECT:
             self.state = LIVE
+            self._svc._note("recover", tenant=self.tenant_id)
             if obs.ENABLED:
                 obs.event("svc", "recover", args={"tenant": self.tenant_id})
         try:
@@ -123,6 +130,8 @@ class TenantSession:
             # the tick, or another tenant
             self.stats["protocol_errors"] += 1
             self._svc.stats["protocol_errors"] += 1
+            self._svc._note("protocol_error", tenant=self.tenant_id,
+                            error=str(exc)[:120])
             if obs.ENABLED:
                 obs.event("svc", "protocol_error",
                           args={"tenant": self.tenant_id,
@@ -156,13 +165,30 @@ class SyncService:
         self._tenants: dict = {}        # tenant_id -> TenantSession
         self._order: list = []          # admission rotation (tenant ids)
         self._tick_no = 0
+        # bounded tick-duration window: percentiles in metrics() are
+        # computed over at most `tick_ring` recent ticks, never a
+        # process-lifetime list (the bounded-everything contract)
         self._tick_ms = deque(maxlen=self.config.tick_ring)
+        #: always-on rolling telemetry (independent of obs tracing):
+        #: tick-duration histogram + admission/degradation counter
+        #: series + lag gauges — what the scrape endpoint exports
+        self.telemetry = Telemetry()
+        # black-box degradation-event ring for describe(): the
+        # postmortem must work with tracing OFF, so the service keeps
+        # its own bounded copy of the ladder events it obs-emits
+        self._events = deque(maxlen=self.config.event_log)
         self.stats = {"ticks": 0, "admitted_msgs": 0, "admitted_ops": 0,
                       "admitted_bytes": 0, "deferrals": 0, "shed_total": 0,
                       "evictions": 0, "joins": 0, "rejoins": 0,
                       "protocol_errors": 0, "max_starved_streak": 0,
                       "peak_inbox": 0, "peak_parked": 0, "peak_recv_buf": 0,
+                      "peak_lag_ops": 0, "peak_lag_ticks": 0,
                       "backpressured_closed": 0, "retransmits_closed": 0}
+
+    def _note(self, kind: str, **args):
+        """Append one degradation/lifecycle event to the bounded
+        black-box ring (the describe() postmortem feed)."""
+        self._events.append({"tick": self._tick_no, "event": kind, **args})
 
     # -- lifecycle ------------------------------------------------------
 
@@ -201,6 +227,8 @@ class SyncService:
         room.tenants.add(tenant_id)
         room.hub.add_peer(tenant_id, sess.channel.send)
         self.stats["rejoins" if rejoin else "joins"] += 1
+        self._note("rejoin" if rejoin else "join",
+                   tenant=tenant_id, room=room_id)
         if obs.ENABLED:
             obs.event("svc", "rejoin" if rejoin else "join",
                       args={"tenant": tenant_id, "room": room_id})
@@ -238,6 +266,9 @@ class SyncService:
         sess.inbox_bytes = 0
         sess.state = DEAD
         self.stats["evictions"] += 1
+        self.telemetry.observe_count("svc", "evict")
+        self._note("evict", tenant=tenant_id, reason=reason,
+                   quarantine_dropped=dropped)
         if obs.ENABLED:
             obs.event("svc", "evict",
                       args={"tenant": tenant_id, "reason": reason,
@@ -253,6 +284,9 @@ class SyncService:
         t_start = time.perf_counter()
         self._tick_no += 1
         cfg = self.config
+        ops0 = self.stats["admitted_ops"]
+        msgs0 = self.stats["admitted_msgs"]
+        defer0 = self.stats["deferrals"]
         deadline = (t_start + cfg.tick_budget_ms / 1e3) \
             if cfg.tick_budget_ms else None
         groups: dict = {}       # (room_id, doc_id) -> [changes, senders]
@@ -289,6 +323,7 @@ class SyncService:
                     self._starve(sess)
             if shed:
                 self.stats["shed_total"] += shed
+                self._note("shed", msgs=shed)
                 if obs.ENABLED:
                     obs.event("svc", "shed",
                               args={"msgs": shed, "tick": self._tick_no},
@@ -307,6 +342,7 @@ class SyncService:
                     # parked/dropped the poison with per-sender stats;
                     # the service just counts the rejection
                     self.stats["protocol_errors"] += 1
+                    self._note("reject", doc=doc_id, error=str(exc)[:120])
                     if obs.ENABLED:
                         obs.event("svc", "reject",
                                   args={"doc": doc_id,
@@ -320,9 +356,28 @@ class SyncService:
                          if s.pending_dead]:
                 self.evict(sess.tenant_id, sess.pending_dead)
         self._track_bounds()
+        if cfg.lag_probe_ticks \
+                and self._tick_no % cfg.lag_probe_ticks == 0:
+            self.probe_lag()
         self.stats["ticks"] += 1
         dt_ms = (time.perf_counter() - t_start) * 1e3
         self._tick_ms.append(dt_ms)
+        # the always-on rolling telemetry (works with tracing off):
+        # tick-duration histogram + this tick's admission/degradation
+        # deltas as counter series, scrape-exported (INTERNALS §14)
+        tel = self.telemetry
+        tel.observe_span("svc", "tick", int(dt_ms * 1e6))
+        d_ops = self.stats["admitted_ops"] - ops0
+        if d_ops:
+            tel.observe_count("svc", "admitted_ops", d_ops)
+        d_msgs = self.stats["admitted_msgs"] - msgs0
+        if d_msgs:
+            tel.observe_count("svc", "admitted_msgs", d_msgs)
+        d_defer = self.stats["deferrals"] - defer0
+        if d_defer:
+            tel.observe_count("svc", "defer", d_defer)
+        if shed:
+            tel.observe_count("svc", "shed", shed)
         if obs.ENABLED:
             obs.span("svc", "tick", t0,
                      args={"tick": self._tick_no, "shed": shed,
@@ -367,6 +422,8 @@ class SyncService:
                 # must not inflate the stat N times over
                 sess.stats["deferred"] += 1
                 self.stats["deferrals"] += 1
+                self._note("defer", tenant=sess.tenant_id,
+                           backlog=len(sess.inbox))
                 if obs.ENABLED:
                     obs.event("svc", "defer",
                               args={"tenant": sess.tenant_id,
@@ -414,6 +471,8 @@ class SyncService:
             except ProtocolError as exc:
                 sess.stats["protocol_errors"] += 1
                 self.stats["protocol_errors"] += 1
+                self._note("protocol_error", tenant=sess.tenant_id,
+                           error=str(exc)[:120])
                 if obs.ENABLED:
                     obs.event("svc", "protocol_error",
                               args={"tenant": sess.tenant_id,
@@ -435,6 +494,8 @@ class SyncService:
                 if owed and silent >= cfg.heartbeat_ticks:
                     sess.state = SUSPECT
                     sess.suspect_at = self._tick_no
+                    self._note("suspect", tenant=sess.tenant_id,
+                               silent_ticks=silent)
                     if obs.ENABLED:
                         obs.event("svc", "suspect",
                                   args={"tenant": sess.tenant_id,
@@ -445,6 +506,63 @@ class SyncService:
                 elif self._tick_no - sess.suspect_at \
                         >= cfg.suspect_grace_ticks:
                     self._mark_dead(sess, "heartbeat_timeout")
+
+    # -- replication-lag probes (INTERNALS §14.2) -----------------------
+
+    def probe_lag(self):
+        """Refresh every live tenant's replication lag: the room hub's
+        ClockMatrix deficit (changes not yet extracted for the peer —
+        one vectorized comparison per room) PLUS the un-acked wire
+        component (change batches sitting in the tenant channel's send
+        window: believed clocks advance optimistically at send time, so
+        the matrix alone cannot see in-flight frames). Runs every
+        ``lag_probe_ticks`` inside tick(); callable directly for a
+        fresh table."""
+        peak_ops = self.stats["peak_lag_ops"]
+        peak_ticks = self.stats["peak_lag_ticks"]
+        for room in self._rooms.values():
+            if not room.tenants:
+                continue
+            table = room.hub.replication_lag()
+            for tid in room.tenants:
+                sess = self._tenants.get(tid)
+                if sess is None or sess.pending_dead:
+                    continue
+                wire = 0
+                for payload in sess.channel.pending_payloads():
+                    if isinstance(payload, dict):
+                        wire += len(payload.get("changes") or ())
+                matrix = table.get(tid, {}).get("ops", 0)
+                sess.lag_ops = matrix + wire
+                sess.lag_wire_ops = wire
+                if sess.lag_ops:
+                    if not sess.lag_since_tick:
+                        sess.lag_since_tick = self._tick_no
+                    if sess.lag_ops > peak_ops:
+                        peak_ops = sess.lag_ops
+                    ticks = self._tick_no - sess.lag_since_tick + 1
+                    if ticks > peak_ticks:
+                        peak_ticks = ticks
+                else:
+                    sess.lag_since_tick = 0
+        self.stats["peak_lag_ops"] = peak_ops
+        self.stats["peak_lag_ticks"] = peak_ticks
+        mx = max((s.lag_ops for s in self._tenants.values()), default=0)
+        self.telemetry.set_gauge("replication_lag_ops_max", mx)
+
+    def _lag_ticks(self, sess: TenantSession) -> int:
+        return (self._tick_no - sess.lag_since_tick + 1
+                if sess.lag_since_tick else 0)
+
+    def replication_lag(self) -> dict:
+        """The per-tenant lag table from the last probe:
+        {tenant: {"room", "ops", "wire_ops", "ticks"}} — `ops` is the
+        total change deficit (matrix + wire), `ticks` how many ticks
+        the tenant has been continuously behind."""
+        return {tid: {"room": s.room_id, "ops": s.lag_ops,
+                      "wire_ops": s.lag_wire_ops,
+                      "ticks": self._lag_ticks(s)}
+                for tid, s in list(self._tenants.items())}
 
     # -- introspection --------------------------------------------------
 
@@ -468,36 +586,180 @@ class SyncService:
         return all(not s.inbox and s.channel.idle
                    for s in self._tenants.values())
 
-    def metrics(self) -> dict:
+    def metrics(self, lag: dict | None = None) -> dict:
         ring = sorted(self._tick_ms)
-        pct = (lambda p: round(ring[min(len(ring) - 1,
-                                        int(p * len(ring)))], 3)) \
+        # nearest-rank percentiles (ceil(p*n)-1): the p-th percentile is
+        # the smallest value covering at least p of the samples —
+        # int(p*n) overshot by one rank at exact multiples (p50 of 100
+        # ticks read the 51st value)
+        pct = (lambda p: round(
+            ring[max(0, math.ceil(p * len(ring)) - 1)], 3)) \
             if ring else (lambda p: 0.0)
+        sessions = list(self._tenants.values())
         bp = self.stats["backpressured_closed"] + sum(
-            s.channel.stats["backpressured"]
-            for s in self._tenants.values())
+            s.channel.stats["backpressured"] for s in sessions)
         rt = self.stats["retransmits_closed"] + sum(
-            s.channel.stats["retransmits"] for s in self._tenants.values())
+            s.channel.stats["retransmits"] for s in sessions)
+        if lag is None:
+            lag = self.replication_lag()
         return {**{k: v for k, v in self.stats.items()
                    if not k.endswith("_closed")},
-                "live_tenants": len(self._tenants),
+                "live_tenants": len(sessions),
                 "rooms": len(self._rooms),
                 "backpressured_total": bp, "retransmits_total": rt,
+                "max_lag_ops": max((v["ops"] for v in lag.values()),
+                                   default=0),
+                "max_lag_ticks": max((v["ticks"] for v in lag.values()),
+                                     default=0),
+                "lagging_tenants": sum(1 for v in lag.values()
+                                       if v["ops"] > 0),
                 "p50_tick_ms": pct(0.50), "p99_tick_ms": pct(0.99),
                 "max_tick_ms": round(ring[-1], 3) if ring else 0.0}
 
     def reclaimed(self, tenant_id: str) -> bool:
         """True iff no service-side state remains for an evicted tenant:
         session, hub peer, ClockMatrix slot, quarantine attribution (the
-        dead-peer reclamation contract the soak asserts)."""
+        dead-peer reclamation contract the soak asserts). Checked
+        entirely through the substrate's public introspection —
+        `hub.peer_state` and `gate.quarantine_items` — the same surface
+        `describe()` dumps."""
         if tenant_id in self._tenants:
             return False
-        for room in self._rooms.values():
-            if tenant_id in room.hub._peers:
+        for room in list(self._rooms.values()):
+            state = room.hub.peer_state(tenant_id)
+            if state["present"] or state["matrix_slot"]:
                 return False
-            if tenant_id in room.hub._matrix._peers.idx:
+            if any(sender == tenant_id
+                   for *_, sender in room.gate.quarantine_items()):
                 return False
-            for q in room.gate._quarantine.values():
-                if any(s == tenant_id for _, s in q._items.values()):
-                    return False
         return True
+
+    # -- the black-box surface (postmortem dump + Prometheus scrape) ----
+
+    def describe(self) -> dict:
+        """Black-box postmortem dump: one JSON-serializable snapshot of
+        everything an operator needs to reconstruct a failure with
+        tracing OFF — tenant health-ladder states with budget/credit
+        occupancy, the replication-lag table, per-room quarantine
+        state, aggregate metrics, and the last-N degradation events
+        (bounded ring, ``ServiceConfig.event_log``). The soak writes
+        this automatically when an acceptance assertion fails
+        (INTERNALS §14.4)."""
+        cfg = self.config
+        tenants = {}
+        for tid, s in list(self._tenants.items()):
+            tenants[tid] = {
+                "room": s.room_id, "state": s.state,
+                "pending_dead": s.pending_dead,
+                "starved_streak": s.starved_streak,
+                "last_inbound_tick": s.last_inbound_tick,
+                "inbox": len(s.inbox), "inbox_cap": s.budget.inbox_cap,
+                "inbox_bytes": s.inbox_bytes,
+                "in_flight": s.channel.in_flight,
+                "recv_buffered": s.channel.buffered,
+                "lag_ops": s.lag_ops, "lag_wire_ops": s.lag_wire_ops,
+                "lag_ticks": self._lag_ticks(s),
+                "priority": s.budget.priority,
+                "stats": dict(s.stats),
+                "channel": dict(s.channel.stats),
+            }
+        rooms = {}
+        for rid, room in list(self._rooms.items()):
+            rooms[rid] = {
+                "tenants": sorted(room.tenants),
+                "docs": sorted(room.doc_set.doc_ids),
+                "quarantine": room.gate.quarantine_stats(),
+                "parked": [list(item)
+                           for item in room.gate.quarantine_items()[:64]],
+            }
+        lag_table = self.replication_lag()
+        return {
+            "schema": "amtpu-postmortem-v1",
+            "tick": self._tick_no,
+            "config": {"tick_budget_ms": cfg.tick_budget_ms,
+                       "heartbeat_ticks": cfg.heartbeat_ticks,
+                       "suspect_grace_ticks": cfg.suspect_grace_ticks,
+                       "max_retries": cfg.max_retries,
+                       "recv_window": cfg.recv_window,
+                       "starvation_boost_ticks":
+                           cfg.starvation_boost_ticks,
+                       "lag_probe_ticks": cfg.lag_probe_ticks},
+            "metrics": self.metrics(lag_table),
+            "lag": lag_table,
+            "tenants": tenants,
+            "rooms": rooms,
+            "events": list(self._events),
+            "tick_p99_ms_telemetry": self.tick_p99_ms_telemetry(),
+        }
+
+    def tick_p99_ms_telemetry(self) -> float:
+        """Rolling-telemetry p99 bound on tick duration in ms (log-
+        bucket conservative bound) — the one summary term the soak,
+        the bench session row, and the postmortem dump all share."""
+        return round(
+            self.telemetry.quantile_ns("svc", "tick", 0.99) / 1e6, 3)
+
+    def write_postmortem(self, path: str) -> str:
+        """Serialize describe() to `path` (the failed-soak artifact)."""
+        import json
+        with open(path, "w") as fh:
+            json.dump(self.describe(), fh, sort_keys=True, default=str)
+        return path
+
+    def scrape(self) -> str:
+        """The Prometheus exposition page: service counters/gauges, the
+        always-on tick/degradation telemetry (histogram + series), the
+        worst-``prom_lag_series`` per-tenant lag gauges, and — when obs
+        tracing is live — the span/event telemetry under the
+        ``amtpu_obs_`` prefix. Best-effort point-in-time snapshot; never
+        locks the tick loop."""
+        from ..obs import prom
+        lag_table = self.replication_lag()
+        m = self.metrics(lag_table)
+        counter_keys = ("ticks", "admitted_msgs", "admitted_ops",
+                        "admitted_bytes", "deferrals", "shed_total",
+                        "evictions", "joins", "rejoins",
+                        "protocol_errors", "backpressured_total",
+                        "retransmits_total")
+        fams = [(f"amtpu_svc_{k[:-6] if k.endswith('_total') else k}"
+                 "_total", "counter",
+                 f"Service lifetime total of {k}.", [({}, m[k])])
+                for k in counter_keys]
+        gauge_keys = ("live_tenants", "rooms", "max_starved_streak",
+                      "peak_inbox", "peak_parked", "peak_recv_buf",
+                      "peak_lag_ops", "peak_lag_ticks", "max_lag_ops",
+                      "max_lag_ticks", "lagging_tenants",
+                      "p50_tick_ms", "p99_tick_ms", "max_tick_ms")
+        fams += [(f"amtpu_svc_{k}", "gauge",
+                  f"Current value of {k}.", [({}, m[k])])
+                 for k in gauge_keys]
+        lag = sorted(lag_table.items(), key=lambda kv: -kv[1]["ops"])
+        lag = lag[: self.config.prom_lag_series]
+        if lag:
+            fams.append((
+                "amtpu_svc_replication_lag_ops", "gauge",
+                "Per-tenant replication lag in changes (matrix deficit "
+                "+ un-acked wire frames), worst lagging first, series "
+                "bounded by prom_lag_series.",
+                [({"tenant": tid, "room": v["room"]}, v["ops"])
+                 for tid, v in lag]))
+            fams.append((
+                "amtpu_svc_replication_lag_ticks", "gauge",
+                "Ticks each exported tenant has been continuously "
+                "behind.",
+                [({"tenant": tid, "room": v["room"]}, v["ticks"])
+                 for tid, v in lag]))
+        fams += prom.telemetry_families(self.telemetry, "amtpu_svc")
+        if obs.ENABLED and obs.telemetry() is not None:
+            fams += prom.telemetry_families(obs.telemetry(), "amtpu_obs")
+        return prom.expose(fams)
+
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
+        """Start the optional stdlib HTTP scrape endpoint (daemon
+        thread): ``GET /metrics`` -> :meth:`scrape`, ``GET /describe``
+        -> :meth:`describe` as JSON. Returns the
+        :class:`~..obs.prom.ScrapeServer` (``.port``, ``.url``,
+        ``.close()``); port 0 binds an ephemeral port."""
+        from ..obs.prom import ScrapeServer
+        return ScrapeServer(self.scrape, self.describe,
+                            port=port, host=host)

@@ -154,6 +154,41 @@ class ClockMatrix:
         what the churn-storm regression test bounds."""
         return len(self._peers)
 
+    def has_peer(self, peer_id: str) -> bool:
+        """Whether the peer currently occupies a matrix slot (public
+        introspection — `release_peer` is what makes this False)."""
+        return peer_id in self._peers.idx
+
+    def lag_table(self) -> dict:
+        """Replication lag of every interned peer against our local
+        clocks, from ONE vectorized comparison (Okapi's cheap causal
+        metadata, PAPERS.md): {peer_id: {"ops": total change deficit,
+        "docs": {doc_id: deficit}}} counting only ACTIVE (revealed)
+        pairs. A deficit is the summed per-actor seq shortfall — the
+        number of changes this hub still believes the peer is missing.
+        Believed clocks advance optimistically at send time, so this
+        term alone covers not-yet-extracted changes; the service tier
+        adds the un-acked wire component (INTERNALS §14.2)."""
+        self._sync_shapes()
+        live = [(i, p) for i, p in enumerate(self._peers.items)
+                if p is not None]
+        out = {p: {"ops": 0, "docs": {}} for _, p in live}
+        if not self._theirs.size or not live:
+            return out
+        deficit = self._ours[None, :, :] - self._theirs
+        np.clip(deficit, 0, None, out=deficit)
+        deficit *= self._active[:, :, None]
+        per_pair = deficit.sum(axis=2)               # (peers, docs)
+        for pi, di in zip(*np.nonzero(per_pair)):
+            peer = self._peers.items[pi]
+            doc = self._docs.items[di]
+            if peer is None or doc is None:
+                continue
+            n = int(per_pair[pi, di])
+            out[peer]["docs"][doc] = n
+            out[peer]["ops"] += n
+        return out
+
     def pending(self) -> list:
         """All ACTIVE (peer_id, doc_id) pairs where the peer is missing
         changes: ONE vectorized comparison over every peer, doc, actor."""

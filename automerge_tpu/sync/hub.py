@@ -116,6 +116,36 @@ class SyncHub:
     def has_peers(self) -> bool:
         return bool(self._peers)
 
+    # -- public introspection (the telemetry tier reads ONLY these) -----
+
+    def peer_state(self, peer_id: str) -> dict:
+        """One peer's hub-side state, without reaching into internals:
+        {"present": registered peer, "matrix_slot": occupies a
+        ClockMatrix slot, "revealed_docs"/"advertised_docs"/
+        "session_docs": bookkeeping set sizes}. After `remove_peer`
+        every field is falsy/zero — the reclamation contract
+        `SyncService.reclaimed` checks."""
+        return {
+            "present": peer_id in self._peers,
+            "matrix_slot": self._matrix.has_peer(peer_id),
+            "revealed_docs": sum(1 for p, _ in self._revealed
+                                 if p == peer_id),
+            "advertised_docs": sum(1 for p, _ in self._advertised
+                                   if p == peer_id),
+            "session_docs": sum(1 for p, _ in self._session_docs
+                                if p == peer_id),
+        }
+
+    def replication_lag(self) -> dict:
+        """Per-peer replication lag derived from the ClockMatrix in one
+        vectorized comparison: {peer_id: {"ops", "docs"}} restricted to
+        currently registered peers (a released slot's residue never
+        reports). See ClockMatrix.lag_table for the deficit
+        definition."""
+        table = self._matrix.lag_table()
+        return {p: table.get(p, {"ops": 0, "docs": {}})
+                for p in self._peers}
+
     def open(self):
         self._doc_set.register_handler(self.doc_changed)
         for doc_id in self._doc_set.doc_ids:

@@ -734,8 +734,25 @@ def measure_pipeline(n_batches: int = 6, n_actors: int = 2_000,
 
 def trace_requested() -> bool:
     """`--trace` (or AMTPU_TRACE=1): record the whole run in the obs
-    flight recorder and dump Perfetto-loadable Chrome trace JSON."""
-    return "--trace" in sys.argv or obs.ENABLED
+    flight recorder and dump Perfetto-loadable Chrome trace JSON.
+    `--prom` implies it — the telemetry store is fed at emit time by
+    the same instrumentation."""
+    return "--trace" in sys.argv or "--prom" in sys.argv or obs.ENABLED
+
+
+def write_bench_prom(rec: dict) -> str:
+    """`--prom`: dump the run's emit-time telemetry (exact span/counter
+    aggregates + log-bucket histograms, INTERNALS §14) as a Prometheus
+    exposition page (AMTPU_PROM_OUT overrides the path) and stamp the
+    artifact path into the record."""
+    from automerge_tpu.obs.prom import expose, telemetry_families
+    path = os.environ.get("AMTPU_PROM_OUT", "bench_prom.txt")
+    with open(path, "w") as fh:
+        fh.write(expose(telemetry_families(obs.telemetry(), "amtpu_obs")))
+    rec["prom_path"] = path
+    print(f"bench.py: telemetry exposition written to {path}",
+          file=sys.stderr)
+    return path
 
 
 def write_bench_trace(rec: dict) -> str:
@@ -762,6 +779,8 @@ def main_pipeline():
     rec = measure_pipeline(quick="--quick" in sys.argv)
     if trace_requested():
         write_bench_trace(rec)
+    if "--prom" in sys.argv:
+        write_bench_prom(rec)
     print(json.dumps(rec))
     if is_chip_platform(rec["platform"]):
         append_session_log(rec)
@@ -803,6 +822,8 @@ def main():
         raise
     if trace_requested():
         write_bench_trace(rec)
+    if "--prom" in sys.argv:
+        write_bench_prom(rec)
     print(json.dumps(rec))
     if is_chip_platform(rec["platform"]):
         # the committed session log gets EVERY live chip run, before any

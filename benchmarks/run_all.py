@@ -382,6 +382,7 @@ def config11_service(n_sessions: int = 200, room_size: int = 5,
     for c in clients:
         if c.room_id == rid:
             assert canon(c.ds.get_doc(rid)) == want, "room-0 diverged"
+    svc.probe_lag()                  # fresh lag table for the record
     m = svc.metrics()
     emit(f"cfg11_service_{n_sessions}_sessions", admitted / dt, "ops/s",
          sessions=n_sessions, aggregate_ops_per_sec=round(admitted / dt, 1),
@@ -390,6 +391,14 @@ def config11_service(n_sessions: int = 200, room_size: int = 5,
          deferrals=m["deferrals"], rooms=m["rooms"],
          peak_inbox=m["peak_inbox"], peak_parked=m["peak_parked"],
          admitted_ops=admitted,
+         # telemetry-tier SLO terms (benchmarks/slo_gate.py checks
+         # these against the committed rows): residual lag at
+         # quiescence must be zero; peaks + shed rate are tracked
+         max_lag_ops=m["max_lag_ops"], max_lag_ticks=m["max_lag_ticks"],
+         peak_lag_ops=m["peak_lag_ops"],
+         peak_lag_ticks=m["peak_lag_ticks"],
+         shed_rate=round(m["shed_total"] / max(1, admitted), 6),
+         tick_p99_ms_telemetry=svc.tick_p99_ms_telemetry(),
          threshold=TRACKING_ONLY)
     if record_session:
         import datetime

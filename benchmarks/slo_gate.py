@@ -1,0 +1,185 @@
+"""CI/bench SLO regression gate over the committed session log.
+
+``BENCH_SESSIONS.jsonl`` is the append-only record of every headline run
+(PR-4 credibility rules). This gate turns those rows into machine
+checks: for each SLO, the NEWEST row of a (metric, platform) group is
+compared against the PREVIOUS committed row of the same group — the
+same cross-round, same-platform diffing the tracking-only methodology
+prescribes, minus the human. Span-derived serial-profile terms
+(prepare_s / commit_s, INTERNALS §11.4) and service SLOs (p99_tick_ms,
+shed rate, replication lag at quiescence) are first-class fields.
+
+Run modes:
+
+- ``python -m benchmarks.slo_gate``: warn-only (ALWAYS exits 0) — the
+  CI wiring; a regression prints loudly but cannot block a PR whose
+  whole point may be a documented tradeoff.
+- ``--strict``: exit 1 on any violation (pre-promotion checks).
+- ``--sessions PATH``: an alternate session log (tests).
+
+A group with only one committed row "seeds" its SLO (reported, never a
+violation); a row missing an SLO field is reported as `missing` —
+silent field rot is itself a regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+#: Relative SLOs: (metric_prefix, dotted field, direction, slack).
+#: direction "min": latest must be >= slack * prior (throughput-like);
+#: direction "max": latest must be <= slack * prior (latency-like).
+SLOS = [
+    ("e2e_pipeline_ops_per_sec", "value", "min", 0.8),
+    ("e2e_pipeline_ops_per_sec", "serial_profile.prepare_s", "max", 2.0),
+    ("e2e_pipeline_ops_per_sec", "serial_profile.commit_s", "max", 2.5),
+    ("ops_per_sec_merged_text", "value", "min", 0.8),
+    ("cfg11_service", "value", "min", 0.7),
+    ("cfg11_service", "p99_tick_ms", "max", 1.5),
+    ("cfg11_service", "shed_rate", "max", 2.0),
+]
+
+#: Absolute SLOs: (metric_prefix, dotted field, op, bound) checked on
+#: the newest row alone. The service bench quiesces before it records,
+#: so ANY residual replication lag in its row is a wiring bug, not a
+#: tradeoff.
+ABS_SLOS = [
+    ("cfg11_service", "max_lag_ops", "<=", 0),
+    ("cfg11_service", "max_lag_ticks", "<=", 0),
+]
+
+#: Derived fields computable from any row that carries the inputs.
+DERIVED = {
+    # sheds per admitted op: every committed cfg11 row carries both
+    # inputs, so the gate can derive it even for pre-telemetry rows
+    "shed_rate": lambda row: (
+        row["shed_total"] / max(1, row["admitted_ops"])
+        if "shed_total" in row and "admitted_ops" in row else None),
+}
+
+
+def _field(row: dict, dotted: str):
+    if dotted in DERIVED:
+        return DERIVED[dotted](row)
+    cur = row
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def load_rows(path: str) -> list:
+    """Measurement rows (metric + platform + numeric value) from one
+    JSONL session log, file order preserved; non-row lines (the log's
+    event entries, corrupt lines) are skipped."""
+    rows = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(row, dict) and row.get("metric") \
+                    and row.get("platform"):
+                rows.append(row)
+    return rows
+
+
+def check(rows: list) -> list:
+    """Evaluate every SLO; returns findings as dicts with `status` in
+    {"ok", "violation", "seeds", "missing"} (violations first)."""
+    groups: dict = {}
+    for row in rows:
+        groups.setdefault((row["metric"], row["platform"]), []).append(row)
+    findings = []
+    for (metric, platform), group in sorted(groups.items()):
+        latest = group[-1]
+        prior = group[-2] if len(group) > 1 else None
+        for prefix, field, direction, slack in SLOS:
+            if not metric.startswith(prefix):
+                continue
+            cur = _field(latest, field)
+            base = dict(metric=metric, platform=platform, field=field,
+                        slo=f"{direction} {slack}x prior")
+            if cur is None:
+                findings.append({**base, "status": "missing",
+                                 "detail": "field absent in latest row"})
+                continue
+            ref = _field(prior, field) if prior else None
+            if ref is None:
+                findings.append({**base, "status": "seeds",
+                                 "latest": cur,
+                                 "detail": "no prior committed row"})
+                continue
+            if direction == "min":
+                ok = cur >= slack * ref
+            else:
+                # a tiny prior makes any jitter a "regression": floor
+                # the latency-like reference at a millisecond-scale
+                # epsilon so 0 -> 0.1 ms does not page anyone
+                ok = cur <= slack * max(ref, 1e-3)
+            findings.append({**base,
+                             "status": "ok" if ok else "violation",
+                             "latest": cur, "prior": ref,
+                             "bound": round(slack * max(
+                                 ref, 1e-3 if direction == "max" else 0),
+                                 6)})
+        for prefix, field, op, bound in ABS_SLOS:
+            if not metric.startswith(prefix):
+                continue
+            cur = _field(latest, field)
+            base = dict(metric=metric, platform=platform, field=field,
+                        slo=f"{op} {bound}")
+            if cur is None:
+                findings.append({**base, "status": "seeds",
+                                 "detail": "field absent (pre-telemetry "
+                                           "row)"})
+                continue
+            ok = cur <= bound if op == "<=" else cur >= bound
+            findings.append({**base,
+                             "status": "ok" if ok else "violation",
+                             "latest": cur})
+    order = {"violation": 0, "missing": 1, "seeds": 2, "ok": 3}
+    findings.sort(key=lambda f: order[f["status"]])
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sessions", default=None,
+                    help="session log path (default: repo "
+                         "BENCH_SESSIONS.jsonl)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any violation (default: warn only)")
+    args = ap.parse_args(argv)
+    path = args.sessions or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_SESSIONS.jsonl")
+    if not os.path.exists(path):
+        print(f"slo_gate: no session log at {path} — nothing to check")
+        return 0
+    findings = check(load_rows(path))
+    n_viol = sum(1 for f in findings if f["status"] == "violation")
+    n_missing = sum(1 for f in findings if f["status"] == "missing")
+    for f in findings:
+        if f["status"] == "ok":
+            continue
+        tag = {"violation": "SLO VIOLATION", "missing": "SLO MISSING",
+               "seeds": "SLO SEEDS"}[f["status"]]
+        print(f"slo_gate: {tag}: {json.dumps(f, sort_keys=True)}",
+              file=sys.stderr if f["status"] == "violation" else sys.stdout)
+    print(f"slo_gate: {len(findings)} checks, {n_viol} violations, "
+          f"{n_missing} missing "
+          f"({'STRICT' if args.strict else 'warn-only'})")
+    return 1 if args.strict and (n_viol or n_missing) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -524,6 +524,31 @@ def session_checkpoint(seed: int) -> None:
 #: metrics of the most recent service session (folded into the summary)
 LAST_SERVICE_METRICS: dict = {}
 
+#: --scrape: serve the live Prometheus endpoint during the service soak
+#: and validate the exposition + /describe dump from an actual HTTP
+#: fetch before the acceptance asserts run
+SCRAPE = False
+
+
+def _validate_scrape(url: str):
+    """Fetch the LIVE scrape endpoint: the exposition page must pass the
+    format validator (INTERNALS §14.3) and /describe must parse as the
+    postmortem schema. Results fold into the summary line."""
+    import json as _json
+    import urllib.request
+
+    from automerge_tpu.obs.prom import validate_prom
+
+    page = urllib.request.urlopen(url + "/metrics", timeout=10) \
+        .read().decode()
+    counts = validate_prom(page)
+    dump = _json.loads(
+        urllib.request.urlopen(url + "/describe", timeout=10).read())
+    assert dump.get("schema") == "amtpu-postmortem-v1", dump.get("schema")
+    LAST_SERVICE_METRICS.update(scrape_ok=True,
+                                scrape_families=counts["families"],
+                                scrape_samples=counts["samples"])
+
 
 class _SvcClient:
     """One tenant-side endpoint: DocSet + Connection + ResilientChannel
@@ -601,16 +626,21 @@ def session_service(seed: int, n_clients: int = 24, n_ticks: int = 30,
       3. no tenant starved: max consecutive backlogged-but-unadmitted
          ticks <= 2x the starvation boost threshold;
       4. every killed-and-not-rejoined tenant was EVICTED and its hub /
-         ClockMatrix / quarantine state fully reclaimed."""
-    import json as _json
-    import math
+         ClockMatrix / quarantine state fully reclaimed;
+      5. the telemetry tier agrees: zero replication lag (ClockMatrix
+         deficit + un-acked wire frames) for every live tenant at
+         quiescence.
 
+    Any failure — never-quiesced, divergence, a violated bound — writes
+    the black-box postmortem dump (``SyncService.describe()``) to
+    ``AMTPU_POSTMORTEM_OUT`` (default ``service_postmortem.json``)
+    before re-raising, so a failed soak leaves flight data, not just a
+    seed. With ``--scrape`` the Prometheus endpoint is served live for
+    the whole session and validated over real HTTP at the end."""
     am = _am()
-    from automerge_tpu import Text
     from automerge_tpu.service import ServiceConfig, SyncService, \
         TenantBudget
 
-    rng = np.random.default_rng(seed)
     cfg = ServiceConfig(
         heartbeat_ticks=12, suspect_grace_ticks=12, max_retries=24,
         recv_window=256,
@@ -628,7 +658,38 @@ def session_service(seed: int, n_clients: int = 24, n_ticks: int = 30,
                                     bytes_per_tick=32 * 1024,
                                     inbox_cap=32))
     svc = SyncService(cfg)
+    scrape_srv = svc.serve_metrics() if SCRAPE else None
+    try:
+        _service_scenario(am, svc, cfg, seed, n_clients, n_ticks,
+                          room_size, quiesce_ticks)
+        if scrape_srv is not None:
+            _validate_scrape(scrape_srv.url)
+    except Exception:
+        # the black-box contract: a failing soak leaves a parseable
+        # flight-data dump, not just an assertion message
+        path = os.environ.get("AMTPU_POSTMORTEM_OUT",
+                              "service_postmortem.json")
+        try:
+            svc.write_postmortem(path)
+            print(f"soak: service postmortem written to {path}",
+                  file=sys.stderr, flush=True)
+        except Exception as dump_exc:   # noqa: BLE001 — never mask the
+            print(f"soak: postmortem dump failed: {dump_exc!r}",  # cause
+                  file=sys.stderr, flush=True)
+        raise
+    finally:
+        if scrape_srv is not None:
+            scrape_srv.close()
 
+
+def _service_scenario(am, svc, cfg, seed, n_clients, n_ticks, room_size,
+                      quiesce_ticks):
+    import json as _json
+    import math
+
+    from automerge_tpu import Text
+
+    rng = np.random.default_rng(seed)
     n_rooms = max(1, math.ceil(n_clients / room_size))
     base_changes: dict = {}
     for g in range(n_rooms):
@@ -772,12 +833,17 @@ def session_service(seed: int, n_clients: int = 24, n_ticks: int = 30,
             f"metrics={svc.metrics()})")
 
     # ---- the acceptance asserts ----
+    svc.probe_lag()                 # a fresh lag table for m + assert 5
     m = svc.metrics()
     LAST_SERVICE_METRICS.clear()
     LAST_SERVICE_METRICS.update(m, n_clients=n_clients, n_rooms=n_rooms,
                                 killed=n_kills_done,
                                 rejoined=n_rejoins_done,
-                                orphan_rejoins=n_orphan_rejoins)
+                                orphan_rejoins=n_orphan_rejoins,
+                                # the rolling-telemetry view of the tick
+                                # tail (log-bucket conservative bound)
+                                tick_p99_ms_telemetry=(
+                                    svc.tick_p99_ms_telemetry()))
     # 1. byte-identical convergence of every survivor with its room
     for g in range(n_rooms):
         room_id = f"room-{g}"
@@ -815,6 +881,16 @@ def session_service(seed: int, n_clients: int = 24, n_ticks: int = 30,
     # every kill ends in exactly one eviction (health-ladder eviction for
     # the vanished, or the rejoin path evicting the stale session first)
     assert m["evictions"] >= n_kills_done, m
+    # 5. the telemetry tier agrees convergence is done: zero replication
+    #    lag — matrix deficit AND un-acked wire frames — for every live
+    #    tenant (a quiesced mesh with nonzero lag would mean the probes
+    #    measure something other than what convergence asserts)
+    lag = svc.replication_lag()
+    laggards = {t: v for t, v in lag.items() if v["ops"]}
+    assert not laggards, \
+        f"service seed {seed}: replication lag nonzero at quiescence: " \
+        f"{dict(list(laggards.items())[:5])}"
+    assert m["max_lag_ops"] == 0 and m["max_lag_ticks"] == 0, m
 
 
 PROFILES = {"general": session_general, "conflict": session_conflict,
@@ -824,11 +900,14 @@ PROFILES = {"general": session_general, "conflict": session_conflict,
 
 
 def run(profile: str, sessions: int, seed_base: int,
-        trace: bool = False, clients: int = None) -> int:
+        trace: bool = False, clients: int = None,
+        scrape: bool = False) -> int:
     import json
 
     from automerge_tpu import obs
 
+    global SCRAPE
+    SCRAPE = scrape
     failures = []
     t0 = time.perf_counter()
     names = list(PROFILES) if profile == "all" else [profile]
@@ -918,6 +997,10 @@ def main():
     ap.add_argument("--trace", action="store_true",
                     help="dump the obs flight recorder as Chrome trace "
                          "JSON (Perfetto-loadable) after the campaign")
+    ap.add_argument("--scrape", action="store_true",
+                    help="service profile: serve the live Prometheus "
+                         "scrape endpoint during the soak and validate "
+                         "the exposition + /describe over real HTTP")
     args = ap.parse_args()
     profile = ("chaos" if args.chaos
                else "checkpoint" if args.checkpoint
@@ -931,7 +1014,7 @@ def main():
         # campaign); 30 everywhere else (the historical default)
         sessions = 1 if profile == "service" else 30
     return run(profile, sessions, args.seed_base, trace=args.trace,
-               clients=clients)
+               clients=clients, scrape=args.scrape)
 
 
 if __name__ == "__main__":

@@ -27,6 +27,36 @@ def test_runner_reports_and_exits_cleanly():
     assert soak.run("general", sessions=2, seed_base=100) == 0
 
 
+def test_service_summary_is_exactly_one_json_line(capsys):
+    """The PR-6 artifact contract, re-pinned with the telemetry fields
+    folded in: a --service campaign's stdout ends with EXACTLY one JSON
+    line (the machine-readable summary), and that line now carries the
+    lag/telemetry aggregates alongside the event mix."""
+    import json
+
+    assert soak.run("service", sessions=1, seed_base=3, clients=12) == 0
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.strip().splitlines() if ln.strip()]
+    parsed = []
+    for ln in lines:
+        try:
+            obj = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            parsed.append((ln, obj))
+    assert len(parsed) == 1, [ln for ln, _ in parsed]
+    assert parsed[0][0] == lines[-1]          # and it is the LAST line
+    summary = parsed[0][1]
+    assert summary["converged"] == summary["total"] == 1
+    sm = summary["service_metrics"]
+    for key in ("max_lag_ops", "max_lag_ticks", "peak_lag_ops",
+                "peak_lag_ticks", "tick_p99_ms_telemetry",
+                "p99_tick_ms", "shed_total", "evictions"):
+        assert key in sm, key
+    assert sm["max_lag_ops"] == 0             # quiesced == zero lag
+
+
 @pytest.mark.slow
 def test_chaos_campaign_50_sessions():
     """The ISSUE-1 acceptance bar, runnable on demand (excluded from the
