@@ -25,6 +25,8 @@ recorded follow-up (ROADMAP item 1).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from .._common import HEAD_PARENT, make_elem_id
@@ -162,24 +164,36 @@ class DeviceTextDocSet:
 
     def apply_batches(self, batches: dict):
         """Merge {obj_id: TextChangeBatch}: vmapped fast path for runs-only
-        ready batches; general per-doc engine otherwise."""
+        ready batches; the GENERAL stacked executor (engine/stacked.py)
+        otherwise — every batch the fast tier can't serve graduates its
+        doc and the whole graduated group executes as ONE stacked
+        multi-object apply per call (the same admission/planning/round
+        machinery as the single-device path, so the sync-tier DocSet and
+        the backend path cannot drift; ROADMAP 1b). The pre-unification
+        per-object loop is kept verbatim as the parity comparator behind
+        ``AMTPU_DOCSET_STACKED=0`` (mesh-backed sets also keep it: their
+        graduated rows slice mesh-sharded tables, and the SPMD fast tier
+        IS the sharded execution path)."""
         from ..ops.ingest import bucket
         from ..ops.ingest import expand_runs_dense
 
         self._codes_cache = None
         fast: list = []
+        general: list = []            # (graduated doc, batch)
         for obj_id, batch in batches.items():
             d = self._idx[obj_id]
             if d in self._overlay:
-                self._overlay[d].apply_batch(batch)
+                general.append((self._overlay[d], batch))
                 continue
             plan_pack = self._plan_fast(d, batch)
             if plan_pack == "skip":
                 continue
             if plan_pack is None:
-                self._graduate(d).apply_batch(batch)
+                general.append((self._graduate(d), batch))
             else:
                 fast.append(plan_pack)
+        if general:
+            self._apply_general(general)
         if not fast:
             return self
 
@@ -273,6 +287,22 @@ class DeviceTextDocSet:
             else:
                 meta.seg_bound += 3 * p["n_runs"] + 2
         return self
+
+    def _apply_general(self, general: list):
+        """Apply the graduated group: one stacked multi-object program
+        set per call by default (engine/stacked.apply_stacked consumes
+        the already-decoded batches), per-doc `apply_batch` when the
+        stacked tier declines the population (single doc / tiny payload
+        / skewed caps) or the comparator flag selects the old path."""
+        stacked_route = (self.mesh is None and
+                         os.environ.get("AMTPU_DOCSET_STACKED", "1")
+                         != "0")
+        if stacked_route and len(general) >= 2:
+            from . import stacked as _stacked
+            if _stacked.apply_stacked(general):
+                return
+        for doc, batch in general:
+            doc.apply_batch(batch)
 
     def _plan_fast(self, d: int, b: TextChangeBatch):
         """Host planning for the vmapped path; None -> general engine.

@@ -169,8 +169,15 @@ class PipelinedIngestor:
     path is the common case.
     """
 
-    def __init__(self, doc, slots: int = None, donate: bool = False):
+    def __init__(self, doc, slots: int = None, donate: bool = False,
+                 device=None):
         self.doc = doc
+        #: shard-lane pinning (INTERNALS §15): every prepare (worker
+        #: thread h2d staging) and commit (caller thread dispatch) runs
+        #: inside ``jax.default_device(device)``, so a per-lane ring
+        #: keeps its document's tables and staged plan buffers on ITS
+        #: lane's device. None = the process default, unchanged.
+        self.device = device
         self._n_slots = max(1, pipeline_depth() if slots is None else slots)
         self._slots = threading.Semaphore(self._n_slots)
         self._in: "queue.Queue" = queue.Queue()
@@ -196,9 +203,20 @@ class PipelinedIngestor:
         # caller's degraded-path inline re-prepares (commit_next): two
         # concurrent UNCHAINED prepares could race actor interning
         self._prep_lock = threading.Lock()
+        self._device_ctx = self._make_device_ctx()
         self._thread = threading.Thread(
             target=self._worker, name="amtpu-pipeline", daemon=True)
         self._started = False
+
+    def _make_device_ctx(self):
+        if self.device is None:
+            import contextlib
+
+            def _null():
+                return contextlib.nullcontext()
+            return _null
+        import jax
+        return lambda: jax.default_device(self.device)
 
     # -- context manager -------------------------------------------------
     def __enter__(self):
@@ -278,10 +296,11 @@ class PipelinedIngestor:
                 serial = True
                 with self._cv:
                     self._serial += 1
-                with self._prep_lock:
+                with self._prep_lock, self._device_ctx():
                     plan = self.doc.prepare_batch(batch)
             try:
-                self.doc.commit_prepared(plan)
+                with self._device_ctx():
+                    self.doc.commit_prepared(plan)
             except ValueError:
                 # generation mismatch: the document moved under the
                 # pending plan — re-plan against live state and commit
@@ -294,9 +313,10 @@ class PipelinedIngestor:
                               args={"doc": self.doc.obj_id, "slot": k})
                 with self._cv:
                     self._fallbacks += 1
-                with self._prep_lock:
+                with self._prep_lock, self._device_ctx():
                     plan = self.doc.prepare_batch(batch)
-                self.doc.commit_prepared(plan)
+                with self._device_ctx():
+                    self.doc.commit_prepared(plan)
         finally:
             with self._cv:
                 self._n_committed += 1
@@ -371,7 +391,7 @@ class PipelinedIngestor:
                         continue
                 try:
                     _t0 = obs.now() if obs.ENABLED else 0
-                    with self._prep_lock:
+                    with self._prep_lock, self._device_ctx():
                         plan = self.doc.prepare_batch(batch, after=base)
                     if obs.ENABLED:
                         obs.span("ring", "plan", _t0, args={

@@ -131,6 +131,15 @@ def assert_round_budget(stats: dict = None):
         f"{s['passes']} round-pass(es) over {s['docs']} objects "
         f"(budget {limit}; per-pass dispatch must not scale with "
         f"object count)")
+    # the tightened emission budget (ROADMAP 1a): every finalized text
+    # doc's RGA positions were seeded from the ONE stacked linearize +
+    # packed fetch, so the diff emission right after the apply pays ZERO
+    # per-object positions dispatches (it used to pay one rga_linearize
+    # or materialize+scalars round trip per text object)
+    assert s.get("pos_seeded", 0) == s.get("text_finalized", 0), (
+        f"stacked apply finalized {s.get('text_finalized', 0)} text docs "
+        f"but seeded positions for {s.get('pos_seeded', 0)} — diff "
+        "emission would fall back to per-object linearize dispatches")
 
 
 def _count(stats: dict, label: str):
@@ -211,18 +220,30 @@ def _host_remap(doc, remap: np.ndarray):
     doc._invalidate()
 
 
-def apply_stacked(items) -> bool:
+def _item_ops(subs) -> int:
+    """Wire-op count of one item's change window: a list of wire dicts or
+    an already-decoded columnar batch (the shard lanes / DocSet tier feed
+    decoded batches; the backend feeds wire windows)."""
+    if hasattr(subs, "n_ops"):
+        return int(subs.n_ops)
+    return sum(len(c.get("ops", ())) for c in subs)
+
+
+def apply_stacked(items):
     """Apply one routed delivery as stacked multi-object rounds.
 
     `items`: ``[(doc, sub_changes), ...]`` — one entry per participating
     engine doc (map or text), each with its per-object change window
-    exactly as `_DeviceCore._distribute` routes them. Returns False when
-    the population is ineligible (the caller then runs the per-object
-    path, with nothing mutated); True when the delivery was applied."""
+    exactly as `_DeviceCore._distribute` routes them (wire dicts), or an
+    already-decoded columnar batch (the shard-lane / DocSet callers).
+    Returns False when the population is ineligible (the caller then
+    runs the per-object path, with nothing mutated); the apply's stats
+    dict (truthy — also mirrored in LAST_STATS) when the delivery was
+    applied, so concurrent shard lanes can assert their own per-apply
+    budgets without racing on the module global."""
     if not stacked_rounds_enabled() or len(items) < 2:
         return False
-    n_wire_ops = sum(len(c.get("ops", ())) for _, subs in items
-                     for c in subs)
+    n_wire_ops = sum(_item_ops(subs) for _, subs in items)
     if n_wire_ops < _min_ops():
         return False
     docs = [d for d, _ in items]
@@ -236,7 +257,7 @@ def apply_stacked(items) -> bool:
     # caps only: a population that is ineligible every apply (one hot
     # object, or a skewed-capacity mix) must not pay a discarded
     # decode+schedule on top of the per-object fallback's own
-    op_docs = [d for d, subs in items if any(c.get("ops") for c in subs)]
+    op_docs = [d for d, subs in items if _item_ops(subs)]
     n_map = sum(isinstance(d, DeviceMapDoc) for d in op_docs)
     n_text = len(op_docs) - n_map
     if n_map + n_text < 2:
@@ -251,7 +272,8 @@ def apply_stacked(items) -> bool:
     _t0 = obs.now() if obs.ENABLED else 0
     sched = []           # (doc, [groups per round], queue_after, n_ops)
     for doc, changes in items:
-        batch = doc._decode_wire(changes)
+        batch = (changes if hasattr(changes, "n_changes")
+                 else doc._decode_wire(changes))
         rounds, queue_after, _prior = doc._schedule(batch)
         groups = [doc._group_round(r) for r in rounds]
         n_ops = sum(b.n_ops for gs in groups for b, _r, _m in gs)
@@ -276,7 +298,8 @@ def apply_stacked(items) -> bool:
     # ---- GO: commit queues, hoist interning, run the passes ----------
     stats = {"docs": len(docs), "map_docs": len(map_docs),
              "text_docs": len(text_docs), "rounds": 0, "passes": 0,
-             "dispatches": 0, "syncs": 0, "h2d": 0}
+             "dispatches": 0, "syncs": 0, "h2d": 0,
+             "text_finalized": 0, "pos_seeded": 0}
     map_set = (_LaneSet(map_docs,
                         ("value", "has_value", "win_actor", "win_seq",
                          "win_counter"), "map") if map_docs else None)
@@ -374,7 +397,7 @@ def apply_stacked(items) -> bool:
 
     LAST_STATS.clear()
     LAST_STATS.update(stats)
-    return True
+    return stats
 
 
 def _conflict_matrix(docs, out_cap: int):
@@ -594,10 +617,27 @@ def _finalize(lane_set: _LaneSet, stats: dict):
     """Unstack the final stacked tables back onto each doc (one program)
     and seed every doc's host mirror from ONE packed d2h fetch, so the
     backend's diff emission right after the apply reads pure host
-    state."""
+    state. For the text lane the fetch also carries every doc's RGA
+    positions (one vmapped `stacked_linearize` program, riding the same
+    packed transfer): emission's `_positions()` reads the seeded cache
+    instead of paying one linearize dispatch + sync per object — the
+    stacked path's residual per-object d2h, removed (ROADMAP 1a;
+    asserted by `assert_round_budget`).
+
+    The fetch (and the linearize's sort) is sliced to the LIVE slot
+    prefix, not the table capacity: a serving population preallocates
+    capacity headroom (INTERNALS §15), and shipping (D, K, cap) when
+    max live slots is a fraction of cap made the packed fetch the
+    stacked path's dominant per-apply cost. Host mirrors are rebuilt at
+    full width with ZERO padding — strictly safer than the device
+    tables' padding bytes, which dense-expansion rounds scribble on for
+    inactive lanes; no consumer may read a slot past its live count
+    either way (capture/save serialize live prefixes only, so bundle
+    bytes are unchanged)."""
     if lane_set is None:
         return
     from ..ops import ingest as K
+    from ..ops.ingest import bucket
     if lane_set.cols is None:
         # no round ran on this kind, but a pending remap must still
         # reach the device columns: gather + unstack applies it
@@ -609,14 +649,43 @@ def _finalize(lane_set: _LaneSet, stats: dict):
     mirror_keys = (_MAP_MIRROR_KEYS if lane_set.kind == "map"
                    else _TEXT_MIRROR_KEYS)
     m_idx = [lane_set.keys.index(k) for k in mirror_keys]
+    cap = lane_set.cap
+    if lane_set.kind == "text":
+        live = [doc.n_elems + 1 for doc in lane_set.docs]
+    else:
+        live = [len(doc.key_table) for doc in lane_set.docs]
+    w = min(cap, bucket(max(live + [1]), 64))
+    fetch_cols = [lane_set.cols[i][:, :w] for i in m_idx]
+    if lane_set.kind == "text":
+        import jax.numpy as jnp
+        from ..ops.linearize import stacked_linearize
+        n_el = np.asarray([doc.n_elems for doc in lane_set.docs],
+                          np.int32)
+        _count(stats, "stacked_linearize")
+        stats["h2d"] += 1
+        fetch_cols.append(stacked_linearize(
+            lane_set.cols[lane_set.keys.index("parent")][:, :w],
+            lane_set.cols[lane_set.keys.index("ctr")][:, :w],
+            lane_set.cols[lane_set.keys.index("actor")][:, :w],
+            jnp.asarray(n_el)))
+        stats["text_finalized"] += len(lane_set.docs)
     _count(stats, "stacked_mirror_fetch")
     _ts = obs.now() if obs.ENABLED else 0
-    packed = np.asarray(K.stacked_pack_rows(
-        *[lane_set.cols[i] for i in m_idx]))
+    packed = np.asarray(K.stacked_pack_rows(*fetch_cols))
     _count_sync(stats, "stacked_mirror_fetch", _ts)
     for d, doc in enumerate(lane_set.docs):
         doc._dev = dict(zip(lane_set.keys, rows[d]))
-        doc._cap = lane_set.cap
-        doc._host = {k: (packed[d, i].astype(bool) if k in _BOOL_KEYS
-                         else packed[d, i])
-                     for i, k in enumerate(mirror_keys)}
+        doc._cap = cap
+        host = {}
+        for i, k in enumerate(mirror_keys):
+            if k in _BOOL_KEYS:
+                full = np.zeros(cap, bool)
+                full[:w] = packed[d, i].astype(bool)
+            else:
+                full = np.zeros(cap, np.int32)
+                full[:w] = packed[d, i]
+            host[k] = full
+        doc._host = host
+        if lane_set.kind == "text":
+            doc._pos_cache = packed[d, len(mirror_keys)][: doc.n_elems + 1]
+            stats["pos_seeded"] += 1

@@ -141,6 +141,23 @@ def rga_linearize(parent: jax.Array, ctr: jax.Array, actor: jax.Array,
 
 
 @jax.jit
+def stacked_linearize(parent: jax.Array, ctr: jax.Array, actor: jax.Array,
+                      n_elems: jax.Array) -> jax.Array:
+    """`rga_linearize` vmapped over a doc axis: one program computes every
+    stacked document's RGA positions from its (D, cap) element tables.
+    `n_elems` is the per-doc live count (slots 1..n_elems valid, slot 0
+    the head); padding slots sort past the live elements exactly as in
+    the single-doc kernel. The stacked multi-object executor
+    (engine/stacked.py `_finalize`) runs this once per apply and ships
+    the (D, cap) result inside the packed mirror fetch, so diff emission
+    after a stacked round reads positions from host state instead of
+    paying one linearize dispatch + sync per text object."""
+    idx = jnp.arange(parent.shape[1], dtype=jnp.int32)[None, :]
+    valid = idx <= n_elems[:, None]
+    return jax.vmap(rga_linearize)(parent, ctr, actor, valid)
+
+
+@jax.jit
 def rga_linearize_segments(parent: jax.Array, attach_off: jax.Array,
                            ctr: jax.Array, actor: jax.Array,
                            weight: jax.Array, valid: jax.Array) -> jax.Array:

@@ -87,7 +87,8 @@ class InboundGate:
         self._quarantine: dict = {}       # doc_id -> QuarantineQueue
         self._n_parked = 0                # total across all docs
         self._busy: set = set()           # re-entrancy guard (doc ids)
-        self.stats = {"delivered": 0, "parked_rejected": 0,
+        self.stats = {"delivered": 0, "applied_ops": 0,
+                      "parked_rejected": 0,
                       "global_evicted": 0,
                       "peak_parked": 0}      # per-doc quarantine stats
         # live on the queues (see quarantine_stats)
@@ -379,4 +380,9 @@ class InboundGate:
         # rejection would make the sender treat an APPLIED delivery as
         # rejected (and its corrected redelivery then dedups silently)
         self._doc_set.set_doc(doc_id, doc)
+        # what actually committed, in wire ops — the honest per-lane
+        # load signal (a premature change that parks costs the backend
+        # nothing; it is counted here on the call that DRAINS it)
+        self.stats["applied_ops"] += sum(
+            len(c.get("ops") or ()) for c in changes)
         return doc

@@ -84,13 +84,21 @@ class ServiceConfig:
     - ``prom_lag_series``: at most this many per-tenant lag gauge
       series on the scrape page (worst-lagging first); aggregates are
       always exported, so the page stays bounded at any tenant count.
+    - ``shard_lanes``: partition the room population across this many
+      shard execution lanes over the device mesh (INTERNALS §15.4):
+      each room maps onto a lane by the deterministic placement table
+      and its grouped gate deliveries run under that lane's device
+      context, so room document state lives device-local per shard. 0
+      (the default) keeps the unsharded single-device behavior; -1 uses
+      one lane per visible device.
     """
 
     __slots__ = ("tick_budget_ms", "heartbeat_ticks", "suspect_grace_ticks",
                  "max_retries", "base_rto", "max_rto", "recv_window",
                  "quarantine_capacity", "quarantine_global_capacity",
                  "starvation_boost_ticks", "tick_ring", "default_budget",
-                 "lag_probe_ticks", "event_log", "prom_lag_series")
+                 "lag_probe_ticks", "event_log", "prom_lag_series",
+                 "shard_lanes")
 
     def __init__(self, *, tick_budget_ms: float = 0.0,
                  heartbeat_ticks: int = 30, suspect_grace_ticks: int = 30,
@@ -101,7 +109,7 @@ class ServiceConfig:
                  starvation_boost_ticks: int = 8, tick_ring: int = 4096,
                  default_budget: TenantBudget = None,
                  lag_probe_ticks: int = 1, event_log: int = 256,
-                 prom_lag_series: int = 64):
+                 prom_lag_series: int = 64, shard_lanes: int = 0):
         self.tick_budget_ms = tick_budget_ms
         self.heartbeat_ticks = heartbeat_ticks
         self.suspect_grace_ticks = suspect_grace_ticks
@@ -117,6 +125,7 @@ class ServiceConfig:
         self.lag_probe_ticks = lag_probe_ticks
         self.event_log = event_log
         self.prom_lag_series = prom_lag_series
+        self.shard_lanes = shard_lanes
 
 
 def approx_msg_bytes(msg) -> int:

@@ -46,7 +46,7 @@ from __future__ import annotations
 import math
 import time
 from collections import deque
-from contextlib import ExitStack
+from contextlib import ExitStack, nullcontext
 
 from .. import obs
 from ..obs.telemetry import Telemetry
@@ -62,12 +62,21 @@ LIVE, SUSPECT, DEAD = "live", "suspect", "dead"
 
 
 class Room:
-    """One doc group's serving shard: DocSet + hub + bounded gate."""
+    """One doc group's serving shard: DocSet + hub + bounded gate.
 
-    __slots__ = ("room_id", "doc_set", "hub", "gate", "tenants")
+    With sharding on (``ServiceConfig.shard_lanes``), ``lane`` is the
+    device execution lane the placement table assigned this room: every
+    grouped gate delivery — the backend applies that mutate the room's
+    document state — runs under the lane's device context, so the
+    room's engine tables live on the lane's device. Causal metadata
+    (hub, ClockMatrix, quarantine) is already room-local, hence
+    shard-local — scale-out never grows a global clock (Okapi)."""
 
-    def __init__(self, room_id: str, config: ServiceConfig):
+    __slots__ = ("room_id", "doc_set", "hub", "gate", "tenants", "lane")
+
+    def __init__(self, room_id: str, config: ServiceConfig, lane=None):
         self.room_id = room_id
+        self.lane = lane
         self.doc_set = DocSet()
         self.gate = InboundGate(
             self.doc_set, capacity=config.quarantine_capacity,
@@ -173,6 +182,23 @@ class SyncService:
         #: tick-duration histogram + admission/degradation counter
         #: series + lag gauges — what the scrape endpoint exports
         self.telemetry = Telemetry()
+        # sharded serving (INTERNALS §15.4): rooms map onto device
+        # execution lanes through the deterministic placement table;
+        # lanes also feed the per-shard admitted-ops window series
+        # (the rebalance-policy signal) into the telemetry store
+        self._shard_placement = None
+        self._shard_lanes = []
+        if self.config.shard_lanes:
+            from ..shard import PlacementTable, ShardLane
+            from ..shard.set import default_devices
+            devices = default_devices()
+            n = (len(devices) if self.config.shard_lanes < 0
+                 else self.config.shard_lanes)
+            self._shard_placement = PlacementTable(n)
+            self._shard_lanes = [
+                ShardLane(i, devices[i % len(devices)],
+                          telemetry=self.telemetry, assert_budget=False)
+                for i in range(n)]
         # black-box degradation-event ring for describe(): the
         # postmortem must work with tracing OFF, so the service keeps
         # its own bounded copy of the ladder events it obs-emits
@@ -195,13 +221,35 @@ class SyncService:
     def room(self, room_id: str) -> Room:
         r = self._rooms.get(room_id)
         if r is None:
-            r = self._rooms[room_id] = Room(room_id, self.config)
+            lane = None
+            if self._shard_placement is not None:
+                lane = self._shard_lanes[
+                    self._shard_placement.shard_of(room_id)]
+            r = self._rooms[room_id] = Room(room_id, self.config,
+                                            lane=lane)
         return r
 
     def seed_doc(self, room_id: str, doc, doc_id: str = None):
         """Install an authoritative replica for a room's doc (doc_id
         defaults to the room id)."""
         self.room(room_id).doc_set.set_doc(doc_id or room_id, doc)
+
+    def shard_map(self) -> dict:
+        """Room -> lane assignment plus per-lane load (empty when the
+        service runs unsharded): the serving tier's placement view."""
+        if self._shard_placement is None:
+            return {}
+        lanes = {lane.index: {"device": str(lane.device), "rooms": [],
+                              "admitted_ops": lane.stats["admitted_ops"]}
+                 for lane in self._shard_lanes}
+        for room_id, room in self._rooms.items():
+            if room.lane is not None:
+                lanes[room.lane.index]["rooms"].append(room_id)
+        for row in lanes.values():
+            row["rooms"].sort()
+        return {"n_lanes": len(self._shard_lanes),
+                "placement_epoch": self._shard_placement.epoch,
+                "lanes": lanes}
 
     def connect(self, tenant_id: str, room_id: str, send_raw, *,
                 budget: TenantBudget = None, seed: int = 0) -> TenantSession:
@@ -329,14 +377,21 @@ class SyncService:
                               args={"msgs": shed, "tick": self._tick_no},
                               n=shed)
             # grouped admission: ONE gate delivery (one backend apply /
-            # columnar decode) per (room, doc) for the whole tick
+            # columnar decode) per (room, doc) for the whole tick —
+            # executed under the room's shard-lane device context when
+            # the service is sharded, so every backend apply's device
+            # work lands on the lane that owns the room
             for (room_id, doc_id), (changes, senders) in groups.items():
                 room = self._rooms.get(room_id)
                 if room is None:
                     continue
+                lane = room.lane
+                ops0 = room.gate.stats["applied_ops"]
                 try:
-                    room.gate.deliver(doc_id, changes, validated=True,
-                                      sender=senders)
+                    with (lane.device_ctx() if lane is not None
+                          else nullcontext()):
+                        room.gate.deliver(doc_id, changes, validated=True,
+                                          sender=senders)
                 except ProtocolError as exc:
                     # the gate already salvaged every valid change and
                     # parked/dropped the poison with per-sender stats;
@@ -347,6 +402,19 @@ class SyncService:
                         obs.event("svc", "reject",
                                   args={"doc": doc_id,
                                         "error": str(exc)[:120]})
+                if lane is not None:
+                    # the gate's applied-ops delta, NOT the delivered op
+                    # count: a premature change that parks costs this
+                    # lane nothing (it counts on the tick that drains
+                    # it), so the per-lane load series the rebalance
+                    # policy reads stays honest — measured even on the
+                    # salvage path, where valid changes still applied
+                    n_ops = room.gate.stats["applied_ops"] - ops0
+                    if n_ops:
+                        lane.stats["admitted_ops"] += n_ops
+                        self.telemetry.observe_count(
+                            "shard", f"lane{lane.index}_admitted_ops",
+                            n_ops)
             # retransmission (may declare peers dead via on_dead)
             for sess in list(self._tenants.values()):
                 if not sess.pending_dead:
@@ -606,6 +674,7 @@ class SyncService:
                    if not k.endswith("_closed")},
                 "live_tenants": len(sessions),
                 "rooms": len(self._rooms),
+                "shard_lanes": len(self._shard_lanes),
                 "backpressured_total": bp, "retransmits_total": rt,
                 "max_lag_ops": max((v["ops"] for v in lag.values()),
                                    default=0),
@@ -690,6 +759,7 @@ class SyncService:
             "rooms": rooms,
             "events": list(self._events),
             "tick_p99_ms_telemetry": self.tick_p99_ms_telemetry(),
+            **({"shards": self.shard_map()} if self._shard_lanes else {}),
         }
 
     def tick_p99_ms_telemetry(self) -> float:

@@ -1,0 +1,131 @@
+"""Compiled-HLO collective audit of the sharded commit path.
+
+The serving tier's scaling claim rests on one invariant: the commit
+path moves ZERO bytes between devices. The lanes make that structural
+(every lane program is single-device), and this module proves the
+stronger SPMD formulation the mesh design rests on (docs/SHARDING_r5.md):
+the PR-7 stacked round kernels, lowered with every operand sharded over
+a doc-only mesh, compile to modules containing **no all-reduce /
+all-gather / all-to-all / collective-permute / reduce-scatter** — XLA's
+partitioner agrees the doc axis is embarrassingly parallel for the real
+round kernels, not just for the simplified `merge_step` the earlier
+evidence audited. `bench.py --sharded` runs this audit and records the
+counts in the cfg12 session row; tests assert the zero.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+COLLECTIVES = ("all-gather", "all-reduce", "all-to-all",
+               "collective-permute", "reduce-scatter")
+
+
+def count_collectives(lowerable, args) -> dict:
+    """Compile and count collective ops in the HLO text (zero-count
+    keys dropped — an empty dict IS the pass)."""
+    hlo = lowerable.lower(*args).compile().as_text()
+    counts = {c: len(re.findall(rf"\b{c}\b", hlo)) for c in COLLECTIVES}
+    return {c: n for c, n in counts.items() if n}
+
+
+def doc_mesh(n_devices: int = None):
+    """A doc-axis-only mesh over the available devices."""
+    import jax
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    if n_devices:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), ("doc",))
+
+
+def commit_path_collectives(mesh=None, docs_per_device: int = 2,
+                            cap: int = 256) -> dict:
+    """Audit the three stacked commit-path kernels over a doc-sharded
+    mesh: {kernel name: {collective: count}} (empty inner dicts = the
+    zero-collective invariant holds). Shapes are small — the audit is
+    about partitioning structure, not scale."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ops import ingest as K
+
+    if mesh is None:
+        mesh = doc_mesh()
+    shard = NamedSharding(mesh, P("doc"))
+    D = mesh.shape["doc"] * docs_per_device
+    M, R, N, Kc, T, S = 64, 64, 256, 64, 64, 64
+
+    def put(arr):
+        return jax.device_put(arr, shard)
+
+    i32 = np.int32
+    elem_tables = (put(np.zeros((D, cap), i32)),          # parent
+                   put(np.zeros((D, cap), i32)),          # ctr
+                   put(np.zeros((D, cap), i32)),          # actor
+                   put(np.zeros((D, cap), i32)),          # value
+                   put(np.zeros((D, cap), bool)),         # has_value
+                   put(np.full((D, cap), -1, i32)),       # win_actor
+                   put(np.zeros((D, cap), i32)),          # win_seq
+                   put(np.zeros((D, cap), bool)),         # win_counter
+                   put(np.zeros((D, cap), bool)))         # chain
+    reg_tables = (put(np.zeros((D, cap), i32)),           # value
+                  put(np.zeros((D, cap), bool)),          # has_value
+                  put(np.full((D, cap), -1, i32)),        # win_actor
+                  put(np.zeros((D, cap), i32)),           # win_seq
+                  put(np.zeros((D, cap), bool)))          # win_counter
+
+    out = {}
+    # one causal round of every map/table object on the mesh
+    ops = np.zeros((D, 5, M), i32)
+    ops[:, K.MOP_KIND, :] = -1
+    ops[:, K.MOP_SLOT, :] = cap
+    conflict = np.full((D, Kc), cap, i32)
+    map_fn = jax.jit(
+        lambda *a: K.stacked_map_round(*a, out_cap=cap),
+        in_shardings=(shard,) * 7, out_shardings=shard)
+    out["stacked_map_round"] = count_collectives(
+        map_fn, reg_tables + (put(ops), put(conflict)))
+
+    # one causal round of every text/list object, the full static shape
+    # (dense expansion + residuals + touches — the worst case)
+    desc = np.zeros((D, 9, R), i32)
+    desc[:, K.DESC_ELEM_BASE, :] = N
+    blob = np.zeros((D, N), i32)
+    res = np.zeros((D, 8, M), i32)
+    res[:, 0, :] = -1
+    res[:, K.RES_SLOT, :] = cap
+    res[:, K.RES_NEW_SLOT, :] = cap
+    touch = np.zeros((D, 3, T), i32)
+    touch[:, 1:, :] = -1
+    mixed_fn = jax.jit(
+        lambda *a: K.stacked_mixed_round(
+            *a, out_cap=cap, expand_kind="dense", with_res=True,
+            with_touch=True),
+        in_shardings=(shard,) * 14, out_shardings=shard)
+    out["stacked_mixed_round"] = count_collectives(
+        mixed_fn, elem_tables + (put(desc), put(blob), put(res),
+                                 put(conflict), put(touch)))
+
+    # every object's host-resolved slow residue, one stacked scatter
+    wb = np.zeros((D, 6, S), i32)
+    wb[:, 0, :] = cap
+    scatter_fn = jax.jit(
+        lambda *a: K.stacked_scatter_registers(*a),
+        in_shardings=(shard,) * 6, out_shardings=shard)
+    out["stacked_scatter_registers"] = count_collectives(
+        scatter_fn, reg_tables + (put(wb),))
+    del jnp
+    return out
+
+
+def assert_zero_collectives(audit: dict):
+    """The acceptance form: every audited commit-path kernel compiled
+    with zero cross-device collectives."""
+    bad = {k: v for k, v in audit.items() if v}
+    assert not bad, (
+        f"sharded commit path compiled with collectives: {bad} — the "
+        "doc axis is no longer communication-free")

@@ -1,0 +1,170 @@
+"""One shard's execution lane: a device, its resident docs, and the
+stacked commit programs that serve them.
+
+A lane is the single-device unit of the sharded serving tier
+(INTERNALS §15): every engine doc the placement table routes here lives
+with its tables on THIS lane's device, and one ingest round across the
+lane's touched docs executes through the PR-7 stacked multi-object
+executor (`engine/stacked.py`) — admission, columnar planning, and the
+round kernels are the SAME code the single-device path runs, so the
+sharded and unsharded paths cannot drift; the lane only decides *where*
+the programs run. A lane never talks to another lane's device: there is
+no multi-device program on the commit path, hence no collective to even
+audit (the doc-axis mesh audit in `shard/audit.py` proves the stronger
+claim for the SPMD formulation).
+
+Device pinning uses ``jax.default_device`` scoped to lane calls: every
+`jnp.asarray`/`device_put` the engine performs inside a lane operation
+lands on the lane's device. On a single-device host (the tier-1 test
+environment) lanes share the one device and only the partitioning logic
+is exercised — shard semantics never REQUIRE a device per lane.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from .. import obs
+from ..engine import stacked as _stacked
+from ..engine.map_doc import DeviceMapDoc
+from ..engine.text_doc import DeviceTextDoc
+
+_DOC_KINDS = {"text": DeviceTextDoc, "map": DeviceMapDoc}
+
+
+class ShardLane:
+    """One device's shard: resident docs + stacked ingest."""
+
+    def __init__(self, index: int, device=None, telemetry=None,
+                 assert_budget: bool = True, doc_kind: str = "text",
+                 capacity: int = 1024):
+        self.index = index
+        self.device = device
+        self.docs: dict = {}          # doc_id -> engine doc
+        self.doc_ops: dict = {}       # doc_id -> lifetime admitted wire ops
+        self.telemetry = telemetry
+        self.assert_budget = assert_budget
+        self.doc_kind = doc_kind
+        self.capacity = capacity
+        self.stats = {"applies": 0, "stacked_applies": 0,
+                      "per_object_applies": 0, "admitted_ops": 0,
+                      "docs_in": 0, "docs_out": 0}
+
+    def device_ctx(self):
+        """Every engine call for this lane runs inside this context, so
+        staged arrays and kernel launches land on the lane's device."""
+        if self.device is None:
+            return contextlib.nullcontext()
+        import jax
+        return jax.default_device(self.device)
+
+    # -- population -----------------------------------------------------
+
+    def ensure_doc(self, doc_id: str, kind: str = None,
+                   capacity: int = None):
+        """Materialize a doc on this lane (the lane's configured kind
+        and slot capacity unless overridden — the ShardedDocSet threads
+        its population-wide settings through the lane constructor)."""
+        doc = self.docs.get(doc_id)
+        if doc is None:
+            with self.device_ctx():
+                doc = _DOC_KINDS[kind or self.doc_kind](
+                    doc_id, capacity=capacity or self.capacity)
+            self.docs[doc_id] = doc
+            self.doc_ops[doc_id] = 0
+        return doc
+
+    def adopt(self, doc_id: str, bundle: bytes):
+        """Install a migrated doc from its checkpoint bundle (the
+        restore stages the tables onto THIS lane's device)."""
+        from ..checkpoint import restore_engine
+        with self.device_ctx():
+            doc = restore_engine(bundle)
+        self.docs[doc_id] = doc
+        self.doc_ops[doc_id] = 0
+        self.stats["docs_in"] += 1
+        return doc
+
+    def export(self, doc_id: str) -> bytes:
+        """Capture a resident doc as a checkpoint bundle and release it
+        (the migration source half; commit-boundary only — the caller
+        guarantees no in-flight plan)."""
+        from ..checkpoint import capture_engine
+        doc = self.docs[doc_id]
+        with self.device_ctx():
+            bundle = capture_engine(doc)
+        del self.docs[doc_id]
+        self.doc_ops.pop(doc_id, None)
+        self.stats["docs_out"] += 1
+        return bundle
+
+    # -- the commit path ------------------------------------------------
+
+    def ingest(self, deliveries: dict):
+        """One serving round over this lane's touched docs:
+        ``{doc_id: changes}`` (wire dicts or decoded columnar batches)
+        executes as ONE stacked multi-object apply on the lane device
+        (`engine/stacked.apply_stacked` — per-round budget asserted),
+        falling back to the per-object engine exactly like the
+        single-device backend when the population is ineligible.
+        Returns the admitted wire-op count."""
+        if not deliveries:
+            return 0
+        items = [(self.ensure_doc(doc_id), changes)
+                 for doc_id, changes in deliveries.items()]
+        n_ops = sum(_stacked._item_ops(subs) for _, subs in items)
+        _t0 = obs.now() if obs.ENABLED else 0
+        with self.device_ctx():
+            st = _stacked.apply_stacked(items)
+            if st:
+                self.stats["stacked_applies"] += 1
+                if self.assert_budget:
+                    _stacked.assert_round_budget(st)
+            else:
+                for doc, changes in items:
+                    if hasattr(changes, "n_changes"):
+                        doc.apply_batch(changes)
+                    else:
+                        doc.apply_changes(changes)
+                self.stats["per_object_applies"] += 1
+        self.stats["applies"] += 1
+        self.stats["admitted_ops"] += n_ops
+        for doc_id, changes in deliveries.items():
+            self.doc_ops[doc_id] = (self.doc_ops.get(doc_id, 0)
+                                    + _stacked._item_ops(changes))
+        if self.telemetry is not None:
+            # the per-shard admitted-ops window series the rebalance
+            # policy reads (INTERNALS §15.3): one rolling counter per
+            # lane, bounded cardinality regardless of population size
+            self.telemetry.observe_count(
+                "shard", f"lane{self.index}_admitted_ops", n_ops)
+        if obs.ENABLED:
+            obs.span("shard", "lane_ingest", _t0, args={
+                "lane": self.index, "docs": len(items), "n_ops": n_ops,
+                "stacked": bool(st)})
+        return n_ops
+
+    def ring(self, doc_id: str, slots: int = None, donate: bool = False):
+        """A K-deep pipelined ingestion ring (engine/pipeline) bound to
+        this lane's device: the worker thread's chained prepares (host
+        planning + h2d staging) and the caller's commits all run under
+        the lane's device context — the streaming path for a shard's
+        hot doc."""
+        from ..engine.pipeline import PipelinedIngestor
+        return PipelinedIngestor(self.ensure_doc(doc_id), slots=slots,
+                                 donate=donate, device=self.device)
+
+    def hottest_doc(self):
+        """(doc_id, lifetime ops) of the lane's hottest resident doc, or
+        None — the migration candidate the rebalance policy exports."""
+        if not self.doc_ops:
+            return None
+        doc_id = max(self.doc_ops, key=self.doc_ops.get)
+        return doc_id, self.doc_ops[doc_id]
+
+    def texts(self) -> dict:
+        """Materialize every resident text doc (outside the commit
+        path; convergence checks and pulls)."""
+        with self.device_ctx():
+            return {doc_id: doc.text() for doc_id, doc in self.docs.items()
+                    if isinstance(doc, DeviceTextDoc)}

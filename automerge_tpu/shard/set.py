@@ -1,0 +1,314 @@
+"""The sharded serving tier: thousands of live docs partitioned across
+the device mesh (INTERNALS §15).
+
+``ShardedDocSet`` is the top of the tier: a population of engine docs
+partitioned over N ``ShardLane``s by a deterministic
+:class:`~.placement.PlacementTable`, with one single-device stacked
+commit program per touched lane per serving round and NO multi-device
+program anywhere on the commit path — the zero-collective invariant is
+structural here (each lane's programs see one device), and
+`shard/audit.py` proves the stronger SPMD claim from compiled HLO.
+
+Causal admission lives at the ROUTER, not in the engine queues: a
+delivery whose dependencies the target doc does not yet cover parks in a
+bounded per-doc :class:`~..resilience.quarantine.QuarantineQueue`
+(wire form) and is retried after every round that advances any clock.
+Keeping the engine queues empty is what makes migration safe — a
+checkpoint capture refuses a doc holding causally-unready queued
+changes, and a router-held parked change trivially survives a move: the
+drain resolves the owning lane at release time.
+
+Hot-doc migration (the rebalance path, `shard/rebalance.py`) moves one
+doc between lanes via a PR-3 checkpoint bundle at a commit boundary:
+
+1. the doc is marked MIGRATING — deliveries arriving for it park in a
+   dedicated migration pen (never half-applied on either lane);
+2. the source lane captures + releases the doc (``lane.export``: the
+   integrity-hashed columnar bundle);
+3. the destination lane restores it (``lane.adopt``: tables staged onto
+   the destination device);
+4. the placement table records the move (the commit point), and the pen
+   replays through the normal delivery gate — premature changes go back
+   to quarantine, ready ones apply on the new owner.
+
+Okapi's replication-group discipline (PAPERS.md) is why scale-out stays
+cheap: causal metadata (clocks, dep closures, sync hubs) is per-doc /
+per-room — shard-LOCAL — so adding lanes never grows a global clock.
+"""
+
+from __future__ import annotations
+
+from .. import obs
+from ..obs.telemetry import Telemetry
+from ..resilience.inbound import _ready_under
+from ..resilience.quarantine import QuarantineQueue
+from .lane import ShardLane
+from .placement import PlacementTable
+
+
+def default_devices():
+    import jax
+    return list(jax.devices())
+
+
+class ShardedDocSet:
+    """A live-doc population served by N shard lanes over the mesh."""
+
+    def __init__(self, n_shards: int = None, devices=None,
+                 doc_kind: str = "text", capacity: int = 1024,
+                 quarantine_capacity: int = 1024, telemetry=None,
+                 assert_budget: bool = True):
+        if devices is None:
+            devices = default_devices()
+        if n_shards is None:
+            n_shards = len(devices)
+        #: always-on rolling telemetry: per-lane admitted-ops windows
+        #: (the rebalance policy's input) + migration counters
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
+        self.placement = PlacementTable(n_shards)
+        self.lanes = [ShardLane(i, devices[i % len(devices)],
+                                telemetry=self.telemetry,
+                                assert_budget=assert_budget,
+                                doc_kind=doc_kind, capacity=capacity)
+                      for i in range(n_shards)]
+        self.doc_kind = doc_kind
+        self.capacity = capacity
+        self._quarantine: dict = {}     # doc_id -> QuarantineQueue
+        self._quarantine_cap = quarantine_capacity
+        self._migrating: dict = {}      # doc_id -> [parked deliveries]
+        self.rebalancer = None          # attach_rebalancer installs one
+        self.stats = {"rounds": 0, "admitted_ops": 0, "parked": 0,
+                      "released": 0, "migrations": 0,
+                      "migrations_deferred": 0, "migration_parked": 0,
+                      "peak_parked": 0}
+
+    # -- introspection --------------------------------------------------
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.lanes)
+
+    def lane_of(self, doc_id: str) -> ShardLane:
+        return self.lanes[self.placement.shard_of(doc_id)]
+
+    def doc(self, doc_id: str):
+        return self.lane_of(doc_id).docs.get(doc_id)
+
+    def doc_ids(self) -> list:
+        return sorted(d for lane in self.lanes for d in lane.docs)
+
+    def quarantined(self, doc_id: str) -> int:
+        q = self._quarantine.get(doc_id)
+        return len(q) if q is not None else 0
+
+    def describe(self) -> dict:
+        """The tier's black-box snapshot: explicit placement entries,
+        per-lane population/stats, quarantine occupancy."""
+        return {
+            "schema": "amtpu-shardmap-v1",
+            "n_shards": self.n_shards,
+            "devices": [str(lane.device) for lane in self.lanes],
+            "placement_epoch": self.placement.epoch,
+            "placement_overrides": self.placement.table(),
+            "lanes": [{"index": lane.index, "device": str(lane.device),
+                       "docs": sorted(lane.docs), "stats": dict(lane.stats)}
+                      for lane in self.lanes],
+            "quarantine": {d: len(q) for d, q in self._quarantine.items()
+                           if len(q)},
+            "migrating": sorted(self._migrating),
+            "stats": dict(self.stats),
+        }
+
+    # -- the delivery gate ----------------------------------------------
+
+    @staticmethod
+    def _split_ready(changes, clock: dict):
+        """Partition one delivery into (ready, premature) under `clock`,
+        admitting in-delivery causal chains in any arrival order (the
+        engine's scheduler handles the rounds; the router only refuses
+        changes whose deps NOTHING in hand can satisfy). The serving
+        hot path — one causally-ready change per doc per round — short-
+        circuits before the fixpoint loop's clock copy."""
+        if len(changes) == 1 and _ready_under(changes[0], clock):
+            return list(changes), []
+        ready, rest = [], list(changes)
+        clock = dict(clock)
+        progress = True
+        while progress and rest:
+            progress = False
+            nxt = []
+            for ch in rest:
+                if _ready_under(ch, clock):
+                    ready.append(ch)
+                    if ch["seq"] > clock.get(ch["actor"], 0):
+                        clock[ch["actor"]] = ch["seq"]
+                    progress = True
+                else:
+                    nxt.append(ch)
+            rest = nxt
+        return ready, rest
+
+    def _park(self, doc_id: str, changes):
+        q = self._quarantine.get(doc_id)
+        if q is None:
+            q = self._quarantine[doc_id] = QuarantineQueue(
+                self._quarantine_cap)
+        for ch in changes:
+            q.park(ch)
+            self.stats["parked"] += 1
+        total = sum(len(q) for q in self._quarantine.values())
+        if total > self.stats["peak_parked"]:
+            self.stats["peak_parked"] = total
+
+    def deliver(self, doc_id: str, changes) -> int:
+        """Single-doc convenience wrapper over :meth:`deliver_round`."""
+        return self.deliver_round({doc_id: changes})
+
+    def deliver_round(self, deliveries: dict) -> int:
+        """One serving round: route ``{doc_id: [wire changes]}`` across
+        the lanes (ready changes grouped into ONE stacked apply per
+        touched lane), park premature changes in the per-doc quarantine,
+        pen deliveries for migrating docs, then drain every quarantine
+        the round unblocked. Returns the admitted wire-op count. The end
+        of the round is a commit boundary: the attached rebalancer (if
+        any) runs its policy here."""
+        _t0 = obs.now() if obs.ENABLED else 0
+        per_lane: dict = {}
+        for doc_id, changes in deliveries.items():
+            changes = list(changes)
+            if doc_id in self._migrating:
+                # the migration pen: the doc has no owner this instant —
+                # nothing may apply until the new shard owns it
+                self._migrating[doc_id].append(changes)
+                self.stats["migration_parked"] += len(changes)
+                continue
+            lane = self.lane_of(doc_id)
+            doc = lane.docs.get(doc_id)
+            ready, premature = self._split_ready(
+                changes, doc.clock if doc is not None else {})
+            if premature:
+                self._park(doc_id, premature)
+            if ready:
+                per_lane.setdefault(lane.index, {})[doc_id] = ready
+        admitted = 0
+        for idx in sorted(per_lane):
+            admitted += self.lanes[idx].ingest(per_lane[idx])
+        admitted += self._drain_quarantine()
+        self.stats["rounds"] += 1
+        self.stats["admitted_ops"] += admitted
+        if obs.ENABLED:
+            obs.span("shard", "round", _t0, args={
+                "docs": len(deliveries), "admitted_ops": admitted})
+        if self.rebalancer is not None:
+            self.rebalancer.maybe_rebalance()
+        return admitted
+
+    def _drain_quarantine(self) -> int:
+        """Retry every parked change against the live clocks until a
+        fixpoint; released changes ride a normal lane ingest (grouped
+        per lane per iteration)."""
+        admitted = 0
+        progress = True
+        while progress:
+            progress = False
+            per_lane: dict = {}
+            for doc_id, q in list(self._quarantine.items()):
+                if not len(q) or doc_id in self._migrating:
+                    continue
+                lane = self.lane_of(doc_id)
+                doc = lane.docs.get(doc_id)
+                parked = q.drain()
+                ready, premature = self._split_ready(
+                    parked, doc.clock if doc is not None else {})
+                for ch in premature:
+                    q.park(ch, requeue=True)
+                if ready:
+                    per_lane.setdefault(lane.index, {})[doc_id] = ready
+                    self.stats["released"] += len(ready)
+            for idx in sorted(per_lane):
+                admitted += self.lanes[idx].ingest(per_lane[idx])
+                progress = True
+        return admitted
+
+    # -- migration ------------------------------------------------------
+
+    def attach_rebalancer(self, **kwargs):
+        from .rebalance import Rebalancer
+        self.rebalancer = Rebalancer(self, **kwargs)
+        return self.rebalancer
+
+    def migrate(self, doc_id: str, dst_shard: int,
+                _mid_migration=None) -> bool:
+        """Move one doc to `dst_shard` via a checkpoint bundle at a
+        commit boundary. Returns False (nothing moved) when the doc is
+        already home, or when its engine still holds causally-unready
+        queued work — migration DEFERS rather than strand a causal hole
+        (the next boundary retries). ``_mid_migration`` is the test seam
+        for the quarantine handshake: called while the doc has no owner,
+        so injected deliveries must pen and replay."""
+        src_shard = self.placement.shard_of(doc_id)
+        if not 0 <= dst_shard < self.n_shards:
+            raise ValueError(f"no shard {dst_shard}")
+        if dst_shard == src_shard:
+            return False
+        src = self.lanes[src_shard]
+        doc = src.docs.get(doc_id)
+        if doc is None:
+            # never materialized here: ownership is just a table entry
+            self.placement.move(doc_id, dst_shard)
+            return True
+        if doc.queue:
+            self.stats["migrations_deferred"] += 1
+            return False
+        _t0 = obs.now() if obs.ENABLED else 0
+        self._migrating[doc_id] = []
+        moved = False
+        try:
+            bundle = src.export(doc_id)
+            try:
+                if _mid_migration is not None:
+                    _mid_migration()
+                self.lanes[dst_shard].adopt(doc_id, bundle)
+                self.placement.move(doc_id, dst_shard)
+                moved = True
+            except BaseException:
+                # failure atomicity: a failed adopt must not lose the
+                # doc — restore residency on the SOURCE lane from the
+                # bundle already in hand (placement never moved, so
+                # ownership and state stay consistent) and let the
+                # penned deliveries replay against it below
+                src.adopt(doc_id, bundle)
+                src.stats["docs_in"] -= 1       # a rollback, not a move
+                src.stats["docs_out"] -= 1
+                raise
+        finally:
+            # whatever happened, the doc has an owner again: replay the
+            # pen through the normal gate — ready changes apply there,
+            # premature ones go (back) to quarantine
+            penned = self._migrating.pop(doc_id, [])
+            for changes in penned:
+                self.deliver_round({doc_id: changes})
+        self.stats["migrations"] += 1
+        self.telemetry.observe_count("shard", "migrations")
+        if obs.ENABLED:
+            obs.span("shard", "migrate", _t0, args={
+                "doc": doc_id, "src": src_shard, "dst": dst_shard,
+                "bundle_bytes": len(bundle), "penned": len(penned)})
+        return moved
+
+    # -- reads ----------------------------------------------------------
+
+    def texts(self) -> dict:
+        out = {}
+        for lane in self.lanes:
+            out.update(lane.texts())
+        return out
+
+    def capture(self, doc_id: str) -> bytes:
+        """The doc's integrity-hashed checkpoint bundle (byte-
+        deterministic for a given state — the shard-count-invariance
+        soak compares exactly these bytes across mesh sizes)."""
+        from ..checkpoint import capture_engine
+        lane = self.lane_of(doc_id)
+        with lane.device_ctx():
+            return capture_engine(lane.docs[doc_id])

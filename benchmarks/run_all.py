@@ -427,6 +427,57 @@ def config11_service(n_sessions: int = 200, room_size: int = 5,
         print(f"# appended to {B.SESSION_LOG_PATH}", file=sys.stderr)
 
 
+def config12_sharded(quick: bool = False, record_session: bool = False):
+    """Sharded serving tier (automerge_tpu/shard, INTERNALS §15): the
+    ISSUE-10 cfg12 row — aggregate mesh ops/s across the full shard
+    population vs the same workload on one shard. Runs in a SUBPROCESS
+    with the scrubbed 8-virtual-cpu-device env (the sharding_evidence
+    discipline: XLA_FLAGS must predate jax init, and this process may
+    already hold a 1-device backend); `bench.py --sharded` asserts the
+    budgets / zero-collective audit / >=4x bar inside the measurement
+    and, with ``--session``, appends its own honest cpu row to
+    BENCH_SESSIONS.jsonl. The emitted sweep row carries
+    ``measured_platform`` so a chip sweep cannot launder the cpu dryrun
+    as a chip measurement."""
+    import os
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "AMTPU_SKIP_PREFLIGHT": "1",
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                         + " --xla_force_host_platform_device_count=8"
+                         ).strip()}
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never init the tunnel plugin
+    cmd = [sys.executable, os.path.join(root, "bench.py"), "--sharded"]
+    if quick:
+        cmd.append("--quick")
+    if record_session:
+        cmd.append("--session")
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=root,
+                         env=env, timeout=3000)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"cfg12 sharded bench failed rc={out.returncode}: "
+            f"{out.stderr[-800:]}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    emit("cfg12_sharded_aggregate_ops_per_sec", rec["value"], "ops/s",
+         vs_baseline=rec["vs_baseline"],
+         n_shards=rec["n_shards"], n_docs=rec["n_docs"],
+         single_shard_ops_per_sec=rec["single_shard_ops_per_sec"],
+         scaleup_vs_single_shard=rec["scaleup_vs_single_shard"],
+         value_spread_pct=rec["value_spread_pct"],
+         zero_collectives=rec["zero_collectives"],
+         collective_audit=rec["collective_audit"],
+         sharded_applies=rec["sharded_applies"],
+         single_shard_applies=rec["single_shard_applies"],
+         measured_platform=rec["platform"],
+         threshold=rec["threshold"])
+    if record_session:
+        print(f"# cfg12 session row appended by bench.py --sharded "
+              f"--session (platform {rec['platform']})", file=sys.stderr)
+
+
 def config5b_residual_heavy(n_actors: int = 10_000, quick: bool = False):
     """Adversarial headline shape: 20% of ops are RESIDUALS (bare deletes
     of distinct base elements + bare inserts without values) that cannot
@@ -1147,6 +1198,12 @@ def main():
         # JSON appended to BENCH_SESSIONS.jsonl (PR-4 credibility rules)
         config11_service(quick=quick, record_session=True)
         return
+    if "--sharded-session" in sys.argv:
+        # the chip_session.sh cfg12 step: ONLY the sharded row, the
+        # subprocess's honest cpu-dryrun JSON appended to
+        # BENCH_SESSIONS.jsonl (the acceptance bar is defined there)
+        config12_sharded(quick=quick, record_session=True)
+        return
     record_round = None
     record_path = None
     if "--record" in sys.argv:
@@ -1229,6 +1286,7 @@ def main():
                                     n_changes=20 if quick else 50),
         lambda: config10_save_load(n_changes=15 if quick else 40),
         lambda: config11_service(quick=quick),
+        lambda: config12_sharded(quick=quick),
     ]
     if record_path is not None:
         steps.insert(0, fold_headline)

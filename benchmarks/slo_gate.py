@@ -40,6 +40,8 @@ SLOS = [
     ("cfg11_service", "value", "min", 0.7),
     ("cfg11_service", "p99_tick_ms", "max", 1.5),
     ("cfg11_service", "shed_rate", "max", 2.0),
+    ("cfg12_sharded", "value", "min", 0.8),
+    ("cfg12_sharded", "scaleup_vs_single_shard", "min", 0.9),
 ]
 
 #: Absolute SLOs: (metric_prefix, dotted field, op, bound) checked on
@@ -49,6 +51,12 @@ SLOS = [
 ABS_SLOS = [
     ("cfg11_service", "max_lag_ops", "<=", 0),
     ("cfg11_service", "max_lag_ticks", "<=", 0),
+    # the sharded commit path stays communication-free, forever: any
+    # nonzero collective count in a committed cfg12 row is a regression
+    # of the tier's core invariant, not a tunable
+    ("cfg12_sharded", "collective_ops_total", "<=", 0),
+    # the ISSUE-10 acceptance bar on the committed dryrun rows
+    ("cfg12_sharded", "scaleup_vs_single_shard", ">=", 4.0),
 ]
 
 #: Derived fields computable from any row that carries the inputs.
@@ -58,6 +66,10 @@ DERIVED = {
     "shed_rate": lambda row: (
         row["shed_total"] / max(1, row["admitted_ops"])
         if "shed_total" in row and "admitted_ops" in row else None),
+    # total cross-device collectives in the cfg12 commit-path HLO audit
+    "collective_ops_total": lambda row: (
+        sum(sum(v.values()) for v in row["collective_audit"].values())
+        if isinstance(row.get("collective_audit"), dict) else None),
 }
 
 

@@ -110,6 +110,16 @@ run "cfg4_stacked_ab" 600 python -m benchmarks.cfg4_smoke --record-session
 # are the chip numbers
 run "service_soak"  900 python scripts/soak.py --service --quick
 run "cfg11_service" 900 python -m benchmarks.run_all --service-session
+# sharded serving tier (ISSUE 10): the shard-count invariance soak
+# (same seeded chaotic stream on 1 vs 8 shards -> byte-identical
+# bundles, incl. a telemetry-triggered hot-doc migration mid-stream),
+# then the cfg12 aggregate-mesh row. The cfg12 step runs in its own
+# subprocess with the 8-virtual-device env (run_all config12_sharded),
+# so ON the chip it still measures the cpu-dryrun distribution
+# property; a real multi-chip window should export AMTPU_SHARDS and
+# run bench.py --sharded directly against the hardware mesh
+run "sharded_soak"  900 python scripts/soak.py --sharded --sessions 4
+run "cfg12_sharded" 1800 python -m benchmarks.run_all --sharded-session
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu

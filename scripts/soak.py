@@ -521,8 +521,15 @@ def session_checkpoint(seed: int) -> None:
         f"checkpoint seed {seed}: change histories diverged after restart"
 
 
-#: metrics of the most recent service session (folded into the summary)
-LAST_SERVICE_METRICS: dict = {}
+#: Per-profile metrics registry: a profile that wants its numbers in the
+#: campaign summary UPDATES ITS ENTRY IN PLACE (never prints its own
+#: JSON — the one-line artifact contract lives in emit_summary alone,
+#: so a new profile cannot regress it by copy-pasting emission logic).
+#: Non-empty entries fold into the summary as "<profile>_metrics".
+PROFILE_METRICS: dict = {"service": {}, "sharded": {}}
+
+#: back-compat alias: the service profile's registry entry
+LAST_SERVICE_METRICS = PROFILE_METRICS["service"]
 
 #: --scrape: serve the live Prometheus endpoint during the service soak
 #: and validate the exposition + /describe dump from an actual HTTP
@@ -893,10 +900,129 @@ def _service_scenario(am, svc, cfg, seed, n_clients, n_ticks, room_size,
     assert m["max_lag_ops"] == 0 and m["max_lag_ticks"] == 0, m
 
 
+def _sharded_stream(seed: int, n_docs: int, n_actors: int, n_seqs: int,
+                    hot_doc: str, hot_factor: int, n_chunks: int):
+    """Deterministic chaotic delivery schedule for one sharded session:
+    per-doc causally-chained change lists (every seq depends on every
+    actor's previous seq), fully shuffled across docs and seqs (so
+    causally-premature arrivals are guaranteed and park in the router
+    quarantine), with ~10% duplicated deliveries, chunked into
+    `n_chunks` serving rounds. Same seed -> byte-identical schedule,
+    whatever the shard count."""
+    rng = np.random.default_rng(seed * 7919 + 17)
+    docs = [f"sdoc-{seed}-{i}" for i in range(n_docs)]
+    flat = []
+    for di, doc in enumerate(docs):
+        seqs = n_seqs * (hot_factor if doc == hot_doc else 1)
+        for s in range(1, seqs + 1):
+            for a in range(n_actors):
+                actor, run = f"w{a}", 4
+                base = (s - 1) * run + 1
+                key = "_head" if s == 1 else f"{actor}:{base - 1}"
+                ops = []
+                for k in range(run):
+                    ctr = base + k
+                    ops.append({"action": "ins", "obj": doc, "key": key,
+                                "elem": ctr})
+                    ops.append({"action": "set", "obj": doc,
+                                "key": f"{actor}:{ctr}",
+                                "value": chr(97 + (ctr + a + di) % 26)})
+                    key = f"{actor}:{ctr}"
+                deps = {} if s == 1 else \
+                    {f"w{b}": s - 1 for b in range(n_actors) if b != a}
+                flat.append((doc, {"actor": actor, "seq": s,
+                                   "deps": deps, "ops": ops}))
+    rng.shuffle(flat)
+    for i in rng.choice(len(flat), max(1, len(flat) // 10),
+                        replace=False):
+        flat.insert(int(rng.integers(0, len(flat))), flat[int(i)])
+    per = max(1, -(-len(flat) // n_chunks))
+    rounds = []
+    for c in range(0, len(flat), per):
+        chunk: dict = {}
+        for doc, ch in flat[c: c + per]:
+            chunk.setdefault(doc, []).append(ch)
+        rounds.append(chunk)
+    return docs, rounds
+
+
+def session_sharded(seed: int, n_docs: int = 8, n_actors: int = 2,
+                    n_seqs: int = 4, shard_counts=(1, 8)) -> None:
+    """Shard-count invariance under chaotic delivery (ISSUE 10): the
+    SAME seeded change stream — full cross-doc shuffle (premature
+    arrivals park in the router quarantine), duplicated deliveries, and
+    a telemetry-triggered hot-doc migration mid-stream on the
+    multi-shard mesh — served at every shard count in `shard_counts`
+    must converge to byte-identical state: per-doc checkpoint-bundle
+    bytes (automerge_tpu.checkpoint.capture_engine — tables, clocks,
+    dep closures, conflicts) AND rendered texts equal across meshes,
+    with every quarantine drained. On meshes with >= 2 shards the
+    rebalance policy must have actually moved the hot doc (the
+    acceptance bar's "at least one telemetry-triggered migration
+    mid-stream"); single-shard runs prove the same stream without any
+    migration, so the comparison also pins migration neutrality."""
+    from automerge_tpu.shard import ShardedDocSet
+    from automerge_tpu.shard.placement import hash_shard
+
+    # hot doc: hammered `hot_factor` harder than the rest, chosen (from
+    # ids alone, so every mesh sees the same stream) to share its
+    # max-shard-count lane with another doc — migrating it away must
+    # actually relieve a co-tenant
+    max_shards = max(shard_counts)
+    ids = [f"sdoc-{seed}-{i}" for i in range(n_docs)]
+    homes = [hash_shard(d, max_shards) for d in ids]
+    hot_doc = ids[0]
+    for i, d in enumerate(ids):
+        if homes.count(homes[i]) >= 2:
+            hot_doc = d
+            break
+    results = {}
+    for n_shards in shard_counts:
+        docs, rounds = _sharded_stream(seed, n_docs, n_actors, n_seqs,
+                                       hot_doc, hot_factor=4,
+                                       n_chunks=6)
+        mesh = ShardedDocSet(n_shards=n_shards, capacity=64)
+        if n_shards >= 2:
+            mesh.attach_rebalancer(ratio=2.0, min_ops=64, cooldown=2)
+        for chunk in rounds:
+            mesh.deliver_round(chunk)
+        for doc in docs:
+            assert mesh.quarantined(doc) == 0, \
+                f"sharded seed {seed} ({n_shards} shards): quarantine " \
+                f"not drained for {doc}"
+        if n_shards >= 2:
+            assert mesh.stats["migrations"] >= 1, \
+                f"sharded seed {seed}: no telemetry-triggered migration " \
+                f"on the {n_shards}-shard mesh ({mesh.stats}, loads " \
+                f"{mesh.rebalancer.window_loads()})"
+        results[n_shards] = (
+            {doc: mesh.capture(doc) for doc in docs}, mesh.texts(),
+            dict(mesh.stats))
+    ref_shards = shard_counts[0]
+    bundles0, texts0, _ = results[ref_shards]
+    for n_shards, (bundles, texts, _stats) in results.items():
+        assert texts == texts0, \
+            f"sharded seed {seed}: texts diverged at {n_shards} vs " \
+            f"{ref_shards} shards"
+        for doc in bundles0:
+            assert bundles[doc] == bundles0[doc], \
+                f"sharded seed {seed}: checkpoint bytes of {doc} " \
+                f"diverged at {n_shards} vs {ref_shards} shards"
+    multi = max(shard_counts)
+    PROFILE_METRICS["sharded"].clear()
+    PROFILE_METRICS["sharded"].update(
+        shard_counts=list(shard_counts), n_docs=n_docs,
+        hot_doc=hot_doc, **{f"stats_{n}_shards": results[n][2]
+                            for n in shard_counts},
+        migrations=results[multi][2]["migrations"],
+        parked=results[multi][2]["parked"],
+        released=results[multi][2]["released"])
+
+
 PROFILES = {"general": session_general, "conflict": session_conflict,
             "lossy": session_lossy, "table": session_table,
             "chaos": session_chaos, "checkpoint": session_checkpoint,
-            "service": session_service}
+            "service": session_service, "sharded": session_sharded}
 
 
 def run(profile: str, sessions: int, seed_base: int,
@@ -951,8 +1077,26 @@ def run(profile: str, sessions: int, seed_base: int,
             obs.write_trace(path)
             print(f"soak: trace written to {path} "
                   "(load at https://ui.perfetto.dev)", file=sys.stderr)
-    # the machine-readable artifact: profile + SEEDS + event mix (the
-    # diagnosable-soak contract, ISSUE 6). Last line, valid JSON.
+    emit_summary(
+        names, sessions, seed_base, total, failures, dt, events,
+        obs_records={"emitted": snap["emitted"] - n0,
+                     "retained": snap["retained"]},
+        trace_path=path if trace else None)
+    return 1 if failures else 0
+
+
+def emit_summary(names, sessions, seed_base, total, failures, dt,
+                 events, obs_records, trace_path=None):
+    """THE one summary emitter: every campaign — whatever mix of
+    profiles ran — ends with exactly ONE machine-readable JSON line
+    (profile + SEEDS + event mix: the diagnosable-soak contract, ISSUE
+    6; last line of stdout, pinned by tests/test_soak_smoke.py).
+    Profiles contribute numbers by updating their PROFILE_METRICS entry
+    in place — never by printing JSON themselves, so a new profile
+    cannot regress the one-line artifact by copy-pasting emission
+    logic."""
+    import json
+
     summary = {
         "soak_profiles": names,
         "sessions_per_profile": sessions,
@@ -963,14 +1107,13 @@ def run(profile: str, sessions: int, seed_base: int,
         "failures": [{"profile": n, "seed": sd, "error": e}
                      for n, sd, e in failures],
         "events": events,
-        "obs_records": {"emitted": snap["emitted"] - n0,
-                        "retained": snap["retained"]},
-        **({"service_metrics": dict(LAST_SERVICE_METRICS)}
-           if "service" in names and LAST_SERVICE_METRICS else {}),
-        **({"trace_path": path} if trace else {}),
+        "obs_records": obs_records,
+        **{f"{name}_metrics": dict(PROFILE_METRICS[name])
+           for name in names
+           if PROFILE_METRICS.get(name)},
+        **({"trace_path": trace_path} if trace_path else {}),
     }
     print(json.dumps(summary, sort_keys=True), flush=True)
-    return 1 if failures else 0
 
 
 def main():
@@ -986,6 +1129,13 @@ def main():
                     help="shorthand for --profile service at scale "
                          "(--clients concurrent sessions, default 1000; "
                          "--sessions defaults to 1 seed)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="shorthand for --profile sharded (shard-count "
+                         "invariance: the same seeded chaotic stream on "
+                         "1 vs 8 shards must converge byte-identically, "
+                         "with a telemetry-triggered hot-doc migration "
+                         "mid-stream on the mesh; --sessions defaults "
+                         "to 8 seeds)")
     ap.add_argument("--clients", type=int, default=None,
                     help="service profile: concurrent client sessions "
                          "(default 1000 with --service)")
@@ -1004,15 +1154,18 @@ def main():
     args = ap.parse_args()
     profile = ("chaos" if args.chaos
                else "checkpoint" if args.checkpoint
-               else "service" if args.service else args.profile)
+               else "service" if args.service
+               else "sharded" if args.sharded else args.profile)
     clients = args.clients
     if args.service and clients is None:
         clients = 100 if args.quick else 1000
     sessions = args.sessions
     if sessions is None:
         # one seed at service scale (a 1000-session scenario IS the
-        # campaign); 30 everywhere else (the historical default)
-        sessions = 1 if profile == "service" else 30
+        # campaign); 8 for the sharded profile (each seed runs the full
+        # stream at EVERY shard count); 30 everywhere else
+        sessions = (1 if profile == "service"
+                    else 8 if profile == "sharded" else 30)
     return run(profile, sessions, args.seed_base, trace=args.trace,
                clients=clients, scrape=args.scrape)
 

@@ -57,6 +57,49 @@ def test_service_summary_is_exactly_one_json_line(capsys):
     assert sm["max_lag_ops"] == 0             # quiesced == zero lag
 
 
+def _one_json_summary(out):
+    """The emit_summary contract: stdout ends with EXACTLY one JSON
+    line; returns it parsed."""
+    import json
+
+    lines = [ln for ln in out.strip().splitlines() if ln.strip()]
+    parsed = []
+    for ln in lines:
+        try:
+            obj = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            parsed.append((ln, obj))
+    assert len(parsed) == 1, [ln for ln, _ in parsed]
+    assert parsed[0][0] == lines[-1]
+    return parsed[0][1]
+
+
+def test_sharded_summary_rides_the_shared_emitter(capsys):
+    """ISSUE-10's small fix, pinned: profiles contribute numbers by
+    updating their PROFILE_METRICS entry — emit_summary is THE one
+    emitter, so a sharded campaign's stdout also ends with exactly one
+    JSON line, carrying the shard-invariance metrics (migrations,
+    quarantine traffic, per-shard-count stats)."""
+    assert soak.run("sharded", sessions=1, seed_base=0) == 0
+    summary = _one_json_summary(capsys.readouterr().out)
+    sm = summary["sharded_metrics"]
+    for key in ("shard_counts", "migrations", "parked", "released",
+                "hot_doc"):
+        assert key in sm, key
+    assert sm["migrations"] >= 1              # the mesh actually moved it
+    assert sm["shard_counts"] == [1, 8]
+
+
+def test_profile_metrics_registry_covers_publishing_profiles():
+    """A new profile cannot print its own summary JSON: the registry is
+    the only channel into emit_summary, and every registered entry
+    belongs to a real profile."""
+    assert set(soak.PROFILE_METRICS) <= set(soak.PROFILES)
+    assert soak.LAST_SERVICE_METRICS is soak.PROFILE_METRICS["service"]
+
+
 @pytest.mark.slow
 def test_chaos_campaign_50_sessions():
     """The ISSUE-1 acceptance bar, runnable on demand (excluded from the
