@@ -64,9 +64,11 @@ def _copy_all_deps(all_deps: dict) -> list:
 def grab(doc, inline: bool = False) -> dict:
     """Generation-stamped consistent snapshot of one engine doc.
 
-    Cheap (no device traffic). The caller either owns the mutation thread
-    (no race possible) or retries on :class:`CaptureConflict` — see
-    :class:`~.writer.AsyncCheckpointer`.
+    Cheap (no device traffic). A grab racing a mutation serves the doc's
+    last cached commit-boundary snapshot (a fully-copied prior grab —
+    "some consistent prefix", the writer's contract) instead of
+    conflicting; :class:`CaptureConflict` survives only for donated
+    buffers and the cold first-grab race (INTERNALS §16.4).
 
     The zero-copy contract — grabbed device-table REFERENCES stay valid
     while ingestion advances — holds because the ingest kernels replace
@@ -81,18 +83,28 @@ def grab(doc, inline: bool = False) -> dict:
     from ..engine.map_doc import DeviceMapDoc
     from ..engine.text_doc import DeviceTextDoc
 
-    if doc.queue:
-        raise CheckpointError(
-            f"cannot checkpoint {doc.obj_id!r}: it holds causally-unready "
-            "queued changes (drain or drop them first)")
     if getattr(doc, "donate_buffers", False) and not inline:
         raise CaptureConflict(doc.obj_id)
     if getattr(doc, "_busy", 0):
         # a mutation is in flight: gen stamps alone can't expose one that
-        # spans this whole grab (the bump lands at mutation end)
+        # spans this whole grab (the bump lands at mutation end). Serve
+        # the last commit-boundary snapshot instead of conflicting — the
+        # writer's contract is "SOME consistent prefix", and every cached
+        # grab is exactly one (built at a quiescent point, all host dicts
+        # copied, device arrays immutable, index persistent). The
+        # busy-wait/retry ladder thus collapses to a snapshot read;
+        # CaptureConflict survives only for donated buffers and the cold
+        # first-grab race (no snapshot exists yet).
+        served = _serve_snapshot(doc)
+        if served is not None:
+            return served
         if obs.ENABLED:
             obs.event("ckpt", "busy_wait", args={"doc": doc.obj_id})
         raise CaptureConflict(doc.obj_id)
+    if doc.queue:
+        raise CheckpointError(
+            f"cannot checkpoint {doc.obj_id!r}: it holds causally-unready "
+            "queued changes (drain or drop them first)")
     gen0 = doc._gen
     dev = dict(doc._dev) if doc._dev is not None else None
     g = {
@@ -109,8 +121,11 @@ def grab(doc, inline: bool = False) -> dict:
         g["type"] = "text"
         g["n_elems"] = doc.n_elems
         g["all_ascii"] = doc.all_ascii
-        idx = doc.index
-        g["index"] = (idx.starts, idx.lens, idx.slots)  # immutable post-merge
+        # O(1) zero-coordination snapshot: the range index is persistent
+        # (merge/remap return new indexes), so the snapshot can never
+        # observe a torn bulk merge; flattening to rows happens in
+        # encode_grab, off the grab's critical path
+        g["index"] = doc.index.snapshot()
     elif isinstance(doc, DeviceMapDoc):
         g["type"] = "map"
         g["key_table"] = list(doc.key_table)
@@ -120,8 +135,48 @@ def grab(doc, inline: bool = False) -> dict:
     if doc._gen != gen0 or getattr(doc, "_busy", 0) \
             or (doc._dev is not None and dev is not None
                 and dev.keys() != doc._dev.keys()):
+        served = _serve_snapshot(doc)
+        if served is not None:
+            return served
         raise CaptureConflict(doc.obj_id)
+    g["mode"] = "live"
+    if not getattr(doc, "donate_buffers", False):
+        # cache the grab as the doc's commit-boundary snapshot: every
+        # copy above froze it, so a later grab racing a mutation (a bulk
+        # index merge, a whole stacked apply) reads it with zero
+        # coordination. Donated docs never cache — their table buffers
+        # are consumed in place by the next commit. Cost: the snapshot
+        # pins one table-set generation between grabs (INTERNALS §16.4).
+        doc._last_grab = g
     return g
+
+
+def _serve_snapshot(doc):
+    """The doc's cached commit-boundary grab, as a fresh dict marked
+    ``mode='snapshot'`` (None when no snapshot exists or it is no
+    longer servable)."""
+    snap = getattr(doc, "_last_grab", None)
+    if snap is None:
+        return None
+    if getattr(doc, "donate_buffers", False):
+        # donated commits consume table buffers in place: only the
+        # inline (caller-owns-quiescence) path may capture such a doc
+        return None
+    dev = snap.get("dev")
+    if dev:
+        from ..ops.ingest import buffers_consumed
+        if buffers_consumed(tuple(dev.values())):
+            # a donation session since the grab consumed the snapshot's
+            # buffers in place — the cache is dead, drop it (the cold
+            # CaptureConflict path takes over, as pre-snapshot)
+            doc._last_grab = None
+            return None
+    if obs.ENABLED:
+        obs.event("ckpt", "snapshot_serve",
+                  args={"doc": doc.obj_id, "gen": snap["gen"]})
+    out = dict(snap)
+    out["mode"] = "snapshot"
+    return out
 
 
 def encode_grab(g: dict, prefix: str = ""):
@@ -144,7 +199,9 @@ def encode_grab(g: dict, prefix: str = ""):
         n_live = g["n_elems"] + 1
         frag["n_elems"] = g["n_elems"]
         frag["all_ascii"] = g["all_ascii"]
-        starts, lens, slots = g["index"]
+        idx = g["index"]
+        starts, lens, slots = (idx if isinstance(idx, tuple)
+                               else idx.rows())
         arrays[prefix + "idx_starts"] = np.asarray(starts, np.int64)
         arrays[prefix + "idx_lens"] = np.asarray(lens, np.int64)
         arrays[prefix + "idx_slots"] = np.asarray(slots, np.int64)
@@ -206,7 +263,7 @@ def restore_engine_doc(frag: dict, arrays: dict, prefix: str = "",
     ``shared_all_deps``: backend-level restores pass the closure map
     rebuilt once from the core history (per-doc closure maps all converge
     to the same content); engine-level bundles carry their own."""
-    from ..engine.host_index import ElemRangeIndex
+    from ..engine.host_index import index_from_rows
     from ..engine.map_doc import DeviceMapDoc
     from ..engine.segments import SegmentMirror
     from ..engine.text_doc import DeviceTextDoc
@@ -234,12 +291,10 @@ def restore_engine_doc(frag: dict, arrays: dict, prefix: str = "",
         doc = DeviceTextDoc(obj_id, capacity=max(n_elems + 1, 16))
         doc.all_ascii = bool(frag["all_ascii"])
         doc.n_elems = n_elems
-        idx = ElemRangeIndex()
-        idx.starts = np.asarray(
-            _require(arrays, prefix + "idx_starts"), np.int64)
-        idx.lens = np.asarray(_require(arrays, prefix + "idx_lens"), np.int64)
-        idx.slots = np.asarray(
-            _require(arrays, prefix + "idx_slots"), np.int64)
+        idx = index_from_rows(
+            np.asarray(_require(arrays, prefix + "idx_starts"), np.int64),
+            np.asarray(_require(arrays, prefix + "idx_lens"), np.int64),
+            np.asarray(_require(arrays, prefix + "idx_slots"), np.int64))
         doc.index = idx
         if n_elems:
             n_live = n_elems + 1

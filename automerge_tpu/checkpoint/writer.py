@@ -97,7 +97,7 @@ class AsyncCheckpointer:
         self._wake = threading.Condition(self._lock)
         self._closing = False
         self.stats = {"async_captures": 0, "grab_conflicts": 0,
-                      "sync_fallbacks": 0}
+                      "sync_fallbacks": 0, "snapshot_serves": 0}
 
     # -- lifecycle -------------------------------------------------------
 
@@ -178,6 +178,13 @@ class AsyncCheckpointer:
         for _ in range(self._max_retries):
             try:
                 g = grab(doc)
+                if g.get("mode") == "snapshot":
+                    # zero-coordination read of the doc's cached
+                    # commit-boundary state: a mutation (bulk index
+                    # merge, stacked apply) was in flight, and instead
+                    # of the old busy-wait/retry ladder the grab served
+                    # the last consistent snapshot (INTERNALS §16.4)
+                    self.stats["snapshot_serves"] += 1
                 break
             except CaptureConflict:
                 self.stats["grab_conflicts"] += 1

@@ -32,8 +32,7 @@ import numpy as np
 from .._common import HEAD_PARENT, make_elem_id
 from .base import transitive_closure
 from .columnar import TextChangeBatch
-from .host_index import (DuplicateElemId, ElemRangeIndex, pack_keys,
-                         unpack_key)
+from .host_index import DuplicateElemId, new_index, pack_keys, unpack_key
 from .runs import detect_runs
 from .segments import SegmentMirror
 from .text_doc import DeviceTextDoc, logger
@@ -47,7 +46,7 @@ class _DocMeta:
         self.clock: dict = {}
         self.actor_table: list = []
         self.actor_rank: dict = {}
-        self.index = ElemRangeIndex()
+        self.index = new_index()
         self.n_elems = 0
         self.seg_bound = 2
         self.all_ascii = True

@@ -8,20 +8,73 @@ the host, where the op columns originate anyway. Two facts make this cheap:
 - elemIds minted by one actor have consecutive counters within a typing run,
   and runs land in consecutive device slots, so the index stores *ranges*
   ((actor, ctr0) .. +len -> slot0 .. +len), not individual elements;
-- lookups are numpy ``searchsorted`` over the packed range starts — C-speed
+- lookups are numpy ``searchsorted`` over packed range starts — C-speed
   binary search, no device round trip, no int64 emulation on the TPU (int64
   sorts/searches run emulated and severalfold slower than int32 on v5e;
   design assumption, docs/MEASUREMENTS.md).
 
 Keys pack as (actor_rank << 32 | ctr); counters stay < 2^31 so keys within a
 range are consecutive integers and slot arithmetic is a subtraction.
+
+Two index structures implement the same contract (INTERNALS §16.2):
+
+- :class:`BatchRangeIndex` (default) — Jiffy-style batch-update tiers: a
+  round's minted ranges land as ONE immutable sorted run appended to a
+  small tier list, with amortized size-doubling compaction; every
+  instance is persistent (``merge``/``remap_actors`` return NEW
+  indexes, nothing published is ever written again), so readers —
+  checkpoint ``grab()``, pull paths, the stacked gather — take
+  zero-coordination O(1) snapshots (``snapshot()`` is ``self``) that can
+  never observe a torn merge. Per-round cost is O(K log K + K log R)
+  instead of the sorted-insert array's O(R) whole-array copy; the index
+  grows with document lifetime, the round's ranges do not.
+- :class:`SortedInsertIndex` — the PR-2 sorted-insert array, kept
+  verbatim behind ``AMTPU_BATCH_INDEX=0`` as the parity comparator
+  (tests/test_batch_index.py pins lookup/merge/flatten byte-identity).
+
+Both coalesce key- and slot-contiguous neighbors in their flattened view,
+so checkpoint bundles (``idx_starts``/``idx_lens``/``idx_slots``) are
+byte-identical across the flag.
 """
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from .._common import check_int32_envelope
+from .. import obs
+
+#: Process-wide bulk-merge accounting: the cfg12t budget — one bulk merge
+#: per doc per round, never one insert per range — is asserted against
+#: these counters (engine/stacked.assert_round_budget, bench.py cfg12t).
+MERGE_STATS = {"bulk_merges": 0, "ranges_inserted": 0, "compactions": 0}
+
+
+def merge_stats_snapshot() -> dict:
+    return dict(MERGE_STATS)
+
+
+def batch_index_enabled() -> bool:
+    """The batch-update tiered index (INTERNALS §16.2) is the default;
+    the legacy sorted-insert array stays available as the parity
+    comparator behind ``AMTPU_BATCH_INDEX=0`` (read per call so tests
+    can pin either structure)."""
+    return os.environ.get("AMTPU_BATCH_INDEX", "1") != "0"
+
+
+def new_index():
+    """A fresh empty index of the configured structure."""
+    return BatchRangeIndex() if batch_index_enabled() \
+        else SortedInsertIndex()
+
+
+def index_from_rows(starts, lens, slots):
+    """Rebuild an index of the configured structure from flattened rows
+    (checkpoint restore; rows are trusted sorted + disjoint)."""
+    cls = BatchRangeIndex if batch_index_enabled() else SortedInsertIndex
+    return cls.from_rows(starts, lens, slots)
 
 
 def pack_keys(actor: np.ndarray, ctr: np.ndarray) -> np.ndarray:
@@ -52,8 +105,62 @@ class DuplicateElemId(ValueError):
         self.key = key
 
 
-class ElemRangeIndex:
-    """Sorted, coalesced (key range -> slot range) map."""
+def _sort_new(starts, lens, slots):
+    """Sort one merge call's ranges by start (stable) and validate the
+    within-call overlap; int64 working copies."""
+    new_starts = np.asarray(starts, np.int64)
+    new_lens = np.asarray(lens, np.int64)
+    new_slots = np.asarray(slots, np.int64)
+    if len(new_starts) > 1:
+        order = np.argsort(new_starts, kind="stable")
+        new_starts = new_starts[order]
+        new_lens = new_lens[order]
+        new_slots = new_slots[order]
+        ends = new_starts + new_lens
+        bad = np.flatnonzero(ends[:-1] > new_starts[1:])
+        if len(bad):
+            raise DuplicateElemId(int(new_starts[bad[0] + 1]))
+    return new_starts, new_lens, new_slots
+
+
+def _coalesce(starts, lens, slots):
+    """Coalesce key- AND slot-contiguous neighbors of one sorted,
+    non-overlapping run (the legacy per-merge pass, shared so the two
+    structures' flattened views are byte-identical)."""
+    if len(starts) > 1:
+        ends = starts + lens
+        joined = (ends[:-1] == starts[1:]) & \
+                 (slots[:-1] + lens[:-1] == slots[1:])
+        if joined.any():
+            head = np.concatenate([[True], ~joined])
+            group = np.cumsum(head) - 1
+            n = int(group[-1]) + 1
+            g_start = starts[head]
+            g_slot = slots[head]
+            g_len = np.zeros(n, np.int64)
+            np.add.at(g_len, group, lens)
+            starts, lens, slots = g_start, g_len, g_slot
+    return starts, lens, slots
+
+
+def _merge_runs(a, b):
+    """Merge two sorted disjoint runs into one (stable by start; equal
+    starts cannot occur — runs are key-disjoint), coalescing neighbors."""
+    starts = np.concatenate([a[0], b[0]])
+    lens = np.concatenate([a[1], b[1]])
+    slots = np.concatenate([a[2], b[2]])
+    order = np.argsort(starts, kind="stable")
+    return _coalesce(starts[order], lens[order], slots[order])
+
+
+class SortedInsertIndex:
+    """Sorted, coalesced (key range -> slot range) map — the legacy
+    sorted-insert array (parity comparator, ``AMTPU_BATCH_INDEX=0``).
+
+    Persistent like its replacement: ``merge`` and ``remap_actors``
+    return NEW indexes and published array attributes are only ever
+    rebound, never written — so ``snapshot()`` is a cheap consistent
+    view here too."""
 
     __slots__ = ("starts", "lens", "slots", "_slot_view")
 
@@ -63,12 +170,31 @@ class ElemRangeIndex:
         self.slots = np.empty(0, np.int64)    # device slot of the first key
         self._slot_view = None                # lazy slot-sorted view
 
+    @classmethod
+    def from_rows(cls, starts, lens, slots) -> "SortedInsertIndex":
+        out = cls()
+        out.starts = np.asarray(starts, np.int64)
+        out.lens = np.asarray(lens, np.int64)
+        out.slots = np.asarray(slots, np.int64)
+        return out
+
     @property
     def n_ranges(self) -> int:
         return len(self.starts)
 
+    def rows(self) -> tuple:
+        """Flattened (starts, lens, slots) view (checkpoint encode)."""
+        return self.starts, self.lens, self.slots
+
+    def snapshot(self) -> "SortedInsertIndex":
+        """A consistent read view: array refs are shared (every mutation
+        rebinds, so a snapshot can never observe a torn merge)."""
+        out = SortedInsertIndex()
+        out.starts, out.lens, out.slots = self.starts, self.lens, self.slots
+        return out
+
     def merge(self, starts: np.ndarray, lens: np.ndarray,
-              slots: np.ndarray) -> "ElemRangeIndex":
+              slots: np.ndarray) -> "SortedInsertIndex":
         """Return a new index with the ranges inserted (the caller commits it
         only after every other validity check passes, so a raising batch
         leaves the document untouched). Raises ValueError on any key overlap
@@ -76,6 +202,7 @@ class ElemRangeIndex:
         applyInsert)."""
         if len(starts) == 0:
             return self
+        _t0 = obs.now() if obs.ENABLED else 0
         # sort only the NEW ranges (K log K), then place them into the
         # already-sorted index with one searchsorted + insert (O(R + K))
         # instead of re-argsorting all R + K ranges per round — the index
@@ -102,21 +229,18 @@ class ElemRangeIndex:
             bad = np.flatnonzero(ends[:-1] > starts[1:])
             if len(bad):
                 raise DuplicateElemId(int(starts[bad[0] + 1]))
+        # count AFTER validation (as the batch structure does), so the
+        # process-wide accounting agrees across the flag on raising merges
+        MERGE_STATS["bulk_merges"] += 1
+        MERGE_STATS["ranges_inserted"] += len(new_starts)
         # coalesce key- and slot-contiguous neighbors to keep the index small
-        if len(starts) > 1:
-            joined = (ends[:-1] == starts[1:]) & \
-                     (slots[:-1] + lens[:-1] == slots[1:])
-            if joined.any():
-                head = np.concatenate([[True], ~joined])
-                group = np.cumsum(head) - 1
-                n = int(group[-1]) + 1
-                g_start = starts[head]
-                g_slot = slots[head]
-                g_len = np.zeros(n, np.int64)
-                np.add.at(g_len, group, lens)
-                starts, lens, slots = g_start, g_len, g_slot
-        out = ElemRangeIndex()
+        starts, lens, slots = _coalesce(starts, lens, slots)
+        out = SortedInsertIndex()
         out.starts, out.lens, out.slots = starts, lens, slots
+        if obs.ENABLED:
+            obs.span("plan", "index_merge", _t0, args={
+                "structure": "sorted_insert", "n_new": len(new_starts),
+                "n_ranges": len(starts)})
         return out
 
     def lookup(self, keys: np.ndarray):
@@ -135,33 +259,250 @@ class ElemRangeIndex:
         occupying each slot. Every live slot >= 1 is covered (each was
         registered when its insert was planned); raises on a slot outside
         every range. The slot-sorted view is cached — instances are
-        immutable after `merge` except for `remap_actors`, which drops it."""
+        immutable after construction."""
         view = self._slot_view
         if view is None:
             order = np.argsort(self.slots, kind="stable")
             view = (self.slots[order], self.lens[order], self.starts[order])
             self._slot_view = view
-        s_slots, s_lens, s_starts = view
-        slots = np.asarray(slots, np.int64)
-        pos = np.searchsorted(s_slots, slots, side="right") - 1
-        safe = np.clip(pos, 0, None)
-        ok = (pos >= 0) & (slots < s_slots[safe] + s_lens[safe])
-        if not ok.all():
-            raise KeyError(
-                f"slot {int(slots[np.flatnonzero(~ok)[0]])} not in index")
-        key = s_starts[safe] + (slots - s_slots[safe])
-        return key >> 32, key & 0xFFFFFFFF
+        return _slot_to_key(view, slots)
 
-    def remap_actors(self, remap: np.ndarray):
+    def remap_actors(self, remap: np.ndarray) -> "SortedInsertIndex":
         """Re-rank the actor halves of the keys after interning inserted a
-        new actor id below existing ones (rank order == lex order)."""
+        new actor id below existing ones (rank order == lex order).
+        Returns the remapped index (pure — the receiver is unchanged, so
+        outstanding snapshots stay valid)."""
         if self.n_ranges == 0:
-            return
+            return self
         actor = (self.starts >> 32).astype(np.int64)
         ctr = self.starts & 0xFFFFFFFF
-        self.starts = (remap[actor].astype(np.int64) << 32) | ctr
-        order = np.argsort(self.starts, kind="stable")
-        self.starts = self.starts[order]
-        self.lens = self.lens[order]
-        self.slots = self.slots[order]
+        starts = (remap[actor].astype(np.int64) << 32) | ctr
+        order = np.argsort(starts, kind="stable")
+        out = SortedInsertIndex()
+        out.starts = starts[order]
+        out.lens = self.lens[order]
+        out.slots = self.slots[order]
+        return out
+
+
+def _slot_to_key(view, slots):
+    """Shared reverse-lookup body over a (slots, lens, starts) slot-sorted
+    view (both index structures)."""
+    s_slots, s_lens, s_starts = view
+    slots = np.asarray(slots, np.int64)
+    pos = np.searchsorted(s_slots, slots, side="right") - 1
+    safe = np.clip(pos, 0, None)
+    ok = (pos >= 0) & (slots < s_slots[safe] + s_lens[safe])
+    if not ok.all():
+        raise KeyError(
+            f"slot {int(slots[np.flatnonzero(~ok)[0]])} not in index")
+    key = s_starts[safe] + (slots - s_slots[safe])
+    return key >> 32, key & 0xFFFFFFFF
+
+
+class BatchRangeIndex:
+    """Tiered batch-update range index with O(1) persistent snapshots.
+
+    Jiffy's batch-update + O(1)-snapshot discipline (PAPERS.md) applied
+    to the range map: a ``merge`` call lands the whole round's minted
+    ranges as ONE immutable sorted run appended to a small tier tuple,
+    validated against the existing tiers by binary-search probes
+    (O(K log K + K·T·log R), T = tier count) — never by rewriting the
+    resident O(R) array. Amortized size-doubling compaction (merge the
+    newest run into its predecessor while it is at least as long) bounds
+    the tier count at O(log R) and total compaction work at O(R log R)
+    over a document's lifetime.
+
+    Persistence is the memory model: every ``merge``/``remap_actors``
+    returns a NEW index whose runs are frozen numpy arrays shared with
+    the parent where unchanged; NOTHING reachable from a published index
+    is ever written again. ``snapshot()`` is therefore ``self`` — a
+    checkpoint grab, a pull, or the stacked gather can take it with zero
+    coordination while another thread merges, and can never observe a
+    torn state (tests/test_batch_index.py pins this under 8 threads).
+    """
+
+    __slots__ = ("_runs", "n_ranges", "_flat", "_slot_view")
+
+    _COMPACT_TIERS = 12   # hard lid on tier count (lookup cost bound);
+    # the doubling rule keeps real documents far below it
+
+    def __init__(self):
+        self._runs = ()        # tuple of (starts, lens, slots) sorted runs
+        self.n_ranges = 0      # total ranges across runs (pre-coalesce)
+        self._flat = None      # lazy flattened+coalesced view
         self._slot_view = None
+
+    @classmethod
+    def from_rows(cls, starts, lens, slots) -> "BatchRangeIndex":
+        out = cls()
+        run = (np.asarray(starts, np.int64), np.asarray(lens, np.int64),
+               np.asarray(slots, np.int64))
+        if len(run[0]):
+            out._runs = (run,)
+            out.n_ranges = len(run[0])
+            out._flat = run
+        return out
+
+    # -- flattened view (checkpoint encode, parity with the legacy) -----
+
+    def _flatten(self) -> tuple:
+        flat = self._flat
+        if flat is None:
+            if not self._runs:
+                flat = (np.empty(0, np.int64), np.empty(0, np.int64),
+                        np.empty(0, np.int64))
+            else:
+                flat = self._runs[0]
+                for run in self._runs[1:]:
+                    flat = _merge_runs(flat, run)
+            self._flat = flat
+        return flat
+
+    @property
+    def starts(self) -> np.ndarray:
+        return self._flatten()[0]
+
+    @property
+    def lens(self) -> np.ndarray:
+        return self._flatten()[1]
+
+    @property
+    def slots(self) -> np.ndarray:
+        return self._flatten()[2]
+
+    def rows(self) -> tuple:
+        """Flattened (starts, lens, slots) view (checkpoint encode);
+        byte-identical to the legacy structure's arrays."""
+        return self._flatten()
+
+    def snapshot(self) -> "BatchRangeIndex":
+        """O(1), zero-coordination: the index is persistent, so the
+        instance IS its own immutable snapshot."""
+        return self
+
+    # -- batch update ----------------------------------------------------
+
+    def _check_overlap(self, new_starts, new_lens):
+        """Raise DuplicateElemId when any new range overlaps a resident
+        one. Probe-based (O(K log R) per tier); the offending key matches
+        the legacy sorted-insert report: the later range's start in the
+        merged order (new-before-old on equal starts, so an exact
+        collision reports the OLD start — both carry the same key half
+        anyway)."""
+        new_ends = new_starts + new_lens
+        worst = None
+        for starts, lens, _slots in self._runs:
+            # (a) a new range starting inside a resident range
+            pos = np.searchsorted(starts, new_starts, side="right") - 1
+            safe = np.clip(pos, 0, None)
+            inside = (pos >= 0) & (new_starts < starts[safe] + lens[safe])
+            if inside.any():
+                k = int(new_starts[np.flatnonzero(inside)[0]])
+                worst = k if worst is None else min(worst, k)
+            # (b) a resident range starting inside a new range (strictly
+            # after its start — case (a) covered equality)
+            lo = np.searchsorted(starts, new_starts, side="right")
+            safe = np.clip(lo, 0, len(starts) - 1)
+            hit = (lo < len(starts)) & (starts[safe] < new_ends)
+            if hit.any():
+                k = int(starts[safe[np.flatnonzero(hit)[0]]])
+                worst = k if worst is None else min(worst, k)
+        if worst is not None:
+            raise DuplicateElemId(worst)
+
+    def merge(self, starts: np.ndarray, lens: np.ndarray,
+              slots: np.ndarray) -> "BatchRangeIndex":
+        """One bulk batch-update: the whole round's ranges land as one
+        immutable run. Returns the NEW index (persistent); raises
+        DuplicateElemId on any key overlap, leaving every published
+        index untouched."""
+        if len(starts) == 0:
+            return self
+        _t0 = obs.now() if obs.ENABLED else 0
+        new_run = _sort_new(starts, lens, slots)
+        self._check_overlap(new_run[0], new_run[1])
+        MERGE_STATS["bulk_merges"] += 1
+        MERGE_STATS["ranges_inserted"] += len(new_run[0])
+        runs = list(self._runs)
+        runs.append(_coalesce(*new_run))
+        # amortized doubling compaction: merge the newest run downward
+        # while it has grown at least as long as its predecessor
+        while len(runs) > 1 and (
+                len(runs[-1][0]) >= len(runs[-2][0])
+                or len(runs) > self._COMPACT_TIERS):
+            b = runs.pop()
+            a = runs.pop()
+            runs.append(_merge_runs(a, b))
+            MERGE_STATS["compactions"] += 1
+        for run in runs:
+            for arr in run:
+                arr.setflags(write=False)
+        out = BatchRangeIndex()
+        out._runs = tuple(runs)
+        out.n_ranges = sum(len(r[0]) for r in runs)
+        if len(runs) == 1:
+            out._flat = runs[0]
+        if obs.ENABLED:
+            obs.span("plan", "index_merge", _t0, args={
+                "structure": "batch_tiers", "n_new": len(new_run[0]),
+                "n_tiers": len(runs), "n_ranges": out.n_ranges})
+        return out
+
+    # -- reads -----------------------------------------------------------
+
+    def lookup(self, keys: np.ndarray):
+        """-> (slots int64, found bool) for packed query keys. One
+        binary-search pass per tier; a key lives in at most one tier
+        (ranges are globally disjoint), so the per-tier hits combine by
+        masked select."""
+        n = len(keys)
+        slot = np.zeros(n, np.int64)
+        found = np.zeros(n, bool)
+        for starts, lens, slots_r in self._runs:
+            pos = np.searchsorted(starts, keys, side="right") - 1
+            safe = np.clip(pos, 0, None)
+            hit = (pos >= 0) & (keys < starts[safe] + lens[safe])
+            if hit.any():
+                slot = np.where(hit, slots_r[safe] + (keys - starts[safe]),
+                                slot)
+                found |= hit
+        return slot, found
+
+    def slot_to_key(self, slots: np.ndarray):
+        """Reverse lookup over the flattened slot-sorted view (cached —
+        instances are immutable)."""
+        view = self._slot_view
+        if view is None:
+            f_starts, f_lens, f_slots = self._flatten()
+            order = np.argsort(f_slots, kind="stable")
+            view = (f_slots[order], f_lens[order], f_starts[order])
+            self._slot_view = view
+        return _slot_to_key(view, slots)
+
+    def remap_actors(self, remap: np.ndarray) -> "BatchRangeIndex":
+        """Re-rank the actor halves after an interning order change.
+        Pure: returns a NEW index; the receiver (and every outstanding
+        snapshot of it) is untouched."""
+        if not self._runs:
+            return self
+        runs = []
+        for starts, lens, slots_r in self._runs:
+            actor = (starts >> 32).astype(np.int64)
+            ctr = starts & 0xFFFFFFFF
+            new_starts = (remap[actor].astype(np.int64) << 32) | ctr
+            order = np.argsort(new_starts, kind="stable")
+            run = (new_starts[order], lens[order], slots_r[order])
+            for arr in run:
+                arr.setflags(write=False)
+            runs.append(run)
+        out = BatchRangeIndex()
+        out._runs = tuple(runs)
+        out.n_ranges = self.n_ranges
+        return out
+
+
+#: Default structure under the configured flag — the name the engine and
+#: annotations use. Constructions in engine code go through
+#: :func:`new_index` so the flag is honored per document.
+ElemRangeIndex = BatchRangeIndex

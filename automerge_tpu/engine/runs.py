@@ -21,6 +21,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .._common import KIND_INS, KIND_SET
+from .. import obs
 
 
 @dataclass
@@ -97,13 +98,21 @@ def detect_runs(kind, ta, tc, pa, pc, val64, op_row, base_elems: int
     hosts; one worker (AMTPU_PLAN_WORKERS=1) short-circuits to the
     single-shard path."""
     n_ops = len(kind)
+    _t0 = obs.now() if obs.ENABLED else 0
+    plan = None
     if n_ops >= _SHARD_MIN_OPS:
         plan = _detect_runs_sharded(kind, ta, tc, pa, pc, val64, op_row,
                                     base_elems)
-        if plan is not None:
-            return plan
-    return _detect_runs_single(kind, ta, tc, pa, pc, val64, op_row,
-                               base_elems)
+    if plan is None:
+        plan = _detect_runs_single(kind, ta, tc, pa, pc, val64, op_row,
+                                   base_elems)
+    if obs.ENABLED:
+        # the cold-prepare term cfg12t attributes (span-derived, the
+        # PR-6 contract): the cross-doc planner's whole point is that
+        # this span fires once per distinct batch shape, not per doc
+        obs.span("plan", "detect_runs", _t0, args={
+            "n_ops": n_ops, "n_runs": plan.n_runs})
+    return plan
 
 
 def _detect_runs_single(kind, ta, tc, pa, pc, val64, op_row,

@@ -61,7 +61,7 @@ import os
 import numpy as np
 
 from .. import obs
-from . import accounting
+from . import accounting, cross_doc
 from .map_doc import DeviceMapDoc
 from .text_doc import DeviceTextDoc
 
@@ -140,6 +140,12 @@ def assert_round_budget(stats: dict = None):
         f"stacked apply finalized {s.get('text_finalized', 0)} text docs "
         f"but seeded positions for {s.get('pos_seeded', 0)} — diff "
         "emission would fall back to per-object linearize dispatches")
+    # the index bulk-update budget (ISSUE 12): a round's minted ranges
+    # land as ONE bulk merge per doc — never one sorted insert per range
+    assert s.get("index_merges", 0) <= s.get("text_plans", 0), (
+        f"stacked apply performed {s.get('index_merges', 0)} index merges "
+        f"for {s.get('text_plans', 0)} planned text rounds (budget: one "
+        "bulk merge per doc per round)")
 
 
 def _count(stats: dict, label: str):
@@ -214,7 +220,7 @@ def _host_remap(doc, remap: np.ndarray):
         for op in ops:
             op["actor_rank"] = int(remap[op["actor_rank"]])
     if isinstance(doc, DeviceTextDoc):
-        doc.index.remap_actors(remap.astype(np.int64))
+        doc.index = doc.index.remap_actors(remap.astype(np.int64))
         if doc.seg_mirror is not None:
             doc.seg_mirror.remap_actors(remap.astype(np.int64))
     doc._invalidate()
@@ -270,11 +276,23 @@ def apply_stacked(items):
 
     # ---- decode + admission (pure: nothing committed until the GO) ----
     _t0 = obs.now() if obs.ENABLED else 0
+    decoded = [(doc, changes if hasattr(changes, "n_changes")
+                else doc._decode_wire(changes))
+               for doc, changes in items]
+    # cross-doc columnar planning (INTERNALS §16): ONE planning pass for
+    # the whole touched population — batches with identical planning
+    # columns share admission templates, run detection, and (after the
+    # interning hoist below) rank caches, instead of re-running
+    # _schedule_columnar + the detection walk per doc. None when
+    # disabled (AMTPU_CROSS_DOC_PLAN=0 keeps the per-doc path verbatim)
+    # or when no two docs share a shape.
+    cross = cross_doc.preplan(decoded)
     sched = []           # (doc, [groups per round], queue_after, n_ops)
-    for doc, changes in items:
-        batch = (changes if hasattr(changes, "n_changes")
-                 else doc._decode_wire(changes))
-        rounds, queue_after, _prior = doc._schedule(batch)
+    for doc, batch in decoded:
+        out = cross.schedule(doc, batch) if cross is not None else None
+        if out is None:
+            out = doc._schedule(batch)
+        rounds, queue_after, _prior = out
         groups = [doc._group_round(r) for r in rounds]
         n_ops = sum(b.n_ops for gs in groups for b, _r, _m in gs)
         sched.append((doc, groups, queue_after, n_ops))
@@ -299,7 +317,8 @@ def apply_stacked(items):
     stats = {"docs": len(docs), "map_docs": len(map_docs),
              "text_docs": len(text_docs), "rounds": 0, "passes": 0,
              "dispatches": 0, "syncs": 0, "h2d": 0,
-             "text_finalized": 0, "pos_seeded": 0}
+             "text_finalized": 0, "pos_seeded": 0,
+             "text_plans": 0, "index_merges": 0}
     map_set = (_LaneSet(map_docs,
                         ("value", "has_value", "win_actor", "win_seq",
                          "win_counter"), "map") if map_docs else None)
@@ -333,6 +352,12 @@ def apply_stacked(items):
                     else:
                         _host_remap(doc, remap)
                         lane.note_remap(doc, remap)
+        if cross is not None:
+            # the vectorized per-doc rank join runs AFTER the interning
+            # hoist (ranks are only defined once every batch actor is
+            # interned); the seeded caches feed every _plan_round below
+            cross.seed_ranks()
+            stats["cross_doc"] = dict(cross.stats)
         if obs.ENABLED:
             obs.span("plan", "stack", _t0, args={
                 "docs": len(docs), "map_docs": len(map_docs),
@@ -371,6 +396,9 @@ def apply_stacked(items):
                 if map_plans:
                     _exec_map_pass(map_set, map_plans, stats)
                 if text_plans:
+                    stats["text_plans"] += len(text_plans)
+                    stats["index_merges"] += sum(
+                        p.n_index_merges for _, _, p in text_plans)
                     _exec_text_pass(text_set, text_plans, stats)
                 stats["passes"] += 1
                 if obs.ENABLED:

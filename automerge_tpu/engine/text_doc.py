@@ -48,11 +48,59 @@ from .base import CausalDeviceDoc
 from .columnar import TextChangeBatch
 from .pipeline import stage_h2d
 from .runs import detect_runs
-from .host_index import (DuplicateElemId, ElemRangeIndex, pack_keys,
-                         unpack_key)
+from .host_index import (DuplicateElemId, ElemRangeIndex, new_index,
+                         pack_keys, unpack_key)
 from .segments import SegmentMirror
 
 logger = logging.getLogger("automerge_tpu.engine")
+
+
+def run_head_fields(plan, batch_rank, ta, tc, pa, pc) -> dict:
+    """Run-head planning fields that are a pure function of the (immutable)
+    op columns + one interning table: head ranks/counters, packed head
+    keys, and the parent-ref prehash. ONE implementation shared by
+    `_plan_round`'s per-(doc, batch) cache fills and the cross-doc
+    planner's rank seeding (engine/cross_doc.py), so the two paths cannot
+    drift."""
+    hpos = plan.hpos
+    head_rank = batch_rank[ta[hpos]]
+    head_ctr64 = tc[hpos].astype(np.int64)
+    p_actor = pa[hpos]
+    is_head_p = p_actor == HEAD_PARENT
+    return {
+        "head_rank": head_rank,
+        "head_ctr64": head_ctr64,
+        "head_keys": pack_keys(head_rank, head_ctr64),
+        "head_parent": (is_head_p,
+                        pack_keys(batch_rank[np.where(is_head_p, 0, p_actor)],
+                                  pc[hpos].astype(np.int64))),
+    }
+
+
+def build_desc_template(plan, tc, op_row, head_rank, row_actor_rank,
+                        row_seq, R: int, N: int) -> np.ndarray:
+    """The (9, R) run-descriptor TEMPLATE of one full round: every row
+    that is a pure function of (op columns, interning) — only the
+    head/parent SLOT rows and the base-slot meta (document state) are
+    filled per application. Shared by `_plan_round` and the cross-doc
+    planner's seeding (engine/cross_doc.py)."""
+    from ..ops.ingest import (DESC_ACTOR, DESC_CTR0, DESC_ELEM_BASE,
+                              DESC_HAS_VALUE, DESC_META, DESC_WIN_ACTOR,
+                              DESC_WIN_SEQ, META_N_ELEMS, META_N_RUNS)
+    hpos = plan.hpos
+    n_runs = plan.n_runs
+    run_len = plan.run_len
+    tmpl = np.zeros((9, R), np.int32)
+    tmpl[DESC_ELEM_BASE] = N          # padding sentinel
+    tmpl[DESC_CTR0, :n_runs] = tc[hpos]
+    tmpl[DESC_ACTOR, :n_runs] = head_rank
+    tmpl[DESC_WIN_ACTOR, :n_runs] = row_actor_rank[op_row[hpos]]
+    tmpl[DESC_WIN_SEQ, :n_runs] = row_seq[op_row[hpos]]
+    tmpl[DESC_ELEM_BASE, :n_runs] = np.cumsum(run_len) - run_len
+    tmpl[DESC_HAS_VALUE, :n_runs] = 1
+    tmpl[DESC_META, META_N_ELEMS] = plan.n_pairs
+    tmpl[DESC_META, META_N_RUNS] = n_runs
+    return tmpl
 
 
 @dataclass
@@ -79,6 +127,9 @@ class _RoundExec:
     mirror_after: Optional[SegmentMirror] = None  # host segment structure
     seg_plan: Any = None      # staged (4, S) segplan matrix (fused path)
     seg_S: int = 0            # S bucket the segplan was packed for
+    n_index_merges: int = 0   # bulk index merges this round performed
+    # (0 or 1 by construction — the cfg12t budget the stacked executor
+    # sums and asserts: one bulk merge per doc per round, never per range)
 
     @property
     def staged(self) -> list:
@@ -161,7 +212,7 @@ class DeviceTextDoc(CausalDeviceDoc):
         super().__init__(obj_id)
         self.all_ascii = True                 # every value ever set is 7-bit
         self.n_elems = 0                      # live element count (excl. head)
-        self.index = ElemRangeIndex()         # elemId -> slot (host)
+        self.index = new_index()              # elemId -> slot (host)
         # host mirror of the chain/segment structure; None = degraded (the
         # self-contained device kernels take over — see _scalars self-heal)
         self.seg_mirror = SegmentMirror.empty()
@@ -231,7 +282,9 @@ class DeviceTextDoc(CausalDeviceDoc):
             dev["actor"], dev["win_actor"], jnp.asarray(remap),
             np.int32(self.n_elems))
         dev.update(actor=actor_n, win_actor=wa_n)
-        self.index.remap_actors(remap.astype(np.int64))
+        # pure remap: the index is persistent, so outstanding snapshots
+        # (checkpoint grabs, pulls) keep the pre-remap view
+        self.index = self.index.remap_actors(remap.astype(np.int64))
         if self.seg_mirror is not None:
             # safe in place: _apply_remap invalidates, so plans derived from
             # the pre-remap mirror can no longer commit
@@ -286,6 +339,7 @@ class DeviceTextDoc(CausalDeviceDoc):
             batch_rank = rc["batch_rank"]
             row_actor_rank = rc["row_rank"]
         else:
+            _tr = obs.now() if obs.ENABLED else 0
             rank = self._actor_rank
             batch_rank = np.asarray(
                 [rank[a] for a in b.actor_table], np.int64)
@@ -295,6 +349,10 @@ class DeviceTextDoc(CausalDeviceDoc):
                   "row_rank": row_actor_rank}
             if cols is not None:
                 cols.rank_cache[self] = rc
+            if obs.ENABLED:
+                obs.span("plan", "rank_resolve", _tr, args={
+                    "doc": self.obj_id, "what": "batch_rank",
+                    "n_actors": len(b.actor_table)})
         row_seq = np.asarray(b.seqs, np.int32)
 
         # --- typing-run detection: INS immediately followed by its SET,
@@ -331,22 +389,31 @@ class DeviceTextDoc(CausalDeviceDoc):
         res_kind = kind[rpos]
 
         # --- elemId index: stage this round's minted ranges (commit later) ---
+        head_parent_pre = None
         if n_runs:
             # run-head gathers and packed keys are pure functions of the
             # (immutable) op columns + this doc's interning — cached with
-            # the rank entry so repeat applications skip them
+            # the rank entry so repeat applications skip them (the
+            # cross-doc planner seeds the same keys across the whole doc
+            # population, engine/cross_doc.py)
             if full_round and "head_keys" in rc:
                 head_keys = rc["head_keys"]
                 head_rank = rc["head_rank"]
                 head_ctr64 = rc["head_ctr64"]
+                head_parent_pre = rc["head_parent"]
             else:
-                head_rank = batch_rank[ta[hpos]]
-                head_ctr64 = tc[hpos].astype(np.int64)
-                head_keys = pack_keys(head_rank, head_ctr64)
+                _tr = obs.now() if obs.ENABLED else 0
+                hf = run_head_fields(plan, batch_rank, ta, tc, pa, pc)
+                head_keys = hf["head_keys"]
+                head_rank = hf["head_rank"]
+                head_ctr64 = hf["head_ctr64"]
+                head_parent_pre = hf["head_parent"]
                 if full_round:
-                    rc["head_keys"] = head_keys
-                    rc["head_rank"] = head_rank
-                    rc["head_ctr64"] = head_ctr64
+                    rc.update(hf)
+                if obs.ENABLED:
+                    obs.span("plan", "rank_resolve", _tr, args={
+                        "doc": self.obj_id, "what": "head_fields",
+                        "n_runs": n_runs})
             new_starts = [head_keys]
             new_lens = [run_len]
             new_slots = [plan.head_slot]
@@ -392,17 +459,10 @@ class DeviceTextDoc(CausalDeviceDoc):
                     f"in {self.obj_id}")
             return np.where(is_head, 0, slots)
 
+        _tq = obs.now() if obs.ENABLED else 0
         if n_runs:
-            pre = rc.get("head_parent") if full_round else None
-            if pre is None:
-                p_actor = pa[hpos]
-                is_head_p = p_actor == HEAD_PARENT
-                pre = (is_head_p,
-                       pack_keys(batch_rank[np.where(is_head_p, 0, p_actor)],
-                                 pc[hpos].astype(np.int64)))
-                if full_round:
-                    rc["head_parent"] = pre
-            run_parent_slot = resolve_parent(None, None, pre=pre)
+            run_parent_slot = resolve_parent(None, None,
+                                             pre=head_parent_pre)
         else:
             run_parent_slot = np.empty(0, np.int64)
 
@@ -424,6 +484,10 @@ class DeviceTextDoc(CausalDeviceDoc):
                         f"assignment to unknown element {decode(bad)} "
                         f"in {self.obj_id}")
                 res_target_slot[res_is_assign] = slots
+        if obs.ENABLED:
+            obs.span("plan", "rank_resolve", _tq, args={
+                "doc": self.obj_id, "what": "resolve_refs",
+                "n_runs": n_runs, "n_res": len(rpos)})
 
         # --- all validity checks passed: stage packed device inputs. Each
         # host->device transfer pays per-transfer latency (PCIe round trip;
@@ -583,7 +647,14 @@ class DeviceTextDoc(CausalDeviceDoc):
                             base_mirror.aux_checksum(),
                             _digest(run_parent_slot), _digest(head_rank),
                             _digest(head_ctr64))
-                mc = getattr(b, "_mirror_cache", None)
+                # the cache lives on the batch's columnar companion when
+                # one exists: the cross-doc planner shares ONE cols
+                # object across every batch of a planning group, so the
+                # whole doc population pays one mirror apply_round (the
+                # token digests every input, so a mismatched doc state
+                # degrades to a recompute, never to corruption)
+                mc_holder = cols if cols is not None else b
+                mc = getattr(mc_holder, "_mirror_cache", None)
                 if mc is not None and mc[0] == mc_token:
                     mc_entry = mc
                     mirror_after = mc[1].copy()
@@ -601,7 +672,7 @@ class DeviceTextDoc(CausalDeviceDoc):
                     mirror_after = None
                 if mc_token is not None and mirror_after is not None:
                     mc_entry = (mc_token, mirror_after.copy(), {})
-                    b._mirror_cache = mc_entry
+                    mc_holder._mirror_cache = mc_entry
 
         seg_plan_dev = None
         seg_S = 0
@@ -646,7 +717,8 @@ class DeviceTextDoc(CausalDeviceDoc):
             n_elems_dev=(jnp.asarray(np.int32(n_elems_after))
                          if staged_mode else None),
             mirror_after=mirror_after, seg_plan=seg_plan_dev, seg_S=seg_S,
-            touched_slots=touched)
+            touched_slots=touched,
+            n_index_merges=1 if new_starts else 0)
         return exec_plan, (n_elems_after, merged_index, out_cap,
                            mirror_after)
 

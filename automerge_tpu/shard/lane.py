@@ -48,7 +48,8 @@ class ShardLane:
         self.capacity = capacity
         self.stats = {"applies": 0, "stacked_applies": 0,
                       "per_object_applies": 0, "admitted_ops": 0,
-                      "docs_in": 0, "docs_out": 0}
+                      "docs_in": 0, "docs_out": 0,
+                      "cross_planned_docs": 0, "index_merges": 0}
 
     def device_ctx(self):
         """Every engine call for this lane runs inside this context, so
@@ -118,6 +119,14 @@ class ShardLane:
             st = _stacked.apply_stacked(items)
             if st:
                 self.stats["stacked_applies"] += 1
+                # cross-doc planning visibility (INTERNALS §16): how many
+                # of this lane's doc-rounds rode a shared admission
+                # template, and the bulk-merge count the budget bounds
+                cd = st.get("cross_doc")
+                if cd:
+                    self.stats["cross_planned_docs"] += cd.get(
+                        "sched_shared", 0)
+                self.stats["index_merges"] += st.get("index_merges", 0)
                 if self.assert_budget:
                     _stacked.assert_round_budget(st)
             else:

@@ -761,12 +761,19 @@ SHARDED_TIMED_REGION = (
     "wall-clock parallelism is additional upside on a real multi-chip "
     "mesh (virtual cpu devices share the host cores — SHARDING_r5 "
     "records that parallel wins are structurally unmeasurable here). "
-    "text_population is the same A/B on a text-doc population, recorded "
-    "WITHOUT a bar: text's per-round host planning (run detection, "
-    "elemId resolution) costs ~4x a map round's and is paid identically "
-    "by both configs, flooring the measurable asymmetry — the recorded "
-    "cross-doc-planning follow-up (ROADMAP), not a distribution "
-    "property; the committed number keeps the limit visible.")
+    "text_population is the same A/B on a text-doc population, carrying "
+    "an ENFORCED bar since ISSUE 12: the cross-doc planner "
+    "(engine/cross_doc.py) amortizes run detection / admission / rank "
+    "resolution across every touched doc of a lane round and the "
+    "batch-update index lands each round's ranges as one bulk merge, so "
+    "the mesh leg no longer pays the per-doc planning floor the "
+    "single-shard per-object comparator pays (directly measured 1.38x "
+    "on the mesh text leg, cross-doc on vs off, same box same day — "
+    "docs/MEASUREMENTS.md ISSUE 12). The scaleup bar is ABSOLUTE "
+    "(>= 1.8x, asserted in-run and by slo_gate) rather than relative: "
+    "the comparator leg's throughput swings with box conditions across "
+    "sessions, and the committed 3.43x 'no bar' number owed part of "
+    "its ratio to a slow comparator day.")
 
 
 def _sharded_map_round(doc_ids, seq: int, key_space: int,
@@ -997,11 +1004,12 @@ def measure_sharded(n_shards: int = None, docs_per_shard: int = 640,
             "over the doc mesh; at full scale the single-shard "
             "comparator degraded to per-object dispatch (population "
             "past one device's stacking gate) on BOTH populations "
-            "while every mesh lane stayed stacked. Acceptance bar: "
+            "while every mesh lane stayed stacked. Acceptance bars: "
             "headline (map population) aggregate >= 4x the "
-            "single-shard rate on the 8-device cpu dryrun; "
-            "text_population recorded without a bar (planning floor, "
-            "see timed_region)"),
+            "single-shard rate on the 8-device cpu dryrun; text "
+            "population aggregate >= 1.8x (the ISSUE-12 enforced bar — "
+            "asserted in-run and re-checked by slo_gate on every "
+            "committed row)"),
         "timed_region": SHARDED_TIMED_REGION,
         "n_shards": n_shards,
         "n_devices": len(devices),
@@ -1036,10 +1044,233 @@ def measure_sharded(n_shards: int = None, docs_per_shard: int = 640,
         assert scaleup >= 4.0, (
             f"aggregate mesh throughput only {scaleup:.2f}x the "
             f"single-shard row (bar: 4x): {rec}")
+        # the ISSUE-12 text bar: the row that used to record "no bar"
+        # (planning floor) is enforced now that cross-doc planning +
+        # the batch-update index lifted it
+        t_scale = text_ab["scaleup_vs_single_shard"]
+        assert t_scale >= 1.8, (
+            f"text population aggregate only {t_scale:.2f}x the "
+            f"single-shard row (bar: 1.8x): {text_ab}")
     if not quick:
         from benchmarks.common import headline_cpu_floor
         headline_cpu_floor(rec, "cfg12_" + rec["metric"])
     return rec
+
+
+TEXT_PREPARE_TIMED_REGION = (
+    "cross-doc cold text planning (engine/cross_doc.py + the batch-update "
+    "range index, INTERNALS §16): a text-doc population in the serving "
+    "regime — every doc receives one causally-ready run-shaped delivery "
+    "per round — applied through the stacked executor with the NEW "
+    "planner (AMTPU_CROSS_DOC_PLAN=1 + AMTPU_BATCH_INDEX=1) vs the "
+    "committed PR-5 per-doc planner + sorted-insert index "
+    "(AMTPU_CROSS_DOC_PLAN=0 + AMTPU_BATCH_INDEX=0). dt spans decode + "
+    "admission + host planning + lane dispatch + the stacked syncs for "
+    "all rounds of one rep (block_until_ready barrier both legs; "
+    "deliveries synthesized before the clock starts). value = admitted "
+    "wire ops/s, median of >= 5 recorded reps after untimed warmup. The "
+    "serial planning terms (detect_runs / index_merge / rank_resolve / "
+    "admission) are EXACT per-(cat, name) emit-time telemetry "
+    "aggregates, not ring-retained spans (the PR-6 span-derived-terms "
+    "contract at population scale, where the trace ring wraps), so the "
+    "win is attributable term by term: cross-doc planning fires "
+    "detect_runs once per distinct batch shape per round instead of "
+    "once per doc, and the index budget — ONE bulk merge per doc per "
+    "round, never one sorted insert per range — is asserted from the "
+    "stacked stats, not inferred.")
+
+
+def measure_text_prepare(n_docs: int = 512, n_rounds: int = 8,
+                         ops_per_doc: int = 8, reps: int = None,
+                         quick: bool = False) -> dict:
+    """cfg12t: the cold text-planning microbench (ISSUE 12).
+
+    Splits the text tier's host-planning floor into span-derived terms
+    and A/Bs the cross-doc planner + batch-update index against the
+    per-doc planner + sorted-insert comparator on the SAME pre-generated
+    population stream. Machine checks: byte-identical final text across
+    the legs, every apply stacked with its round budget asserted, and
+    the bulk-merge budget (one index merge per doc per round) checked
+    exactly."""
+    from automerge_tpu.engine import stacked as _stacked
+    from automerge_tpu.engine.text_doc import DeviceTextDoc
+
+    if quick:
+        n_docs, n_rounds = 48, 4
+    reps = max(5, bench_reps(5) if reps is None else reps) if not quick \
+        else 2
+    warmup = 1 if quick else 2
+    doc_ids = [f"tp-{i:05d}" for i in range(n_docs)]
+
+    flags = {
+        "cross_doc": {"AMTPU_CROSS_DOC_PLAN": "1", "AMTPU_BATCH_INDEX": "1"},
+        "per_doc": {"AMTPU_CROSS_DOC_PLAN": "0", "AMTPU_BATCH_INDEX": "0"},
+    }
+    term_keys = ("detect_runs", "index_merge", "rank_resolve", "admission",
+                 "cross_doc")
+
+    def span_totals():
+        tele = obs.telemetry()
+        if tele is None:
+            return {}
+        aggs = tele.span_aggregates()
+        out = {}
+        for key, agg in aggs.items():
+            cat, name = key if isinstance(key, tuple) else (None, key)
+            if cat == "plan" and name in term_keys:
+                out[name] = agg["total_ns"]
+        return out
+
+    def leg(label):
+        import gc
+        import jax as _jax
+        prior = {k: os.environ.get(k) for k in flags[label]}
+        os.environ.update(flags[label])
+        try:
+            # capacity sized to keep the whole population under ONE
+            # device's padded-stacking cell gate (n_docs x 9 x cap <=
+            # AMTPU_STACKED_MAX_CELLS) — this bench measures planning,
+            # not the fallback path
+            docs = {d: DeviceTextDoc(d, capacity=1024) for d in doc_ids}
+            seed = _sharded_text_round(doc_ids, 1, 1, 64)
+            st = _stacked.apply_stacked([(docs[k], v)
+                                         for k, v in seed.items()])
+            assert st, "seed round fell off the stacked path"
+            streams = []
+            for rep in range(warmup + reps):
+                seq0 = 2 + rep * n_rounds
+                base = 33 + (seq0 - 2) * (ops_per_doc // 2)
+                streams.append([
+                    _sharded_text_round(doc_ids, seq0 + r,
+                                        base + (ops_per_doc // 2) * r,
+                                        ops_per_doc)
+                    for r in range(n_rounds)])
+            rates = []
+            merges = plans = 0
+            t0_terms = span_totals()
+            gc_was = gc.isenabled()
+            try:
+                for rep, rounds in enumerate(streams):
+                    gc.collect()
+                    gc.disable()
+                    admitted = 0
+                    t0 = time.perf_counter()
+                    for chunk in rounds:
+                        items = [(docs[k], v) for k, v in chunk.items()]
+                        st = _stacked.apply_stacked(items)
+                        assert st, "round fell off the stacked path"
+                        _stacked.assert_round_budget(st)
+                        merges += st["index_merges"]
+                        plans += st["text_plans"]
+                        admitted += sum(len(c["ops"]) for v in
+                                        chunk.values() for c in v)
+                    _jax.block_until_ready(
+                        [arr for d in docs.values()
+                         for arr in d._ensure_dev().values()])
+                    dt = time.perf_counter() - t0
+                    if gc_was:
+                        gc.enable()
+                    rates.append(admitted / dt)
+            finally:
+                if gc_was:
+                    gc.enable()
+            terms = {k: round((v - t0_terms.get(k, 0)) / 1e9, 4)
+                     for k, v in span_totals().items()}
+            for k in term_keys:
+                terms.setdefault(k, 0.0)
+            texts = {k: d.text() for k, d in docs.items()}
+            return {
+                "ops_per_sec": round(_median(rates[warmup:])),
+                "reps_ops_per_sec": [round(r) for r in rates[warmup:]],
+                "value_spread_pct": round(_spread_pct(rates[warmup:]), 1),
+                "plan_terms_s": terms,
+                "index_merges": merges,
+                "text_plans": plans,
+                "cross_doc": (st or {}).get("cross_doc"),
+            }, texts
+        finally:
+            for k, v in prior.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    was_enabled = obs.ENABLED
+    if not was_enabled:
+        obs.enable()
+    try:
+        new, texts_new = leg("cross_doc")
+        legacy, texts_old = leg("per_doc")
+    finally:
+        if not was_enabled:
+            obs.disable()
+    assert texts_new == texts_old, \
+        "cross-doc planner diverged from the per-doc comparator"
+    # the index bulk-update budget, checked EXACTLY: one merge per
+    # planned text round (never one sorted insert per range)
+    assert new["index_merges"] == new["text_plans"], new
+    assert new["cross_doc"] and new["cross_doc"]["sched_shared"] > 0, (
+        "cross-doc planner never shared a schedule", new)
+
+    import jax as _jax
+    from datetime import datetime, timezone
+    platform = _jax.devices()[0].platform
+    speedup = round(new["ops_per_sec"] / max(legacy["ops_per_sec"], 1), 3)
+    rec = {
+        "metric": "cfg12t_text_cold_prepare_ops_per_sec",
+        "value": new["ops_per_sec"],
+        "unit": "ops/s",
+        "threshold": (
+            "asserted in code: byte-identical final text across the "
+            "planner A/B; every apply stacked within the round budget; "
+            "index_merges == planned text rounds (one bulk merge per doc "
+            "per round) — enforced again by the slo_gate rule "
+            "index_merges_per_doc_round <= 1 on this committed row; "
+            "value >= 0.8x prior committed row (slo_gate relative "
+            "floor)"),
+        "timed_region": TEXT_PREPARE_TIMED_REGION,
+        "n_docs": n_docs,
+        "n_rounds_per_rep": n_rounds,
+        "ops_per_doc_per_round": ops_per_doc,
+        "n_reps": reps,
+        "warmup_reps": warmup,
+        "reps_ops_per_sec": new["reps_ops_per_sec"],
+        "value_spread_pct": new["value_spread_pct"],
+        "per_doc_ops_per_sec": legacy["ops_per_sec"],
+        "per_doc_reps": legacy["reps_ops_per_sec"],
+        "speedup_vs_per_doc": speedup,
+        "plan_terms_s": new["plan_terms_s"],
+        "per_doc_plan_terms_s": legacy["plan_terms_s"],
+        "index_merges": new["index_merges"],
+        "text_plans": new["text_plans"],
+        "index_merges_per_doc_round": round(
+            new["index_merges"] / max(new["text_plans"], 1), 4),
+        "cross_doc": new["cross_doc"],
+        "platform": platform,
+        "recorded_at_utc": datetime.now(timezone.utc).isoformat(),
+    }
+    assert rec["value"] == round(_median(rec["reps_ops_per_sec"])), rec
+    return rec
+
+
+def main_text_prepare():
+    """`bench.py --text-prepare`: the cfg12t cold-planning entry point
+    (append to the committed session log with ``--session``)."""
+    from benchmarks.common import preflight_device
+    budget = float(os.environ.get("AMTPU_PREFLIGHT_BUDGET_S", "420"))
+    if not preflight_device(total_budget_s=budget, allow_cpu=True):
+        print("bench.py --text-prepare: no reachable jax device — "
+              "refusing to hang", file=sys.stderr)
+        return 3
+    if trace_requested():
+        obs.enable()
+    rec = measure_text_prepare(quick="--quick" in sys.argv)
+    if trace_requested():
+        write_bench_trace(rec)
+    print(json.dumps(rec))
+    if is_chip_platform(rec["platform"]) or "--session" in sys.argv:
+        append_session_log(rec)
+    return 0
 
 
 def main_sharded():
@@ -1282,6 +1513,8 @@ if __name__ == "__main__":
     # mode has no reduced shape, and `--quick --trace` needs one
     if "--sharded" in sys.argv:
         sys.exit(main_sharded())
+    if "--text-prepare" in sys.argv:
+        sys.exit(main_text_prepare())
     sys.exit(main_pipeline()
              if ("--pipeline" in sys.argv or "--quick" in sys.argv)
              else main())

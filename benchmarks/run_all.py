@@ -478,6 +478,45 @@ def config12_sharded(quick: bool = False, record_session: bool = False):
               f"--session (platform {rec['platform']})", file=sys.stderr)
 
 
+def config12t_text_prepare(quick: bool = False,
+                           record_session: bool = False):
+    """Cross-doc cold text planning (ISSUE 12, INTERNALS §16): the
+    cfg12t microbench — span-derived detect_runs / index_merge /
+    rank_resolve terms A/B'd against the per-doc planner + sorted-insert
+    index, with the bulk-merge budget asserted inside the measurement.
+    Subprocess for the same reason as cfg12 (a clean obs/jax state; with
+    ``--session`` the row appends itself to BENCH_SESSIONS.jsonl)."""
+    import os
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "AMTPU_SKIP_PREFLIGHT": "1"}
+    cmd = [sys.executable, os.path.join(root, "bench.py"),
+           "--text-prepare"]
+    if quick:
+        cmd.append("--quick")
+    if record_session:
+        cmd.append("--session")
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=root,
+                         env=env, timeout=1200)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"cfg12t text-prepare bench failed rc={out.returncode}: "
+            f"{out.stderr[-800:]}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    emit("cfg12t_text_cold_prepare_ops_per_sec", rec["value"], "ops/s",
+         n_docs=rec["n_docs"],
+         per_doc_ops_per_sec=rec["per_doc_ops_per_sec"],
+         speedup_vs_per_doc=rec["speedup_vs_per_doc"],
+         value_spread_pct=rec["value_spread_pct"],
+         plan_terms_s=rec["plan_terms_s"],
+         per_doc_plan_terms_s=rec["per_doc_plan_terms_s"],
+         index_merges_per_doc_round=rec["index_merges_per_doc_round"],
+         cross_doc=rec["cross_doc"],
+         measured_platform=rec["platform"],
+         threshold=rec["threshold"])
+
+
 def config5b_residual_heavy(n_actors: int = 10_000, quick: bool = False):
     """Adversarial headline shape: 20% of ops are RESIDUALS (bare deletes
     of distinct base elements + bare inserts without values) that cannot
@@ -1204,6 +1243,10 @@ def main():
         # BENCH_SESSIONS.jsonl (the acceptance bar is defined there)
         config12_sharded(quick=quick, record_session=True)
         return
+    if "--text-prepare-session" in sys.argv:
+        # the chip_session.sh cfg12t step: ONLY the cold-planning row
+        config12t_text_prepare(quick=quick, record_session=True)
+        return
     record_round = None
     record_path = None
     if "--record" in sys.argv:
@@ -1287,6 +1330,7 @@ def main():
         lambda: config10_save_load(n_changes=15 if quick else 40),
         lambda: config11_service(quick=quick),
         lambda: config12_sharded(quick=quick),
+        lambda: config12t_text_prepare(quick=quick),
     ]
     if record_path is not None:
         steps.insert(0, fold_headline)

@@ -42,6 +42,16 @@ SLOS = [
     ("cfg11_service", "shed_rate", "max", 2.0),
     ("cfg12_sharded", "value", "min", 0.8),
     ("cfg12_sharded", "scaleup_vs_single_shard", "min", 0.9),
+    # ISSUE 12: the text population graduates from tracking-only to an
+    # enforced row — relative floor on its aggregate mesh throughput,
+    # plus the cold-planning microbench's own throughput floor. The
+    # scaleup RATIO gets an absolute bar below, not a relative one: its
+    # denominator (the per-object single-shard leg) swings with box
+    # conditions across sessions (docs/MEASUREMENTS.md ISSUE 12), so a
+    # ratio-vs-prior rule would page on comparator weather
+    ("cfg12_sharded", "text_population.aggregate_ops_per_sec",
+     "min", 0.8),
+    ("cfg12t_text_cold_prepare", "value", "min", 0.8),
 ]
 
 #: Absolute SLOs: (metric_prefix, dotted field, op, bound) checked on
@@ -57,6 +67,14 @@ ABS_SLOS = [
     ("cfg12_sharded", "collective_ops_total", "<=", 0),
     # the ISSUE-10 acceptance bar on the committed dryrun rows
     ("cfg12_sharded", "scaleup_vs_single_shard", ">=", 4.0),
+    # the ISSUE-12 text bar: the row that used to carry "no bar"
+    # (median-of-5 measured 2.27x with the planning floor lifted; bar
+    # set with ~25% margin for the text mesh leg's rep spread)
+    ("cfg12_sharded", "text_population.scaleup_vs_single_shard",
+     ">=", 1.8),
+    # the ISSUE-12 bulk-update budget on the committed cfg12t row: one
+    # index merge per doc per round, never one sorted insert per range
+    ("cfg12t_text_cold_prepare", "index_merges_per_doc_round", "<=", 1),
 ]
 
 #: Derived fields computable from any row that carries the inputs.

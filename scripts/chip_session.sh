@@ -120,6 +120,10 @@ run "cfg11_service" 900 python -m benchmarks.run_all --service-session
 # run bench.py --sharded directly against the hardware mesh
 run "sharded_soak"  900 python scripts/soak.py --sharded --sessions 4
 run "cfg12_sharded" 1800 python -m benchmarks.run_all --sharded-session
+# cross-doc cold text planning (ISSUE 12): the cfg12t A/B row — the
+# span-derived detect_runs/index_merge/rank_resolve terms on the chip
+# host, budget-asserted inside the measurement
+run "cfg12t_text_prepare" 1200 python -m benchmarks.run_all --text-prepare-session
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu

@@ -1,0 +1,261 @@
+"""Batch-update range index vs the sorted-insert legacy (INTERNALS §16.2).
+
+The tiered :class:`BatchRangeIndex` (AMTPU_BATCH_INDEX default) must be
+indistinguishable from the legacy :class:`SortedInsertIndex` on every
+read — lookups, reverse lookups, the flattened checkpoint rows — over
+randomized interleaved merge histories, and must additionally deliver
+the persistence contract the legacy array never promised: a snapshot
+taken with ZERO coordination while another thread bulk-merges can never
+observe a torn state. Both are pinned here."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from automerge_tpu.engine import host_index as H
+
+
+# ---------------------------------------------------------------------------
+# randomized merge-history generation
+# ---------------------------------------------------------------------------
+
+
+def rand_merge_history(seed, n_merges=60, n_actors=5, max_ranges=16):
+    """A sequence of non-overlapping bulk merges (ranges keyed like the
+    engine's: packed (actor_rank << 32 | ctr)), plus the key->slot truth
+    table."""
+    rng = np.random.default_rng(seed)
+    taken = {}
+    slot = 1
+    merges = []
+    for _ in range(n_merges):
+        starts, lens, slots = [], [], []
+        for _ in range(int(rng.integers(1, max_ranges))):
+            a = int(rng.integers(0, n_actors))
+            c = int(rng.integers(0, 10 ** 6))
+            length = int(rng.integers(1, 40))
+            key = (a << 32) | c
+            if any(key < k + l and k < key + length
+                   for k, l in taken.items()):
+                continue
+            if any(s < key + length and key < s + l
+                   for s, l in zip(starts, lens)):
+                continue
+            starts.append(key)
+            lens.append(length)
+            slots.append(slot)
+            slot += length
+            taken[key] = length
+        if starts:
+            merges.append((np.asarray(starts, np.int64),
+                           np.asarray(lens, np.int64),
+                           np.asarray(slots, np.int64)))
+    return merges, taken
+
+
+def replay(cls, merges):
+    idx = cls()
+    for s, l, sl in merges:
+        idx = idx.merge(s, l, sl)
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# read parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_read_parity_random_histories(seed):
+    merges, taken = rand_merge_history(seed)
+    legacy = replay(H.SortedInsertIndex, merges)
+    batch = replay(H.BatchRangeIndex, merges)
+
+    # flattened rows byte-identical (the checkpoint bundle contract)
+    for a, b in zip(legacy.rows(), batch.rows()):
+        assert np.array_equal(a, b)
+
+    # every inserted key (range starts, interiors, ends) resolves equally
+    keys = []
+    for k, l in taken.items():
+        keys += [k, k + l - 1, k + l // 2]
+    keys = np.asarray(sorted(set(keys)), np.int64)
+    sa, fa = legacy.lookup(keys)
+    sb, fb = batch.lookup(keys)
+    assert fa.all() and np.array_equal(sa, sb) and np.array_equal(fa, fb)
+
+    # misses resolve equally (just-outside probes)
+    misses = np.asarray([k + l for k, l in taken.items()
+                         if (k + l) not in taken], np.int64)
+    _, fa = legacy.lookup(misses)
+    _, fb = batch.lookup(misses)
+    assert np.array_equal(fa, fb)
+
+    # reverse lookup parity over every live slot
+    slots = np.concatenate([np.arange(s, s + l) for (k, l), s in
+                            zip(taken.items(), _slots_of(legacy, taken))])
+    ra = np.stack(legacy.slot_to_key(slots))
+    rb = np.stack(batch.slot_to_key(slots))
+    assert np.array_equal(ra, rb)
+
+
+def _slots_of(idx, taken):
+    keys = np.asarray(list(taken), np.int64)
+    s, f = idx.lookup(keys)
+    assert f.all()
+    return s.tolist()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_remap_parity(seed):
+    merges, taken = rand_merge_history(seed, n_merges=30)
+    legacy = replay(H.SortedInsertIndex, merges)
+    batch = replay(H.BatchRangeIndex, merges)
+    rng = np.random.default_rng(seed + 99)
+    remap = rng.permutation(8).astype(np.int64)
+    l2 = legacy.remap_actors(remap)
+    b2 = batch.remap_actors(remap)
+    # pure: the originals (and any snapshot of them) are untouched
+    for a, b in zip(legacy.rows(), batch.rows()):
+        assert np.array_equal(a, b)
+    keys = np.asarray(sorted(taken), np.int64)
+    keys2 = (remap[keys >> 32] << np.int64(32)) | (keys & 0xFFFFFFFF)
+    sa, fa = l2.lookup(keys2)
+    sb, fb = b2.lookup(keys2)
+    assert fa.all() and fb.all() and np.array_equal(sa, sb)
+
+
+def test_duplicate_raises_same_key_both_structures():
+    merges, taken = rand_merge_history(3, n_merges=10)
+    legacy = replay(H.SortedInsertIndex, merges)
+    batch = replay(H.BatchRangeIndex, merges)
+    key = sorted(taken)[len(taken) // 2]
+    for idx in (legacy, batch):
+        with pytest.raises(H.DuplicateElemId) as ei:
+            idx.merge(np.asarray([key], np.int64),
+                      np.asarray([1], np.int64),
+                      np.asarray([10 ** 6], np.int64))
+        assert ei.value.key == key
+    # overlap WITHIN one merge call raises too
+    for idx in (H.SortedInsertIndex(), H.BatchRangeIndex()):
+        with pytest.raises(H.DuplicateElemId):
+            idx.merge(np.asarray([10, 12], np.int64),
+                      np.asarray([5, 5], np.int64),
+                      np.asarray([1, 6], np.int64))
+
+
+def test_flag_selects_structure(monkeypatch):
+    monkeypatch.setenv("AMTPU_BATCH_INDEX", "0")
+    assert isinstance(H.new_index(), H.SortedInsertIndex)
+    monkeypatch.setenv("AMTPU_BATCH_INDEX", "1")
+    assert isinstance(H.new_index(), H.BatchRangeIndex)
+    idx = H.index_from_rows(np.asarray([8], np.int64),
+                            np.asarray([2], np.int64),
+                            np.asarray([1], np.int64))
+    s, f = idx.lookup(np.asarray([8, 9, 10], np.int64))
+    assert f.tolist() == [True, True, False]
+    assert s[:2].tolist() == [1, 2]
+
+
+def test_merge_accounting_one_bulk_update_per_round():
+    before = H.merge_stats_snapshot()
+    idx = H.new_index()
+    for r in range(5):
+        base = r * 100
+        idx = idx.merge(
+            np.asarray([base + i * 10 for i in range(4)], np.int64),
+            np.full(4, 3, np.int64),
+            np.asarray([1 + r * 12 + i * 3 for i in range(4)], np.int64))
+    after = H.merge_stats_snapshot()
+    assert after["bulk_merges"] - before["bulk_merges"] == 5
+    assert after["ranges_inserted"] - before["ranges_inserted"] == 20
+
+
+# ---------------------------------------------------------------------------
+# zero-coordination snapshots under concurrent bulk merges (8 threads)
+# ---------------------------------------------------------------------------
+
+
+def _validate_snapshot(idx):
+    """A snapshot must be internally consistent: sorted disjoint rows,
+    every row resolvable at its start/end, reverse lookup closing the
+    loop."""
+    starts, lens, slots = idx.rows()
+    if not len(starts):
+        return 0
+    assert (np.diff(starts) > 0).all()
+    assert ((starts + lens)[:-1] <= starts[1:]).all()
+    probes = np.concatenate([starts, starts + lens - 1])
+    got, found = idx.lookup(probes)
+    assert found.all()
+    n = len(starts)
+    assert np.array_equal(got[:n], slots)
+    assert np.array_equal(got[n:], slots + lens - 1)
+    a, c = idx.slot_to_key(slots)
+    assert np.array_equal((a << np.int64(32)) | c, starts)
+    return int(lens.sum())
+
+
+@pytest.mark.parametrize("structure", ["batch", "legacy"])
+def test_snapshot_never_observes_torn_merge_8_threads(structure):
+    """One writer bulk-merging (single ranges and multi-range splits
+    interleaved), seven readers snapshotting with zero coordination:
+    every observed snapshot is a fully consistent prior version, and the
+    observed element count never goes backwards for any single reader
+    (persistence = monotone publication)."""
+    cls = (H.BatchRangeIndex if structure == "batch"
+           else H.SortedInsertIndex)
+    holder = {"idx": cls()}
+    stop = threading.Event()
+    failures = []
+    merges, _ = rand_merge_history(11, n_merges=300, max_ranges=8)
+
+    def writer():
+        try:
+            idx = holder["idx"]
+            for s, l, sl in merges:
+                idx = idx.merge(s, l, sl)
+                holder["idx"] = idx       # atomic publish (rebind)
+        except Exception as exc:          # pragma: no cover
+            failures.append(exc)
+        finally:
+            stop.set()
+
+    def reader():
+        last = 0
+        try:
+            while not stop.is_set() or last == 0:
+                snap = holder["idx"].snapshot()
+                total = _validate_snapshot(snap)
+                assert total >= last, "snapshot went backwards"
+                last = total
+                if stop.is_set():
+                    break
+        except Exception as exc:          # pragma: no cover
+            failures.append(exc)
+
+    threads = [threading.Thread(target=writer)] + \
+        [threading.Thread(target=reader) for _ in range(7)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not failures, failures
+    final = _validate_snapshot(holder["idx"])
+    assert final == sum(int(l.sum()) for _, l, _ in merges)
+
+
+def test_compaction_bounds_tier_count():
+    idx = H.BatchRangeIndex()
+    key = 1
+    slot = 1
+    for r in range(500):
+        idx = idx.merge(np.asarray([key], np.int64),
+                        np.asarray([2], np.int64),
+                        np.asarray([slot], np.int64))
+        key += 3                          # never coalescible
+        slot += 2
+        assert len(idx._runs) <= idx._COMPACT_TIERS
+    assert idx.n_ranges == 500
+    _validate_snapshot(idx)

@@ -484,3 +484,93 @@ def test_bench_restore_metrics_small_scale():
     assert rec["restore_bundle_bytes"] > 0
     # no speed assertion at toy scale — the 1M-doc ratio is pinned by the
     # bench record (docs/MEASUREMENTS.md); this pins shape + equivalence
+
+
+def test_grab_mid_mutation_serves_commit_boundary_snapshot():
+    """ISSUE 12: a grab observing a mutation in flight no longer climbs
+    the busy-wait/retry ladder — it reads the doc's cached
+    commit-boundary snapshot with zero coordination (CaptureConflict is
+    kept only for donated buffers / the cold first-grab race)."""
+    doc, _ = _engine_text_doc(200)
+    bytes0 = AsyncCheckpointer.capture(doc)   # caches the snapshot
+    doc._busy = 1                             # a bulk index merge mid-flight
+    try:
+        with AsyncCheckpointer(max_grab_retries=2) as w:
+            h = w.capture_async(doc)
+            data = h.result(30)
+            assert w.stats["snapshot_serves"] == 1
+            assert w.stats["sync_fallbacks"] == 0
+            assert w.stats["grab_conflicts"] == 0
+    finally:
+        doc._busy = 0
+    assert data == bytes0                     # the commit-boundary state
+
+
+def test_grab_racing_bulk_index_merge_is_consistent_prefix():
+    """Async grabs racing a thread of real applies (each holding _busy
+    across its bulk index merge): every capture restores to SOME
+    consistent prefix — replaying the full stream on top converges it to
+    the final document byte-for-byte."""
+    import threading
+    import time
+
+    import bench
+    from automerge_tpu.engine import DeviceTextDoc
+
+    n = 2000
+    doc = DeviceTextDoc("r")
+    base = bench.base_batch("r", n)
+    doc.apply_batch(base)
+    batches = [bench.merge_batch("r", 8, 40, n, seed=s, actor_prefix=p)
+               for s, p in ((1, "a"), (2, "b"), (3, "c"), (4, "d"))]
+    captures = []
+    # seed the snapshot cache SYNCHRONOUSLY before the mutator starts:
+    # an async seed could lose the race and hit the cold-first-grab
+    # CaptureConflict path this test deliberately excludes
+    seed = AsyncCheckpointer.capture(doc)
+    with AsyncCheckpointer() as w:
+        handles = []
+        done = threading.Event()
+
+        def mutate():
+            for b in batches:
+                doc.apply_batch(b)
+            done.set()
+
+        t = threading.Thread(target=mutate)
+        t.start()
+        while not done.is_set() and len(handles) < 12:
+            handles.append(w.capture_async(doc))
+            time.sleep(0.01)
+        t.join(60)
+        captures = [seed] + [h.result(60) for h in handles]
+        assert w.stats["grab_conflicts"] == 0, w.stats
+    final = doc.text()
+    for data in captures:
+        restored = restore_engine(data)
+        for b in batches:
+            restored.apply_batch(b)
+        assert restored.text() == final
+
+
+def test_snapshot_not_served_for_donation_enabled_doc():
+    """Review regression (ISSUE 12): a cached commit-boundary snapshot
+    must NOT be served once the doc enters donated-buffer mode — donated
+    commits consume the snapshot's table buffers in place, so the busy
+    path falls back to CaptureConflict exactly as pre-snapshot."""
+    from automerge_tpu.checkpoint.engine_codec import CaptureConflict, grab
+
+    doc, _ = _engine_text_doc(200)
+    AsyncCheckpointer.capture(doc)          # caches the snapshot
+    doc.donate_buffers = True
+    try:
+        with pytest.raises(CaptureConflict):
+            grab(doc)                       # deferred grab refuses outright
+        doc._busy = 1
+        with pytest.raises(CaptureConflict):
+            grab(doc, inline=True)          # busy + donated: no stale serve
+    finally:
+        doc._busy = 0
+        doc.donate_buffers = False
+    # donation off again and quiescent: live grabs resume
+    assert grab(doc)["mode"] == "live"

@@ -432,3 +432,119 @@ def test_apply_changes_routes_through_boundary_decoder():
     walk.apply_batch(TextChangeBatch.from_changes(changes, "t",
                                                   _try_native=False))
     assert doc.text() == walk.text()
+
+
+# ---------------------------------------------------------------------------
+# cross-doc planner parity (INTERNALS §16)
+# ---------------------------------------------------------------------------
+
+
+def _rewrite_obj(changes, obj):
+    """The same wire stream retargeted at another object — the cross-doc
+    grouping shape (identical planning columns, different obj id)."""
+    return [{**c, "ops": [{**op, "obj": obj} for op in c["ops"]]}
+            for c in changes]
+
+
+def _population_state(docs):
+    out = {}
+    for k, doc in docs.items():
+        st = engine_state(doc)
+        st["index_rows"] = tuple(r.tobytes() for r in doc.index.rows())
+        out[k] = st
+    return out
+
+
+def _run_population(seed, cross, columnar, monkeypatch, batch_index="1",
+                    n_docs=6, n_chunks=4):
+    """Deliver one randomized stream (out-of-order, dups, premature) to a
+    doc population in chunks through the stacked executor — the lane
+    shape — under the given planner/index flags; returns final state."""
+    from automerge_tpu.engine import stacked as S
+    monkeypatch.setenv("AMTPU_CROSS_DOC_PLAN", cross)
+    monkeypatch.setenv("AMTPU_COLUMNAR_PLAN", columnar)
+    monkeypatch.setenv("AMTPU_BATCH_INDEX", batch_index)
+    rng = random.Random(seed * 13 + 5)
+    docs = {f"d{i}": DeviceTextDoc(f"d{i}") for i in range(n_docs)}
+    # one shared stream for the population + one divergent doc (its own
+    # stream: a group of one, exercising the fallback path)
+    shared = rand_text_changes(random.Random(seed), n_changes=20, obj="X")
+    lone = rand_text_changes(random.Random(seed + 77), n_changes=12,
+                             obj="X")
+    cuts = sorted(rng.sample(range(1, len(shared)), n_chunks - 1))
+    chunks = [shared[a:b] for a, b in
+              zip([0] + cuts, cuts + [len(shared)])]
+    lone_cuts = [len(lone) * (i + 1) // n_chunks for i in range(n_chunks)]
+    lone_chunks = [lone[a:b] for a, b in
+                   zip([0] + lone_cuts[:-1], lone_cuts)]
+    for chunk, lchunk in zip(chunks, lone_chunks):
+        items = [(doc, _rewrite_obj(chunk, k))
+                 for k, doc in docs.items() if k != "d0"]
+        if lchunk:
+            items.append((docs["d0"], _rewrite_obj(lchunk, "d0")))
+        st = S.apply_stacked(items)
+        if not st:
+            for doc, changes in items:
+                doc.apply_changes(changes)
+        else:
+            S.assert_round_budget(st)
+    return _population_state(docs)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_cross_doc_planner_parity(seed, monkeypatch):
+    """Committed state of a whole doc population is byte-identical with
+    the cross-doc planner on vs off, under BOTH AMTPU_COLUMNAR_PLAN
+    values and both index structures, over out-of-order/dup/premature
+    chunked deliveries (the randomized parity bar of ISSUE 12)."""
+    ref = _run_population(seed, "0", "1", monkeypatch)
+    for cross, columnar, bidx in (("1", "1", "1"), ("1", "1", "0"),
+                                  ("1", "0", "1"), ("0", "0", "1")):
+        got = _run_population(seed, cross, columnar, monkeypatch,
+                              batch_index=bidx)
+        assert got == ref, (cross, columnar, bidx)
+
+
+def test_cross_doc_planner_shares_and_stays_identical(monkeypatch):
+    """The uniform-population shape actually SHARES (schedules, run
+    detection, rank seeds — the stats prove the pass ran once), and the
+    shared plan commits the same bytes as the per-doc planner."""
+    from automerge_tpu.engine import stacked as S
+    monkeypatch.setenv("AMTPU_COLUMNAR_PLAN", "1")
+
+    def build(cross):
+        monkeypatch.setenv("AMTPU_CROSS_DOC_PLAN", cross)
+        docs = {f"t{i}": DeviceTextDoc(f"t{i}") for i in range(8)}
+        stats = []
+        for rnd in range(3):
+            base = 1 + rnd * 8
+            key = "_head" if rnd == 0 else f"a:{base - 1}"
+            ops = []
+            k = key
+            for j in range(8):
+                ops.append({"action": "ins", "obj": "X", "key": k,
+                            "elem": base + j})
+                ops.append({"action": "set", "obj": "X",
+                            "key": f"a:{base + j}",
+                            "value": chr(97 + (base + j) % 26)})
+                k = f"a:{base + j}"
+            chunk = [{"actor": "a", "seq": rnd + 1, "deps": {},
+                      "ops": ops}]
+            items = [(doc, _rewrite_obj(chunk, kk))
+                     for kk, doc in docs.items()]
+            st = S.apply_stacked(items)
+            assert st, "population fell off the stacked path"
+            S.assert_round_budget(st)
+            stats.append(st)
+        return docs, stats
+
+    docs_on, stats_on = build("1")
+    docs_off, _ = build("0")
+    cd = stats_on[-1]["cross_doc"]
+    assert cd["groups"] == 1 and cd["docs"] == 8
+    assert cd["sched_shared"] == 7 and cd["sched_templated"] == 1
+    assert cd["rank_seeded"] == 8
+    # one bulk index merge per doc per round, never per range
+    assert stats_on[-1]["index_merges"] == stats_on[-1]["text_plans"] == 8
+    for k in docs_on:
+        assert engine_state(docs_on[k]) == engine_state(docs_off[k])

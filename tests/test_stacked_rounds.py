@@ -309,3 +309,71 @@ def test_per_object_comparator_unchanged(monkeypatch):
     stacked.LAST_STATS.clear()
     am.apply_changes(base, changes)
     assert not stacked.LAST_STATS
+
+
+# ---------------------------------------------------------------------------
+# cross-doc planning through the stacked executor (INTERNALS §16)
+# ---------------------------------------------------------------------------
+
+
+def test_stacked_stats_carry_index_merge_budget(monkeypatch):
+    """Every stacked apply's stats carry the ISSUE-12 bulk-update
+    accounting (index_merges <= planned text rounds), and the budget
+    assert rejects a violated count."""
+    import pytest
+
+    from automerge_tpu.engine.text_doc import DeviceTextDoc
+
+    monkeypatch.setenv("AMTPU_CROSS_DOC_PLAN", "1")
+    docs = {f"b{i}": DeviceTextDoc(f"b{i}") for i in range(4)}
+    items = []
+    for k, doc in docs.items():
+        ops = []
+        key = "_head"
+        for j in range(1, 9):
+            ops.append({"action": "ins", "obj": k, "key": key, "elem": j})
+            ops.append({"action": "set", "obj": k, "key": f"a:{j}",
+                        "value": chr(97 + j)})
+            key = f"a:{j}"
+        items.append((doc, [{"actor": "a", "seq": 1, "deps": {},
+                             "ops": ops}]))
+    st = stacked.apply_stacked(items)
+    assert st
+    assert st["index_merges"] == st["text_plans"] == 4
+    assert st["cross_doc"]["sched_shared"] == 3
+    stacked.assert_round_budget(st)
+    bad = {**st, "index_merges": st["text_plans"] + 1}
+    with pytest.raises(AssertionError, match="bulk merge per doc"):
+        stacked.assert_round_budget(bad)
+
+
+def test_cross_doc_disabled_keeps_per_doc_path(monkeypatch):
+    """AMTPU_CROSS_DOC_PLAN=0: the stacked apply carries no cross_doc
+    stats and still commits the identical state (the comparator
+    contract the randomized suites pin at population scale)."""
+    from automerge_tpu.engine.text_doc import DeviceTextDoc
+
+    def build(flag):
+        monkeypatch.setenv("AMTPU_CROSS_DOC_PLAN", flag)
+        docs = {f"c{i}": DeviceTextDoc(f"c{i}") for i in range(3)}
+        items = []
+        for k, doc in docs.items():
+            ops = []
+            key = "_head"
+            for j in range(1, 7):
+                ops.append({"action": "ins", "obj": k, "key": key,
+                            "elem": j})
+                ops.append({"action": "set", "obj": k, "key": f"a:{j}",
+                            "value": chr(110 + j)})
+                key = f"a:{j}"
+            items.append((doc, [{"actor": "a", "seq": 1, "deps": {},
+                                 "ops": ops}]))
+        st = stacked.apply_stacked(items)
+        assert st
+        return docs, st
+
+    docs_on, st_on = build("1")
+    docs_off, st_off = build("0")
+    assert "cross_doc" in st_on and "cross_doc" not in st_off
+    for k in docs_on:
+        assert docs_on[k].text() == docs_off[k].text()
