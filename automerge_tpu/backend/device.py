@@ -359,8 +359,20 @@ class _DeviceCore:
         the engine's concurrency resolution (covering checks, add-wins,
         RGA sibling ordering) is trivially vacuous. Any other remote
         delivery takes the engine."""
+        frame = None
+        if hasattr(changes, "batch") and hasattr(changes, "n_ops"):
+            # a decoded binary wire delivery (engine/wire_format.py):
+            # admission/history run on its canonical dict view; the
+            # decoded batch rides through to the engine when the whole
+            # frame admits cleanly (_distribute_frame)
+            frame = changes
+            changes = frame.changes()
         changes = [_clean(c) for c in changes]
-        if len(changes) == 1 and not self.queue:
+        # frames are bulk by construction (the encode-side min-ops gate):
+        # the interactive write-behind overlay would just defer a dict
+        # window decode to flush_pending — the decoded batch is already
+        # in hand, so frames go straight to the engine
+        if frame is None and len(changes) == 1 and not self.queue:
             if is_local:
                 fast = self._try_fast_local(changes[0], undoable)
             else:
@@ -371,6 +383,7 @@ class _DeviceCore:
         # rounds into the engine so device state is current again
         self.flush_pending()
         local = changes[0] if (undoable and changes) else None
+        queued_before = bool(self.queue)
         self.queue.extend(changes)
         applied: list = []
         creations: dict = {}                 # (actor, seq) -> clock before
@@ -389,8 +402,64 @@ class _DeviceCore:
                 break
         if local is not None and local in applied:
             self._push_undo(self._capture_inverse(local))
+        if frame is not None and not queued_before and not self.queue \
+                and len(applied) == frame.n_changes:
+            # whole-frame admission (no prior queue, no leftovers, no
+            # duplicates): hand the decoded batch straight to the target
+            # engine doc — the zero-copy ingest lane (INTERNALS §17)
+            out = self._distribute_frame(applied, frame)
+            if out is not None:
+                touched, created = out
+                return self._emit_diffs(touched, created)
         touched, created = self._distribute(applied, creations)
         return self._emit_diffs(touched, created)
+
+    def _distribute_frame(self, applied, frame):
+        """Feed a one-object binary-frame delivery to its engine doc as
+        the decoded columnar batch: no window dicts, no per-op routing
+        walk, no re-decode — ``prepare_batch`` consumes the frame's
+        zero-copy views directly (and the stacked/cross-doc tiers see
+        the batch through the same ``apply_batch`` seam). Returns None
+        when the frame's object kind does not match the wrapper (the
+        caller falls back to the generic routed walk, which materializes
+        windows and preserves exact parity)."""
+        obj = frame.obj_id
+        wrapper = self.root if obj == ROOT_ID else self.objects.get(obj)
+        if wrapper is None:
+            # same failure as the routing walk's use-before-make branch
+            raise ValueError(f"Modification of unknown object {obj}")
+        batch = frame.batch()
+        is_text_frame = hasattr(batch, "op_target_actor")
+        if is_text_frame != isinstance(wrapper, _TextObj):
+            return None
+        wrapper.ov = None
+        if is_text_frame:
+            from .._common import KIND_INS
+            ins = batch.op_kind == KIND_INS
+            if bool(ins.any()):
+                wrapper.max_elem = max(
+                    wrapper.max_elem, int(batch.op_target_ctr[ins].max()))
+        wrapper.doc.apply_batch(batch)
+        # bulk causal advance for every doc the delivery never touched
+        # (identical to the _distribute_routed tail)
+        entries = {}
+        clock_delta: dict = {}
+        for ch in applied:
+            actor, seq = ch["actor"], ch["seq"]
+            entries[(actor, seq)] = self.states[actor][seq - 1]["allDeps"]
+            if seq > clock_delta.get(actor, 0):
+                clock_delta[actor] = seq
+        quiet = [self.objects[oid].doc for oid in self.obj_order
+                 if oid != obj]
+        if obj != ROOT_ID:
+            quiet.append(self.root.doc)
+        for doc in quiet:
+            doc._all_deps.update(entries)
+            clock = doc.clock
+            for a, s in clock_delta.items():
+                if s > clock.get(a, 0):
+                    clock[a] = s
+        return {obj}, []
 
     def _capture_inverse(self, local: dict) -> list:
         """Inverse-op capture: the reference captures inside applyAssign
@@ -827,12 +896,19 @@ class _DeviceCore:
     def flush_pending(self):
         """Replay pending fast-path rounds into the engine (no diffs: they
         were emitted op-wise when the rounds applied); refresh the diff
-        snapshots and drop the overlays."""
+        snapshots and drop the overlays. Decodes inside the replay tag
+        as ``plan/decode_replay``: these changes never crossed the wire,
+        so the wire-ingest decode term stays attributable."""
         if not self.pending:
             return
         pending, self.pending = self.pending, []
         routed, self._pending_routed = self._pending_routed, []
-        touched, _ = self._distribute(pending, {}, routed=routed)
+        from ..engine import wire_columns as _wc
+        _wc.REPLAY_DEPTH += 1
+        try:
+            touched, _ = self._distribute(pending, {}, routed=routed)
+        finally:
+            _wc.REPLAY_DEPTH -= 1
         for oid in touched:
             w = self.root if oid == ROOT_ID else self.objects.get(oid)
             if isinstance(w, _TextObj):
@@ -1503,7 +1579,18 @@ def _device_apply(state: DeviceBackendState, changes, undoable: bool,
                  for ch in state.history()
                  for op in ch.get("ops", ())
                  if op.get("action") in _MAKE_KIND}
-    if not _in_scope(changes, known):
+    frame = changes if hasattr(changes, "batch") else None
+    if frame is not None:
+        # frame-level scope answer (no per-op walk): the frame grammar
+        # is device-shaped by construction, so scope is just "does the
+        # target object exist with a compatible kind". A mismatch (or a
+        # frame for an object this lineage never made) degrades to the
+        # dict view and the generic gate below.
+        kind = "map" if frame.obj_id == ROOT_ID else known.get(frame.obj_id)
+        if kind not in (("text", "list") if frame.kind == "text"
+                        else ("map", "table")):
+            changes, frame = frame.changes(), None
+    if frame is None and not _in_scope(changes, known):
         _graduate_signal("out_of_scope",
                          f"{len(changes)} change(s) outside device op shape")
         oracle_state = state._core.graduate(state._version)
@@ -1525,6 +1612,19 @@ def _device_apply(state: DeviceBackendState, changes, undoable: bool,
 
 
 def apply_changes(state, changes):
+    from ..engine.wire_format import WireFrame
+    if isinstance(changes, WireFrame):
+        # a binary wire delivery: decode (idempotent — the gate already
+        # validated it) IS the structural validation; the frame grammar
+        # is a strict subset of the device op shape, so per-op walks are
+        # redundant. The command log records the canonical dict view so
+        # fork/graduation replay stays frame-free and deterministic.
+        changes.validate()
+        if isinstance(state, _OracleState):
+            with prevalidated():
+                return _oracle.apply_changes(state, changes.changes())
+        return _device_apply(state, changes, False,
+                             ("apply", changes.changes(), False))
     # validation materializes BEFORE logging (iterator inputs must see
     # identical content in the live apply and the replay log) and rejects
     # structurally malformed changes with a typed ProtocolError before any

@@ -217,6 +217,13 @@ _NUMPY_MIN_OPS = 64   # below this the numpy column setup costs more than
 # the per-op walk (interactive windows are a handful of ops; the walk
 # already wins there and the columns still derive lazily at schedule)
 
+#: Non-zero while the backend replays its own write-behind pending
+#: rounds (device.flush_pending): decode spans in that extent emit as
+#: ``plan/decode_replay`` — the changes never crossed the wire, so the
+#: wire-ingest ``plan/decode`` serial term must not absorb them (the
+#: cfg13 A/B separates the two; INTERNALS §17).
+REPLAY_DEPTH = 0
+
 
 def decode_text_changes_columnar(data, obj_id: str):
     """Wire payload -> TextChangeBatch with columns attached.
@@ -250,9 +257,10 @@ def decode_text_changes_columnar(data, obj_id: str):
     if bulk:
         change_columns(batch)
     if obs.ENABLED:
-        obs.span("plan", "decode", _t0, args={
-            "obj": obj_id, "n_changes": batch.n_changes,
-            "n_ops": batch.n_ops, "bulk": bulk})
+        obs.span("plan", "decode_replay" if REPLAY_DEPTH else "decode",
+                 _t0, args={
+                     "obj": obj_id, "n_changes": batch.n_changes,
+                     "n_ops": batch.n_ops, "bulk": bulk})
     return batch
 
 

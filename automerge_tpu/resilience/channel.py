@@ -65,6 +65,26 @@ def validate_envelope(env) -> dict:
 #: in-order release drains the window.
 RECV_WINDOW = 1024
 
+def payload_wire_bytes(payload) -> int:
+    """Wire-byte size of one channel payload: exact for binary frames
+    (the ``wire`` field's encoded length IS the wire form), JSON-ish
+    estimate for dict-shaped parts (the same accounting
+    ``service.budget.approx_msg_bytes`` uses). Computed ONCE at send and
+    stored with the un-acked entry, so retransmissions charge the stored
+    size — never re-measuring, mirroring the never-re-encode contract."""
+    nbytes = getattr(payload, "nbytes", None)
+    if isinstance(nbytes, int) and not isinstance(payload, np.ndarray):
+        return nbytes
+    if isinstance(payload, dict):
+        return 2 + sum(len(str(k)) + 4 + payload_wire_bytes(v)
+                       for k, v in payload.items())
+    if isinstance(payload, (list, tuple)):
+        return 2 + sum(2 + payload_wire_bytes(v) for v in payload)
+    if isinstance(payload, str):
+        return 2 + len(payload)
+    return 8
+
+
 #: Default retransmit budget PER ENVELOPE. With exponential backoff this
 #: spans hundreds of rounds of sustained silence — far beyond any fault
 #: the chaos profiles inject against a live peer — so a legitimate slow
@@ -100,21 +120,30 @@ class ResilientChannel:
                       "dup_dropped": 0, "held_out_of_order": 0,
                       "window_dropped": 0, "delivered": 0,
                       "deliver_errors": 0, "backpressured": 0,
+                      "bytes_sent": 0, "bytes_resent": 0,
                       "dead": False}
 
     # -- outbound -------------------------------------------------------
 
     def send(self, payload):
+        """Queue + transmit one payload. The payload object (its binary
+        frames included) is CACHED in the send window as-is: a
+        retransmission resends the stored object/bytes verbatim — frames
+        are never re-encoded on retry, and the per-payload wire size is
+        measured once here (``bytes_sent``/``bytes_resent`` let the
+        bench report wire bytes per op for the dict-vs-binary A/B)."""
         if self.dead:
             raise PeerDeadError(
                 "channel is dead (retransmit cap exhausted); reconnect "
                 "with a fresh channel")
         seq = self._next_seq
         self._next_seq += 1
-        self._unacked[seq] = {"payload": payload,
+        nbytes = payload_wire_bytes(payload)
+        self._unacked[seq] = {"payload": payload, "nbytes": nbytes,
                               "due": self._round + self._base_rto,
                               "rto": self._base_rto, "tries": 0}
         self.stats["sent"] += 1
+        self.stats["bytes_sent"] += nbytes
         self._send_raw({"kind": "data", "seq": seq,
                         "ack": self._recv_high, "payload": payload})
 
@@ -144,6 +173,9 @@ class ResilientChannel:
             jitter = int(self._rng.integers(0, max(2, entry["rto"] // 2)))
             entry["due"] = self._round + entry["rto"] + jitter
             self.stats["retransmits"] += 1
+            # stored bytes: the size measured at send time, the payload
+            # object cached at send time — no re-encode, no re-measure
+            self.stats["bytes_resent"] += entry["nbytes"]
             if obs.ENABLED:
                 obs.event("chan", "retransmit",
                           args={"seq": seq, "rto": entry["rto"]})

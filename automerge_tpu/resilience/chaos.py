@@ -24,16 +24,40 @@ Fault model (per message, in this order):
 Every message is round-tripped through JSON (``codec=True``), which both
 isolates the receiver from sender-side mutation and enforces the wire-format
 invariant that sync messages are plain JSON — a tuple or numpy scalar
-leaking into a message surfaces here, not in production.
+leaking into a message surfaces here, not in production. Binary change
+frames (engine/wire_format.py) are the one non-JSON payload the wire
+grammar defines: the codec carries them as base64 of their exact encoded
+bytes and rebuilds a FRESH ``WireFrame`` per delivered copy, so every
+receiver decodes its own frame from raw bytes — exactly the real-socket
+semantics, and a duplicated copy cannot share a decode cache with the
+original.
 """
 
 from __future__ import annotations
 
+import base64
 import json
 
 import numpy as np
 
 from .. import obs
+
+_WIRE_KEY = "__amtpu_wire_b64__"
+
+
+def _codec_default(obj):
+    from ..engine.wire_format import WireFrame
+    if isinstance(obj, WireFrame):
+        return {_WIRE_KEY: base64.b64encode(obj.data).decode("ascii")}
+    raise TypeError(f"Object of type {type(obj).__name__} is not JSON "
+                    "serializable")
+
+
+def _codec_hook(d):
+    if _WIRE_KEY in d and len(d) == 1:
+        from ..engine.wire_format import WireFrame
+        return WireFrame(base64.b64decode(d[_WIRE_KEY]))
+    return d
 
 
 class ChaosLink:
@@ -73,7 +97,8 @@ class ChaosLink:
 
     def send(self, msg):
         self.stats["sent"] += 1
-        wire = json.dumps(msg) if self.codec else msg
+        wire = json.dumps(msg, default=_codec_default) \
+            if self.codec else msg
         if self.partitioned:
             self.stats["partition_dropped"] += 1
             if obs.ENABLED:
@@ -91,7 +116,8 @@ class ChaosLink:
             if obs.ENABLED:
                 obs.event("chaos", "dup")
         for _ in range(copies):
-            payload = json.loads(wire) if self.codec else msg
+            payload = (json.loads(wire, object_hook=_codec_hook)
+                       if self.codec else msg)
             due = self._round
             if self.delay and self._rng.random() < self.delay:
                 due += int(self._rng.integers(1, self.max_delay + 1))

@@ -63,6 +63,11 @@ def absorb_msg(doc_set, msg: dict):
     Connection, or a hub peer removed mid-flight: absorb inbound changes
     through the shared gate, never write to the (torn-down) transport.
     `msg` must already be validated. Returns the doc."""
+    if msg.get("wire") is not None:
+        from ..engine.wire_format import as_frame
+        return inbound_gate(doc_set).deliver_wire(
+            msg["docId"], [(as_frame(msg["wire"]), None)],
+            changes=msg.get("changes") or (), validated=True)
     if msg.get("changes"):
         return inbound_gate(doc_set).deliver(msg["docId"], msg["changes"],
                                              validated=True)
@@ -121,6 +126,56 @@ class InboundGate:
             return self._drain_loop(doc_id, changes, senders)
         finally:
             self._busy.discard(doc_id)
+
+    def deliver_wire(self, doc_id: str, frames, changes=(), sender=None,
+                     senders=None, validated: bool = False):
+        """Apply one inbound delivery carrying binary frames
+        (engine/wire_format.py), with an optional dict-change prefix
+        (applied first — the split_outgoing message shape).
+
+        ``frames`` is ``[(WireFrame, sender_or_None), ...]``. The FAST
+        LANE — no dict prefix, no parked quarantine, no re-entrant
+        drain, frames combining into one same-object delivery whose
+        rows are all causally admissible — hands the decoded batch
+        straight to the backend: one apply, zero per-change dicts on
+        the hot path (the dicts materialize lazily at backend admission
+        for history bookkeeping only). Anything else degrades to the
+        dict path via ``WireFrame.changes()`` — same drain loop, same
+        quarantine, same typed failures, byte-identical committed
+        state (the parity contract, tests/test_wire_format.py)."""
+        from ..engine.wire_format import as_frame, combine_frames
+        frames = [(as_frame(f).validate(), s) for f, s in frames]
+        if not changes and frames and doc_id not in self._busy \
+                and not self.quarantined(doc_id):
+            delivery = combine_frames([f for f, _ in frames]) \
+                if len(frames) > 1 else frames[0][0]
+            if delivery is not None \
+                    and delivery.ready_under(self._clock(doc_id)):
+                self._busy.add(doc_id)
+                try:
+                    doc = self._apply(doc_id, delivery)
+                    self.stats["delivered"] += delivery.n_changes
+                    if obs.ENABLED:
+                        obs.event("gate", "wire_fast",
+                                  args={"doc": doc_id,
+                                        "n_ops": delivery.n_ops})
+                    return doc
+                except ProtocolError:
+                    # backend rejection: its failure-atomic restore ran,
+                    # so re-deliver through the dict path, which salvages
+                    # valid changes and attributes the poison per sender
+                    pass
+                finally:
+                    self._busy.discard(doc_id)
+        all_changes = list(changes)
+        sender_list = (list(senders) if senders is not None
+                       else [sender] * len(all_changes))
+        for f, s in frames:
+            sub = f.changes()
+            all_changes.extend(sub)
+            sender_list.extend([s if s is not None else sender] * len(sub))
+        return self.deliver(doc_id, all_changes, validated=validated,
+                            sender=sender_list)
 
     @staticmethod
     def _sender_map(changes, sender) -> dict:
@@ -382,7 +437,10 @@ class InboundGate:
         self._doc_set.set_doc(doc_id, doc)
         # what actually committed, in wire ops — the honest per-lane
         # load signal (a premature change that parks costs the backend
-        # nothing; it is counted here on the call that DRAINS it)
-        self.stats["applied_ops"] += sum(
-            len(c.get("ops") or ()) for c in changes)
+        # nothing; it is counted here on the call that DRAINS it).
+        # `changes` may be a decoded wire delivery (the binary fast
+        # lane), whose op count is a column length, not a walk
+        self.stats["applied_ops"] += (
+            int(changes.n_ops) if hasattr(changes, "n_ops")
+            else sum(len(c.get("ops") or ()) for c in changes))
         return doc

@@ -22,7 +22,9 @@ One shared schema, two strictness levels:
 
 Validation never mutates or copies its input; it returns the validated value
 so call sites can write ``changes = validate_changes(changes)`` (which also
-materializes iterator inputs exactly once).
+materializes iterator inputs exactly once). One deliberate exception: a
+bytes-typed ``wire`` field is replaced in place by its validated
+``WireFrame`` so the decode is paid once (see ``validate_msg``).
 """
 
 from __future__ import annotations
@@ -175,12 +177,19 @@ def validate_changes(changes, strict: bool = True) -> list:
 
 
 def validate_msg(msg) -> dict:
-    """Validate one ``{docId, clock, changes?, checkpoint?, noSnapshot?}``
-    sync message (strict). ``checkpoint`` (a base64 checkpoint bundle, the
-    snapshot-bootstrap path) and ``noSnapshot`` (the receiver's typed
-    fallback request after a corrupt bundle) are optional extensions; the
-    bundle's own integrity is verified by the checkpoint codec at restore
-    time, not here."""
+    """Validate one ``{docId, clock, changes?, wire?, checkpoint?,
+    noSnapshot?}`` sync message (strict). ``checkpoint`` (a base64
+    checkpoint bundle, the snapshot-bootstrap path) and ``noSnapshot``
+    (the receiver's typed fallback request after a corrupt bundle) are
+    optional extensions; the bundle's own integrity is verified by the
+    checkpoint codec at restore time, not here. ``wire`` carries an
+    ``AMTPUWIRE1`` binary change frame (engine/wire_format.py) — it is
+    fully decoded (integrity hash + column envelope/bounds checks) HERE,
+    so a truncated, bit-flipped, wrong-version, or out-of-envelope frame
+    raises the typed ``WireFormatError`` (a ``ProtocolError``) before
+    any state is touched, exactly like dict-wire malformation. A message
+    may carry both ``changes`` (the dict prefix, e.g. a creation change)
+    and ``wire`` (the frame-scoped tail); they apply in that order."""
     if not isinstance(msg, dict):
         raise ProtocolError(f"sync message must be an object, got "
                             f"{type(msg).__name__}")
@@ -198,6 +207,24 @@ def validate_msg(msg) -> dict:
                                 f"{type(changes).__name__}")
         for change in changes:
             validate_change(change, strict=True)
+    wire = msg.get("wire")
+    if wire is not None:
+        from ..engine.wire_format import WireFormatError, as_frame
+        try:
+            frame = as_frame(wire).validate()
+        except WireFormatError:
+            raise
+        except (ValueError, TypeError, OverflowError) as exc:
+            raise WireFormatError(
+                f"malformed wire frame: {exc}") from exc
+        if frame is not wire:
+            # the ONE exception to the never-mutate rule: a bytes-typed
+            # frame is replaced in place by its validated WireFrame, so
+            # the decode just paid (body hash + bounds checks) is cached
+            # for every downstream consumer instead of re-run per access
+            # (in-process senders already pass WireFrame objects and are
+            # untouched)
+            msg["wire"] = frame
     ckpt = msg.get("checkpoint")
     if ckpt is not None and not isinstance(ckpt, str):
         raise ProtocolError(f"message `checkpoint` must be a base64 string, "

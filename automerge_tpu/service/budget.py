@@ -131,12 +131,11 @@ class ServiceConfig:
 def approx_msg_bytes(msg) -> int:
     """Cheap JSON-ish size estimate for budget accounting (recursive, no
     encode): close enough to wire bytes to meter tenants fairly, and two
-    orders of magnitude cheaper than re-serializing every message."""
-    if isinstance(msg, dict):
-        return 2 + sum(len(str(k)) + 4 + approx_msg_bytes(v)
-                       for k, v in msg.items())
-    if isinstance(msg, (list, tuple)):
-        return 2 + sum(2 + approx_msg_bytes(v) for v in msg)
-    if isinstance(msg, str):
-        return 2 + len(msg)
-    return 8
+    orders of magnitude cheaper than re-serializing every message. A
+    binary wire frame's size is EXACT — its encoded length is the wire
+    form. ONE implementation, shared with the channel's
+    bytes_sent/bytes_resent accounting (resilience/channel.py
+    ``payload_wire_bytes``) so the service's tenant metering and the
+    bench's dict-vs-binary byte comparison can never drift apart."""
+    from ..resilience.channel import payload_wire_bytes
+    return payload_wire_bytes(msg)

@@ -154,11 +154,16 @@ class TenantSession:
 
     def _enqueue(self, payload):
         """Channel deliver callback: validate at the service boundary,
-        meter, and queue for the tick scheduler."""
+        meter, and queue for the tick scheduler. Binary frames meter by
+        their column lengths and exact encoded size — no op walk."""
         msg = validate_msg(payload)
         changes = msg.get("changes")
         nops = sum(len(c.get("ops") or []) for c in changes) if changes \
-            else 1
+            else 0
+        wire = msg.get("wire")
+        if wire is not None:
+            from ..engine.wire_format import as_frame
+            nops += as_frame(wire).n_ops
         nbytes = approx_msg_bytes(msg)
         self.inbox.append((msg, nbytes, max(1, nops)))
         self.inbox_bytes += nbytes
@@ -337,7 +342,8 @@ class SyncService:
         defer0 = self.stats["deferrals"]
         deadline = (t_start + cfg.tick_budget_ms / 1e3) \
             if cfg.tick_budget_ms else None
-        groups: dict = {}       # (room_id, doc_id) -> [changes, senders]
+        groups: dict = {}       # (room_id, doc_id) ->
+        #                         [changes, senders, frames]
         shed = 0
         with ExitStack() as stack:
             # every room hub defers its flushes to ONE flush per room at
@@ -381,7 +387,8 @@ class SyncService:
             # executed under the room's shard-lane device context when
             # the service is sharded, so every backend apply's device
             # work lands on the lane that owns the room
-            for (room_id, doc_id), (changes, senders) in groups.items():
+            for (room_id, doc_id), (changes, senders, frames) \
+                    in groups.items():
                 room = self._rooms.get(room_id)
                 if room is None:
                     continue
@@ -390,8 +397,19 @@ class SyncService:
                 try:
                     with (lane.device_ctx() if lane is not None
                           else nullcontext()):
-                        room.gate.deliver(doc_id, changes, validated=True,
-                                          sender=senders)
+                        if frames:
+                            # N tenants' binary frames for one doc:
+                            # combined columnar delivery — still ONE
+                            # backend apply, zero per-op Python on the
+                            # admissible path (dict prefix, if any,
+                            # applies first)
+                            room.gate.deliver_wire(
+                                doc_id, frames, changes=changes,
+                                senders=senders, validated=True)
+                        else:
+                            room.gate.deliver(doc_id, changes,
+                                              validated=True,
+                                              sender=senders)
                 except ProtocolError as exc:
                     # the gate already salvaged every valid change and
                     # parked/dropped the poison with per-sender stats;
@@ -514,18 +532,26 @@ class SyncService:
     def _admit_msg(self, sess: TenantSession, msg: dict, groups: dict):
         room = self._rooms[sess.room_id]
         changes = msg.get("changes")
-        if changes and msg.get("checkpoint") is None \
+        wire = msg.get("wire")
+        if (changes or wire is not None) and msg.get("checkpoint") is None \
                 and not msg.get("noSnapshot"):
-            # strip changes for the cross-tenant per-doc group; record
-            # the revealed clock NOW (ordering is free — flush reads the
-            # post-apply doc state at tick end either way)
+            # strip changes/frames for the cross-tenant per-doc group;
+            # record the revealed clock NOW (ordering is free — flush
+            # reads the post-apply doc state at tick end either way).
+            # Binary frames stay ENCODED here: they group as opaque
+            # (frame, tenant) pairs and decode exactly once at the
+            # gate's wire fast lane
             if msg.get("clock") is not None:
                 room.hub.note_clock(sess.tenant_id, msg["docId"],
                                     msg["clock"])
-            changes_l, senders = groups.setdefault(
-                (sess.room_id, msg["docId"]), ([], []))
-            changes_l.extend(changes)
-            senders.extend([sess.tenant_id] * len(changes))
+            changes_l, senders, frames = groups.setdefault(
+                (sess.room_id, msg["docId"]), ([], [], []))
+            if changes:
+                changes_l.extend(changes)
+                senders.extend([sess.tenant_id] * len(changes))
+            if wire is not None:
+                from ..engine.wire_format import as_frame
+                frames.append((as_frame(wire), sess.tenant_id))
         else:
             # metadata (clock reveal / advertisement), or a snapshot-
             # bearing message — a checkpoint+tail bootstrap from a

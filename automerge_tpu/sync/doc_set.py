@@ -75,19 +75,25 @@ class DocSet:
         return checkpoint_doc(doc)
 
     def bootstrap_doc(self, doc_id: str, checkpoint, changes=None,
-                      fallback_changes=None, validated: bool = False):
+                      fallback_changes=None, validated: bool = False,
+                      wire=None):
         """Install a document from a checkpoint + op-log tail (snapshot
         bootstrap). The bundle is integrity-verified before any state is
         installed; a corrupt bundle raises ``CheckpointError`` — or,
         when ``fallback_changes`` carries the full log, degrades to full
         log replay instead. The tail then applies through the validated
-        + quarantined inbound gate like any network delivery."""
+        + quarantined inbound gate like any network delivery; ``wire``
+        carries the tail's binary frame when the peer served it on the
+        binary wire (the dict ``changes`` are then the prefix)."""
         from ..checkpoint import restore_doc_or_replay
         from ..resilience.inbound import inbound_gate
         doc = restore_doc_or_replay(checkpoint, fallback_changes)
         self.set_doc(doc_id, doc)
         gate = inbound_gate(self)
-        if changes:
+        if wire is not None:
+            gate.deliver_wire(doc_id, [(wire, None)],
+                              changes=changes or (), validated=validated)
+        elif changes:
             gate.deliver(doc_id, changes, validated=validated)
         else:
             gate.release(doc_id)   # parked changes the snapshot satisfied

@@ -219,11 +219,20 @@ class SyncHub:
 
         Change extraction is shared: flagged pairs with the same
         (doc, believed clock) — the common case when one local change
-        fans out to N caught-up peers — run `get_missing_changes` once."""
+        fans out to N caught-up peers — run `get_missing_changes` once.
+        With the binary wire on (``AMTPU_WIRE_BINARY``, the default),
+        the frame ENCODE is shared the same way: one
+        ``split_outgoing`` per (doc, clock) group mints one
+        ``AMTPUWIRE1`` frame serving every peer of the group — and the
+        channel layer retransmits those exact bytes, never re-encoding
+        (INTERNALS §17)."""
         if self._defer_depth:
             self._flush_wanted = True
             return
+        from ..engine.wire_format import split_outgoing, wire_binary_enabled
+        binary = wire_binary_enabled()
         extracted: dict = {}
+        encoded: dict = {}
         for peer_id, doc_id in self._matrix.pending():
             if peer_id not in self._peers:
                 continue
@@ -253,6 +262,16 @@ class SyncHub:
             self._matrix.update_theirs(peer_id, doc_id, clock)
             self._advertised[(peer_id, doc_id)] = clock
             msg = {"docId": doc_id, "clock": clock, "changes": changes}
+            if binary:
+                parts = encoded.get(key)
+                if parts is None:
+                    parts = encoded[key] = split_outgoing(changes)
+                prefix, frame = parts
+                if frame is not None:
+                    msg = {"docId": doc_id, "clock": clock}
+                    if prefix:
+                        msg["changes"] = prefix
+                    msg["wire"] = frame
             if (self.snapshot_min_changes and not their
                     and len(changes) >= self.snapshot_min_changes
                     and (peer_id, doc_id) not in self._no_snapshot):
@@ -260,11 +279,20 @@ class SyncHub:
                 # clock) missing a long history gets a checkpoint bundle
                 # + the op-log tail past its frontier instead of the
                 # whole log. A failed capture just serves plain changes.
+                # The tail rides the binary wire too (one cached encode
+                # serves the whole join storm, like the bundle itself).
                 snap = self._doc_checkpoint(doc_id, state)
                 if snap is not None:
-                    ck_b64, tail = snap
+                    ck_b64, tail, tail_parts = snap
                     msg = {"docId": doc_id, "clock": clock,
-                           "checkpoint": ck_b64, "changes": tail}
+                           "checkpoint": ck_b64}
+                    if binary and tail_parts is not None \
+                            and tail_parts[1] is not None:
+                        if tail_parts[0]:
+                            msg["changes"] = tail_parts[0]
+                        msg["wire"] = tail_parts[1]
+                    else:
+                        msg["changes"] = tail
             self._peers[peer_id].send_msg(msg)
 
     def _doc_checkpoint(self, doc_id: str, state):
@@ -281,7 +309,10 @@ class SyncHub:
         from ..checkpoint import Checkpoint, capture_state
         cached = self._ckpt_cache.get(doc_id)
         if cached is not None:
-            ck, cap_len, _ = cached
+            # the entry may carry a 4th slot (the cached tail-frame
+            # encode) once a tail has been served — unpack the fixed
+            # prefix only
+            ck, cap_len = cached[0], cached[1]
             stale = (state.history_len - cap_len >= self.snapshot_min_changes
                      or not less_or_equal(ck.clock, dict(state.clock)))
             if stale:
@@ -300,9 +331,24 @@ class SyncHub:
                 obs.event("sync", "snapshot_capture", args={"doc": doc_id})
         elif obs.ENABLED:
             obs.event("sync", "snapshot_serve_cached", args={"doc": doc_id})
-        ck, _, ck_b64 = cached
+        ck, _, ck_b64 = cached[:3]
         tail = Backend.get_missing_changes(state, ck.clock)
-        return ck_b64, tail
+        # tail frame cache, keyed by history length: the join-storm
+        # coalescing extends to the binary encode of the tail
+        tail_parts = None
+        from ..engine.wire_format import wire_binary_enabled
+        if wire_binary_enabled() and tail:
+            if len(cached) > 3 and cached[3][0] == state.history_len:
+                tail_parts = cached[3][1]
+            else:
+                from ..engine.wire_format import split_outgoing
+                tail_parts = split_outgoing(tail)
+                entry = (state.history_len, tail_parts)
+                if len(cached) > 3:
+                    cached[3] = entry
+                else:
+                    cached.append(entry)
+        return ck_b64, tail, tail_parts
 
     # -- inbound --------------------------------------------------------
 
@@ -354,6 +400,16 @@ class SyncHub:
             return self._doc_set.get_doc(doc_id)
         if msg.get("checkpoint") is not None:
             return self._receive_snapshot(peer_id, doc_id, msg)
+        if msg.get("wire") is not None:
+            # binary frame (+ optional dict prefix): the gate's wire
+            # fast lane hands the decoded batch straight to the backend
+            # when admissible; otherwise the same validated +
+            # quarantined dict path runs on the materialized changes
+            from ..engine.wire_format import as_frame
+            return inbound_gate(self._doc_set).deliver_wire(
+                doc_id, [(as_frame(msg["wire"]), peer_id)],
+                changes=msg.get("changes") or (), sender=peer_id,
+                validated=True)
         if msg.get("changes"):
             # validated + quarantined application: premature changes park
             # in the bounded per-doc quarantine (attributed to this peer
@@ -386,9 +442,16 @@ class SyncHub:
         ``noSnapshot`` re-request — the peer then serves the full log,
         i.e. the full-replay fallback."""
         from ..checkpoint import Checkpoint, CheckpointError
+        from ..engine.wire_format import as_frame
+        wire = msg.get("wire")
         if self._doc_set.get_doc(doc_id) is not None:
             # we already hold state for this doc (a race with another
             # peer's bootstrap): take only the tail, through the gate
+            if wire is not None:
+                return inbound_gate(self._doc_set).deliver_wire(
+                    doc_id, [(as_frame(wire), peer_id)],
+                    changes=msg.get("changes") or (), sender=peer_id,
+                    validated=True)
             if msg.get("changes"):
                 return inbound_gate(self._doc_set).deliver(
                     doc_id, msg["changes"], validated=True, sender=peer_id)
@@ -396,7 +459,8 @@ class SyncHub:
         try:
             ck = Checkpoint.from_base64(msg["checkpoint"])
             return self._doc_set.bootstrap_doc(
-                doc_id, ck, msg.get("changes") or [], validated=True)
+                doc_id, ck, msg.get("changes") or [], validated=True,
+                wire=None if wire is None else as_frame(wire))
         except CheckpointError as exc:
             logger.warning("snapshot bootstrap for doc %r failed (%s); "
                            "requesting full history", doc_id, exc)

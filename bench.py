@@ -1057,6 +1057,307 @@ def measure_sharded(n_shards: int = None, docs_per_shard: int = 640,
     return rec
 
 
+WIRE_TIMED_REGION = (
+    "binary columnar wire A/B at service scale (engine/wire_format.py, "
+    "INTERNALS §17): N tenant sessions over lossless queue transports "
+    "into one tick-scheduled SyncService, every client appending a bulk "
+    "text run each round (payloads past the frame gate, so the binary "
+    "leg ships AMTPUWIRE1 frames end-to-end: client hub encode -> "
+    "channel -> service grouped gate -> zero-copy backend apply -> hub "
+    "fan-out re-encode -> client decode). The SAME seeded session runs "
+    "twice — AMTPU_WIRE_BINARY=1 then =0 — and must commit "
+    "byte-identical per-replica save bytes + text (asserted in-run). dt "
+    "= first edit -> full quiescence; value = admitted wire ops/s of "
+    "the BINARY leg. decode_s per leg is the SERVICE-ingest decode "
+    "term: the EXACT emit-time telemetry aggregate of (plan, decode) "
+    "span time emitted inside the service's own work — sess.on_wire "
+    "(channel release -> validate_msg -> frame decode) plus svc.tick "
+    "(grouped gate deliveries) — while client-side fan-out decode is "
+    "reported separately as client_decode_s (same wire, the peers' "
+    "budget). Write-behind replay decodes emit as plan/decode_replay "
+    "(never crossed the wire, identical both legs) and the binary "
+    "leg's dict-materialization cost as materialize_s — the honest "
+    "residual per-change Python, paid at backend history admission, "
+    "off the planning path. wire_bytes_per_op sums both directions' "
+    "channel bytes_sent over admitted ops (frame sizes are exact "
+    "encoded lengths; dict messages are the same JSON-ish estimate "
+    "both legs).")
+
+
+def measure_wire(n_sessions: int = 48, room_size: int = 8,
+                 n_rounds: int = 4, chars_per_round: int = 1024,
+                 quick: bool = False) -> dict:
+    """cfg13: dict-vs-binary wire A/B at service scale (ISSUE 13).
+
+    Machine checks, asserted in-run: byte-identical per-replica
+    committed state across the flag legs; the binary leg actually put
+    frames on the wire; span-derived decode_s drops >= 5x binary vs
+    dict; binary decode_s stays under 5% of the service tick budget."""
+    import gc
+    from collections import deque
+
+    import automerge_tpu as am
+    from automerge_tpu import Connection, DocSet, Text
+    from automerge_tpu.resilience import ResilientChannel
+    from automerge_tpu.service import ServiceConfig, SyncService, \
+        TenantBudget
+
+    if quick:
+        n_sessions, n_rounds = 16, 2
+    n_rooms = max(1, n_sessions // room_size)
+
+    # one seeded base shared by BOTH legs: object ids are minted
+    # randomly, so byte-level A/B needs identical creation changes
+    bases = {}
+    for g in range(n_rooms):
+        rid = f"room-{g}"
+        doc0 = am.change(am.init(f"{rid}-origin"), lambda d: (
+            d.__setitem__("t", Text("svc"))))
+        bases[rid] = am.get_all_changes(doc0)
+
+    def leg(binary: str):
+        prior = os.environ.get("AMTPU_WIRE_BINARY")
+        os.environ["AMTPU_WIRE_BINARY"] = binary
+        try:
+            svc = SyncService(ServiceConfig(default_budget=TenantBudget(
+                ops_per_tick=8192, bytes_per_tick=4 << 20, inbox_cap=64)))
+            for g in range(n_rooms):
+                rid = f"room-{g}"
+                svc.seed_doc(rid, am.apply_changes(am.init(f"server-{g}"),
+                                                   bases[rid]))
+            wire_msgs = [0]
+            tele = obs.telemetry()
+
+            def term(cat, name):
+                agg = tele.span_aggregates().get((cat, name))
+                return agg["total_ns"] if agg else 0
+
+            # the SERVICE-ingest decode term: exactly the (plan, decode)
+            # span time emitted inside the service's own work — the
+            # transport boundary (sess.on_wire: channel release ->
+            # validate_msg -> frame decode) plus the tick's grouped gate
+            # deliveries — as opposed to client-side fan-out decode
+            # (same wire, different budget; both reported)
+            svc_decode_ns = [0]
+
+            class Client:
+                def __init__(self, i):
+                    self.tid = f"t{i}"
+                    rid = self.rid = f"room-{i % n_rooms}"
+                    self.to_server, self.to_client = deque(), deque()
+                    self.ds = DocSet()
+                    self.ds.set_doc(rid, am.apply_changes(
+                        am.init(f"c-{i}"), bases[rid]))
+                    svc.connect(self.tid, rid, self.to_client.append)
+                    self.chan = ResilientChannel(self.to_server.append,
+                                                 None)
+                    self.conn = Connection(self.ds, self.chan.send)
+                    self.chan._deliver = self.conn.receive_msg
+                    self.conn.open()
+
+                def pump(self):
+                    while self.to_server:
+                        env = self.to_server.popleft()
+                        if isinstance(env.get("payload"), dict) and \
+                                env["payload"].get("wire") is not None:
+                            wire_msgs[0] += 1
+                        sess = svc.session(self.tid)
+                        if sess is not None:
+                            d0 = term("plan", "decode")
+                            sess.on_wire(env)
+                            svc_decode_ns[0] += \
+                                term("plan", "decode") - d0
+                    while self.to_client:
+                        env = self.to_client.popleft()
+                        if isinstance(env.get("payload"), dict) and \
+                                env["payload"].get("wire") is not None:
+                            wire_msgs[0] += 1
+                        self.chan.on_wire(env)
+                    self.chan.tick()
+
+            clients = [Client(i) for i in range(n_sessions)]
+            svc_tick = svc.tick
+
+            def ticked():
+                d0 = term("plan", "decode")
+                svc_tick()
+                svc_decode_ns[0] += term("plan", "decode") - d0
+
+            svc.tick = ticked
+
+            def settle(max_ticks=1200):
+                for _ in range(max_ticks):
+                    for c in clients:
+                        c.pump()
+                    svc.tick()
+                    if svc.idle() and all(
+                            c.chan.idle and not c.to_server
+                            and not c.to_client for c in clients):
+                        return
+                raise AssertionError(
+                    f"wire bench never quiesced: {svc.metrics()}")
+
+            settle()                       # join handshake off the clock
+            svc_decode_ns[0] = 0
+            t_dec0 = term("plan", "decode")
+            t_rep0 = term("plan", "decode_replay")
+            t_mat0 = term("plan", "materialize")
+            tick0 = svc.telemetry.span_aggregates().get(
+                ("svc", "tick"), {"total_ns": 0})["total_ns"]
+            ops0 = svc.stats["admitted_ops"]
+            rng = __import__("random").Random(1313)
+            gc.collect()
+            t0 = time.perf_counter()
+            for r in range(n_rounds):
+                for c in clients:
+                    text = "".join(chr(97 + rng.randrange(26))
+                                   for _ in range(chars_per_round))
+                    c.ds.set_doc(c.rid, am.change(
+                        c.ds.get_doc(c.rid),
+                        lambda d, t=text: d["t"].insert_at(0, *list(t))))
+                    c.pump()
+                svc.tick()
+            settle()
+            dt = time.perf_counter() - t0
+            admitted = svc.stats["admitted_ops"] - ops0
+            assert admitted >= n_sessions * n_rounds * chars_per_round, (
+                admitted, svc.metrics())
+            # per-replica committed state, a fixed replica order — the
+            # cross-leg byte-identity contract
+            states = []
+            texts = set()
+            for g in range(n_rooms):
+                rid = f"room-{g}"
+                doc = svc.room(rid).doc_set.get_doc(rid)
+                states.append(am.save(doc))
+                texts.add((rid, am.to_json(doc)["t"]))
+            for c in clients:
+                states.append(am.save(c.ds.get_doc(c.rid)))
+                texts.add((c.rid, am.to_json(c.ds.get_doc(c.rid))["t"]))
+            assert len(texts) == n_rooms, "population diverged in-leg"
+            bytes_sent = sum(
+                s.channel.stats["bytes_sent"]
+                for s in svc.tenants.values()) + sum(
+                c.chan.stats["bytes_sent"] for c in clients)
+            return {
+                "ops_per_sec": round(admitted / dt),
+                "admitted_ops": admitted,
+                "dt_s": round(dt, 4),
+                "decode_s": round(svc_decode_ns[0] / 1e9, 6),
+                "client_decode_s": round(
+                    (term("plan", "decode") - t_dec0
+                     - svc_decode_ns[0]) / 1e9, 6),
+                # write-behind replay decode: local changes re-entering
+                # the engine (flush_pending) — never crossed the wire,
+                # identical work both legs, reported so it can't hide
+                "decode_replay_s": round(
+                    (term("plan", "decode_replay") - t_rep0) / 1e9, 6),
+                "materialize_s": round(
+                    (term("plan", "materialize") - t_mat0) / 1e9, 6),
+                "tick_total_s": round(
+                    (svc.telemetry.span_aggregates().get(
+                        ("svc", "tick"), {"total_ns": 0})["total_ns"]
+                     - tick0) / 1e9, 4),
+                "wire_msgs": wire_msgs[0],
+                "bytes_sent": bytes_sent,
+                "wire_bytes_per_op": round(bytes_sent / max(admitted, 1),
+                                           1),
+                "p99_tick_ms": svc.metrics()["p99_tick_ms"],
+            }, states
+        finally:
+            if prior is None:
+                os.environ.pop("AMTPU_WIRE_BINARY", None)
+            else:
+                os.environ["AMTPU_WIRE_BINARY"] = prior
+
+    was_enabled = obs.ENABLED
+    if not was_enabled:
+        obs.enable()
+    try:
+        leg("1")     # untimed warmup: pays the jit compiles at the
+        # session's engine shapes so neither timed leg inherits them
+        binary, states_b = leg("1")
+        legacy, states_d = leg("0")
+    finally:
+        if not was_enabled:
+            obs.disable()
+    assert states_b == states_d, \
+        "binary leg committed different bytes than the dict leg"
+    assert binary["wire_msgs"] > 0, "binary leg never shipped a frame"
+    assert legacy["wire_msgs"] == 0, "dict leg shipped frames"
+    decode_speedup = legacy["decode_s"] / max(binary["decode_s"], 1e-9)
+    decode_share = binary["decode_s"] / max(binary["tick_total_s"], 1e-9)
+    assert decode_speedup >= 5.0, (
+        f"decode term only dropped {decode_speedup:.2f}x "
+        f"(bar: 5x): {binary} vs {legacy}")
+    assert decode_share < 0.05, (
+        f"binary decode still {decode_share:.2%} of the tick budget "
+        f"(bar: <5%): {binary}")
+
+    from datetime import datetime, timezone
+
+    import jax as _jax
+    rec = {
+        "metric": f"cfg13_wire_service_{n_sessions}_sessions",
+        "value": binary["ops_per_sec"],
+        "unit": "ops/s",
+        "threshold": (
+            "asserted in code: byte-identical per-replica save bytes + "
+            "texts across AMTPU_WIRE_BINARY=0/1 on the same seeded "
+            "session; binary leg ships frames (wire_msgs > 0), dict leg "
+            "none; span-derived decode_s drops >= 5x binary vs dict; "
+            "binary decode_s < 5% of the svc tick budget — re-enforced "
+            "by the slo_gate rules on this committed row (decode "
+            "absolute ceiling + wire_bytes_per_op relative)"),
+        "timed_region": WIRE_TIMED_REGION,
+        "sessions": n_sessions,
+        "rooms": n_rooms,
+        "n_rounds": n_rounds,
+        "chars_per_round": chars_per_round,
+        "aggregate_ops_per_sec": binary["ops_per_sec"],
+        "dict_ops_per_sec": legacy["ops_per_sec"],
+        "admitted_ops": binary["admitted_ops"],
+        "decode_s": binary["decode_s"],
+        "dict_decode_s": legacy["decode_s"],
+        "decode_speedup_vs_dict": round(decode_speedup, 2),
+        "decode_share_of_tick": round(decode_share, 6),
+        "client_decode_s": binary["client_decode_s"],
+        "dict_client_decode_s": legacy["client_decode_s"],
+        "decode_replay_s": binary["decode_replay_s"],
+        "dict_decode_replay_s": legacy["decode_replay_s"],
+        "materialize_s": binary["materialize_s"],
+        "tick_total_s": binary["tick_total_s"],
+        "wire_msgs": binary["wire_msgs"],
+        "wire_bytes_per_op": binary["wire_bytes_per_op"],
+        "dict_wire_bytes_per_op": legacy["wire_bytes_per_op"],
+        "p99_tick_ms": binary["p99_tick_ms"],
+        "dict_p99_tick_ms": legacy["p99_tick_ms"],
+        "platform": _jax.devices()[0].platform,
+        "recorded_at_utc": datetime.now(timezone.utc).isoformat(),
+    }
+    return rec
+
+
+def main_wire():
+    """`bench.py --wire`: the cfg13 binary-wire A/B entry point (append
+    to the committed session log with ``--session``)."""
+    from benchmarks.common import preflight_device
+    budget = float(os.environ.get("AMTPU_PREFLIGHT_BUDGET_S", "420"))
+    if not preflight_device(total_budget_s=budget, allow_cpu=True):
+        print("bench.py --wire: no reachable jax device — refusing to "
+              "hang", file=sys.stderr)
+        return 3
+    if trace_requested():
+        obs.enable()
+    rec = measure_wire(quick="--quick" in sys.argv)
+    if trace_requested():
+        write_bench_trace(rec)
+    print(json.dumps(rec))
+    if is_chip_platform(rec["platform"]) or "--session" in sys.argv:
+        append_session_log(rec)
+    return 0
+
+
 TEXT_PREPARE_TIMED_REGION = (
     "cross-doc cold text planning (engine/cross_doc.py + the batch-update "
     "range index, INTERNALS §16): a text-doc population in the serving "
@@ -1513,6 +1814,8 @@ if __name__ == "__main__":
     # mode has no reduced shape, and `--quick --trace` needs one
     if "--sharded" in sys.argv:
         sys.exit(main_sharded())
+    if "--wire" in sys.argv:
+        sys.exit(main_wire())
     if "--text-prepare" in sys.argv:
         sys.exit(main_text_prepare())
     sys.exit(main_pipeline()

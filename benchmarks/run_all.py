@@ -517,6 +517,44 @@ def config12t_text_prepare(quick: bool = False,
          threshold=rec["threshold"])
 
 
+def config13_wire(quick: bool = False, record_session: bool = False):
+    """Binary columnar wire A/B at service scale (ISSUE 13, INTERNALS
+    §17): the cfg13 row — dict vs AMTPUWIRE1 frames on the SAME seeded
+    service session, byte-identical committed state asserted in-run,
+    span-derived service-ingest decode term >= 5x smaller, binary
+    decode under 5% of the tick budget, wire bytes/op recorded for both
+    legs. Subprocess for a clean obs/jax state; ``--session`` appends
+    the row to BENCH_SESSIONS.jsonl."""
+    import os
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "AMTPU_SKIP_PREFLIGHT": "1"}
+    cmd = [sys.executable, os.path.join(root, "bench.py"), "--wire"]
+    if quick:
+        cmd.append("--quick")
+    if record_session:
+        cmd.append("--session")
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=root,
+                         env=env, timeout=1200)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"cfg13 wire bench failed rc={out.returncode}: "
+            f"{out.stderr[-800:]}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    emit("cfg13_wire_service_ops_per_sec", rec["value"], "ops/s",
+         sessions=rec["sessions"],
+         dict_ops_per_sec=rec["dict_ops_per_sec"],
+         decode_s=rec["decode_s"],
+         dict_decode_s=rec["dict_decode_s"],
+         decode_speedup_vs_dict=rec["decode_speedup_vs_dict"],
+         decode_share_of_tick=rec["decode_share_of_tick"],
+         wire_bytes_per_op=rec["wire_bytes_per_op"],
+         dict_wire_bytes_per_op=rec["dict_wire_bytes_per_op"],
+         measured_platform=rec["platform"],
+         threshold=rec["threshold"])
+
+
 def config5b_residual_heavy(n_actors: int = 10_000, quick: bool = False):
     """Adversarial headline shape: 20% of ops are RESIDUALS (bare deletes
     of distinct base elements + bare inserts without values) that cannot
@@ -1247,6 +1285,10 @@ def main():
         # the chip_session.sh cfg12t step: ONLY the cold-planning row
         config12t_text_prepare(quick=quick, record_session=True)
         return
+    if "--wire-session" in sys.argv:
+        # the chip_session.sh cfg13 step: ONLY the binary-wire A/B row
+        config13_wire(quick=quick, record_session=True)
+        return
     record_round = None
     record_path = None
     if "--record" in sys.argv:
@@ -1331,6 +1373,7 @@ def main():
         lambda: config11_service(quick=quick),
         lambda: config12_sharded(quick=quick),
         lambda: config12t_text_prepare(quick=quick),
+        lambda: config13_wire(quick=quick),
     ]
     if record_path is not None:
         steps.insert(0, fold_headline)

@@ -52,6 +52,12 @@ SLOS = [
     ("cfg12_sharded", "text_population.aggregate_ops_per_sec",
      "min", 0.8),
     ("cfg12t_text_cold_prepare", "value", "min", 0.8),
+    # ISSUE 13: binary-wire service rows — aggregate throughput floor
+    # and a relative ceiling on wire bytes per admitted op (a format or
+    # framing regression that bloats the wire shows up here even while
+    # the absolute decode bars below still pass)
+    ("cfg13_wire_service", "value", "min", 0.8),
+    ("cfg13_wire_service", "wire_bytes_per_op", "max", 1.25),
 ]
 
 #: Absolute SLOs: (metric_prefix, dotted field, op, bound) checked on
@@ -75,6 +81,12 @@ ABS_SLOS = [
     # the ISSUE-12 bulk-update budget on the committed cfg12t row: one
     # index merge per doc per round, never one sorted insert per range
     ("cfg12t_text_cold_prepare", "index_merges_per_doc_round", "<=", 1),
+    # the ISSUE-13 acceptance bars on every committed cfg13 row,
+    # forever: the service-ingest decode term stays >= 5x smaller than
+    # the dict wire on the same seeded stream, and under 5% of the
+    # tick budget (the "decode term ~vanishes" contract)
+    ("cfg13_wire_service", "decode_speedup_vs_dict", ">=", 5.0),
+    ("cfg13_wire_service", "decode_share_of_tick", "<=", 0.05),
 ]
 
 #: Derived fields computable from any row that carries the inputs.

@@ -124,6 +124,12 @@ run "cfg12_sharded" 1800 python -m benchmarks.run_all --sharded-session
 # span-derived detect_runs/index_merge/rank_resolve terms on the chip
 # host, budget-asserted inside the measurement
 run "cfg12t_text_prepare" 1200 python -m benchmarks.run_all --text-prepare-session
+# binary columnar wire A/B (ISSUE 13): the cfg13 row on the chip host —
+# service-ingest decode term dict vs AMTPUWIRE1 frames on the same
+# seeded session, byte-identity + the >=5x decode bar + the <5%
+# tick-share bar asserted inside the measurement, wire bytes/op both
+# legs; appended to BENCH_SESSIONS.jsonl
+run "cfg13_wire" 1200 python -m benchmarks.run_all --wire-session
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu

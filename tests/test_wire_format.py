@@ -1,0 +1,791 @@
+"""Binary columnar wire format (INTERNALS §17).
+
+Pins the ISSUE-13 contracts:
+
+- **Lossless + byte-deterministic**: encode -> decode -> materialize
+  reproduces the original wire dicts byte-identically (key order, dep
+  insertion order, pooled values); encoding the same changes twice (or
+  re-encoding a decoded batch) yields identical bytes.
+- **Zero-copy**: decoded op columns are read-only views over the frame
+  buffer, with the per-change planner columns attached.
+- **Malformed-frame hardening**: truncated / bit-flipped / wrong-version
+  / oversize-length / out-of-envelope frames raise the typed
+  ``WireFormatError`` (a ``ProtocolError``) through ``validate_msg`` and
+  the inbound gate — never IndexError/struct.error — with no state
+  escaping.
+- **Parity**: committed state (save bytes + text) is byte-identical
+  across the binary and dict wire on randomized out-of-order/dup/
+  premature chunked streams, across the AMTPU_WIRE_BINARY x
+  AMTPU_CROSS_DOC_PLAN matrix, with mixed binary/dict peers on one hub,
+  and at service scale.
+- **Channel caching**: retransmissions resend the cached payload object
+  (no re-encode) and the bytes_sent/bytes_resent accounting reads the
+  size stored at send time.
+"""
+
+import json
+import os
+import random
+import struct
+
+import numpy as np
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import Connection, DocSet, Text
+from automerge_tpu.engine import wire_format as wf
+from automerge_tpu.resilience.channel import ResilientChannel
+from automerge_tpu.resilience.errors import ProtocolError
+from automerge_tpu.resilience.inbound import inbound_gate
+from automerge_tpu.resilience.validation import validate_msg
+
+from test_columnar_plan import rand_text_changes
+
+OBJ = "t"
+
+
+def _frame_scoped(changes):
+    """Give every empty-ops change a fresh ins so the stream is frame
+    scoped (the generator can mint op-less changes; a frame requires
+    >= 1 op per change)."""
+    elems = {}
+    for c in changes:
+        for op in c["ops"]:
+            if op["action"] == "ins":
+                elems[c["actor"]] = max(elems.get(c["actor"], 0),
+                                        op["elem"])
+    for c in changes:
+        if not c["ops"]:
+            e = elems.get(c["actor"], 0) + 1000 + c["seq"]
+            c["ops"].append({"action": "ins", "obj": OBJ, "key": "_head",
+                             "elem": e})
+    return changes
+
+
+# ---------------------------------------------------------------------------
+# round trip
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_round_trip_byte_identity(seed):
+    rng = random.Random(seed)
+    changes = _frame_scoped(rand_text_changes(rng, n_changes=12 + 6 * seed))
+    data = wf.encode_changes(changes)
+    assert wf.encode_changes(changes) == data, "encode not deterministic"
+    batch = wf.decode(data)
+    out = wf.materialize_changes(batch)
+    assert json.dumps(out) == json.dumps(changes), \
+        "materialized dicts differ from the originals"
+    assert wf.encode_batch(batch) == data, "decode -> re-encode unstable"
+
+
+def test_dep_insertion_order_preserved():
+    """Content-equal deps dicts with different insertion orders must NOT
+    collapse on the wire (the byte-parity contract of the history)."""
+    changes = [
+        {"actor": "a", "seq": 1, "deps": {},
+         "ops": [{"action": "ins", "obj": OBJ, "key": "_head", "elem": 1}]},
+        {"actor": "b", "seq": 1, "deps": {},
+         "ops": [{"action": "ins", "obj": OBJ, "key": "_head", "elem": 1}]},
+        {"actor": "c", "seq": 1, "deps": {"a": 1, "b": 1},
+         "ops": [{"action": "set", "obj": OBJ, "key": "a:1", "value": "x"}]},
+        {"actor": "d", "seq": 1, "deps": {"b": 1, "a": 1},
+         "ops": [{"action": "set", "obj": OBJ, "key": "b:1", "value": "y"}]},
+    ]
+    out = wf.materialize_changes(wf.decode(wf.encode_changes(changes)))
+    assert json.dumps(out) == json.dumps(changes)
+
+
+def test_map_frame_round_trip():
+    changes = [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+        {"action": "set", "obj": "m", "key": "k1", "value": 7},
+        {"action": "set", "obj": "m", "key": "k2", "value": "wide string"},
+        {"action": "set", "obj": "m", "key": "k3", "value": 3.5,
+         "datatype": "float64"},
+        {"action": "inc", "obj": "m", "key": "k1", "value": -2},
+        {"action": "del", "obj": "m", "key": "k2"},
+        {"action": "link", "obj": "m", "key": "k4", "value": "child-1"},
+    ]}]
+    data = wf.encode_changes(changes)
+    batch = wf.decode(data)
+    assert json.dumps(wf.materialize_changes(batch)) == json.dumps(changes)
+    assert wf.encode_batch(batch) == data
+
+
+def test_zero_copy_views_and_columns():
+    rng = random.Random(1)
+    changes = _frame_scoped(rand_text_changes(rng, n_changes=20))
+    batch = wf.decode(wf.encode_changes(changes))
+    for col in (batch.op_change, batch.op_kind, batch.op_value,
+                batch.op_target_actor, batch.op_target_ctr):
+        assert col.base is not None, "column is not a buffer view"
+        assert not col.flags.writeable, "wire view must be read-only"
+    cols = batch._change_columns
+    assert cols is not None and cols.n_changes == batch.n_changes
+    assert not cols.actor_idx.flags.writeable
+
+
+def test_split_outgoing_peels_creation_prefix():
+    rng = random.Random(2)
+    tail = _frame_scoped(rand_text_changes(rng, n_changes=18,
+                                           premature=False, dups=False))
+    mk = {"actor": "root", "seq": 1, "deps": {},
+          "ops": [{"action": "makeText", "obj": OBJ}]}
+    prefix, frame = wf.split_outgoing([mk] + tail, min_ops=1)
+    assert prefix == [mk]
+    assert frame is not None and frame.n_changes == len(tail)
+    # fully out-of-scope stays on the dict wire
+    prefix, frame = wf.split_outgoing([mk], min_ops=1)
+    assert prefix == [mk] and frame is None
+
+
+def test_min_ops_gate():
+    ch = [{"actor": "a", "seq": 1, "deps": {},
+           "ops": [{"action": "ins", "obj": OBJ, "key": "_head",
+                    "elem": 1}]}]
+    prefix, frame = wf.split_outgoing(ch)          # default gate: 64
+    assert frame is None and prefix == ch
+    _, frame = wf.split_outgoing(ch, min_ops=1)
+    assert frame is not None
+
+
+# ---------------------------------------------------------------------------
+# malformed-frame hardening
+# ---------------------------------------------------------------------------
+
+
+def _valid_frame_bytes(n_changes=12, seed=3):
+    rng = random.Random(seed)
+    changes = _frame_scoped(rand_text_changes(rng, n_changes=n_changes,
+                                              premature=False, dups=False))
+    return wf.encode_changes(changes)
+
+
+def test_bit_flips_reject_typed():
+    data = _valid_frame_bytes()
+    rng = random.Random(0)
+    for _ in range(400):
+        raw = bytearray(data)
+        raw[rng.randrange(len(raw))] ^= 1 << rng.randrange(8)
+        try:
+            wf.decode(bytes(raw))
+        except wf.WireFormatError:
+            pass        # typed rejection is the contract
+        # an undetected flip is impossible: every section and the
+        # manifest are SHA-256 covered, so reaching here without an
+        # exception means the flip hit a dead byte — there are none
+        else:
+            raise AssertionError("bit flip decoded silently")
+
+
+def test_truncations_reject_typed():
+    data = _valid_frame_bytes()
+    for cut in list(range(0, 64)) + list(range(64, len(data), 61)):
+        with pytest.raises(wf.WireFormatError):
+            wf.decode(data[:cut])
+
+
+def test_wrong_version_and_magic_reject():
+    data = _valid_frame_bytes()
+    with pytest.raises(wf.WireFormatError):
+        wf.decode(b"AMTPUWIRE2\n" + data[len(wf.MAGIC):])
+    old = wf.VERSION
+    try:
+        wf.VERSION = 99
+        future = _valid_frame_bytes()
+    finally:
+        wf.VERSION = old
+    with pytest.raises(wf.WireFormatError, match="version"):
+        wf.decode(future)
+
+
+def test_oversize_length_rejects():
+    data = _valid_frame_bytes()
+    raw = bytearray(data)
+    struct.pack_into("<Q", raw, len(wf.MAGIC), 2**62)   # huge manifest len
+    with pytest.raises(wf.WireFormatError):
+        wf.decode(bytes(raw))
+    with pytest.raises(wf.WireFormatError):
+        wf.decode(b"")
+    with pytest.raises(wf.WireFormatError):
+        wf.decode(None)
+
+
+def _tampered(mutate):
+    """Re-pack a valid frame with one column mutated (fresh hashes, so
+    only the SEMANTIC envelope/bounds checks can reject it)."""
+    manifest, sections = wf._unpack(_valid_frame_bytes())
+    arrays = {k: np.array(v) for k, v in sections.items()}
+    mutate(arrays)
+    man = {k: manifest[k] for k in ("kind", "obj_id", "n_changes", "n_ops",
+                                    "n_change_actors")}
+    return wf._pack(man, arrays)
+
+
+@pytest.mark.parametrize("mutate, why", [
+    (lambda a: a["seqs"].__setitem__(0, 0), "seq below 1"),
+    (lambda a: a["seqs"].__setitem__(0, -3), "negative seq"),
+    (lambda a: a["actor_idx"].__setitem__(0, 10_000), "actor idx OOB"),
+    (lambda a: a["dep_gid"].__setitem__(0, 999), "dep group OOB"),
+    (lambda a: a["g_off"].__setitem__(0, 7), "non-CSR offsets"),
+    (lambda a: a["op_change"].__setitem__(0, 30_000), "op row OOB"),
+    (lambda a: a["op_kind"].__setitem__(0, 9), "unknown op kind"),
+    (lambda a: a["op_target_actor"].__setitem__(0, 4_000), "target OOB"),
+    (lambda a: a["op_target_ctr"].__setitem__(0, 0), "elem ctr below 1"),
+    (lambda a: a["op_parent_actor"].__setitem__(0, -7), "bad parent rank"),
+], ids=lambda x: x if isinstance(x, str) else "")
+def test_envelope_and_bounds_guards(mutate, why):
+    """int32 envelope + index-bounds guards on every decoded column: a
+    frame that would later IndexError (or silently reorder elements)
+    rejects typed at decode, before any state exists."""
+    with pytest.raises(wf.WireFormatError):
+        wf.decode(_tampered(mutate))
+
+
+def test_validate_msg_and_gate_reject_malformed_frames():
+    """The sync boundary surfaces frame malformation as ProtocolError
+    and leaves document state untouched."""
+    data = _valid_frame_bytes()
+    corrupt = bytearray(data)
+    corrupt[len(data) // 2] ^= 0x10
+    with pytest.raises(ProtocolError):
+        validate_msg({"docId": "d", "clock": {}, "wire": bytes(corrupt)})
+    with pytest.raises(ProtocolError):
+        validate_msg({"docId": "d", "clock": {}, "wire": 12345})
+    ds = DocSet()
+    gate = inbound_gate(ds)
+    with pytest.raises(ProtocolError):
+        gate.deliver_wire("d", [(wf.WireFrame(bytes(corrupt)), "p1")])
+    assert ds.get_doc("d") is None
+    assert gate.quarantined("d") == 0
+
+
+# ---------------------------------------------------------------------------
+# gate semantics: fast lane, quarantine, poison
+# ---------------------------------------------------------------------------
+
+
+def _seed_base():
+    """One seeded history shared by every replica of a test (object ids
+    are minted randomly, so byte-level save comparison requires every
+    leg to replay the SAME creation changes)."""
+    doc = am.init("origin")
+    doc = am.change(doc, lambda d: d.__setitem__("t", Text("Z")))
+    state = am.frontend.get_backend_state(doc)
+    from automerge_tpu.backend import default as B
+    base = B.get_missing_changes(state, {})
+    obj_id = next(op["obj"] for c in base for op in c["ops"]
+                  if op["action"] == "makeText")
+    return base, obj_id
+
+
+def _seeded_doc_set(base):
+    ds = DocSet()
+    ds.set_doc("d", am.apply_changes(am.init("replica"), base))
+    return ds
+
+
+def _rewrite(changes, obj_id):
+    out = []
+    for c in changes:
+        c = dict(c)
+        c["ops"] = [{**op, "obj": obj_id} for op in c["ops"]]
+        out.append(c)
+    return out
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_gate_wire_vs_dict_parity(seed):
+    """deliver_wire over chunked frames == deliver over the same dicts:
+    byte-identical save + text, equal gate stats."""
+    rng = random.Random(100 + seed)
+    base, obj_id = _seed_base()
+    stream = _rewrite(rand_text_changes(rng, n_changes=30, obj=OBJ),
+                      obj_id)
+    ds_a = _seeded_doc_set(base)
+    ds_b = _seeded_doc_set(base)
+    chunks = []
+    i = 0
+    while i < len(stream):
+        n = rng.randrange(1, 7)
+        chunks.append(stream[i:i + n])
+        i += n
+    for chunk in chunks:
+        prefix, frame = wf.split_outgoing(chunk, min_ops=1)
+        if frame is not None:
+            inbound_gate(ds_a).deliver_wire("d", [(frame, "p")],
+                                            changes=prefix,
+                                            validated=False)
+        else:
+            inbound_gate(ds_a).deliver("d", chunk, sender="p")
+        inbound_gate(ds_b).deliver("d", chunk, sender="p")
+    assert am.to_json(ds_a.get_doc("d")) == am.to_json(ds_b.get_doc("d"))
+    assert am.save(ds_a.get_doc("d")) == am.save(ds_b.get_doc("d"))
+    ga, gb = inbound_gate(ds_a).stats, inbound_gate(ds_b).stats
+    assert ga["delivered"] == gb["delivered"]
+    assert ga["applied_ops"] == gb["applied_ops"]
+
+
+def test_premature_frame_parks_and_releases():
+    base, obj_id = _seed_base()
+    ds = _seeded_doc_set(base)
+    gate = inbound_gate(ds)
+    dep = [{"actor": "x", "seq": 1, "deps": {},
+            "ops": [{"action": "ins", "obj": obj_id, "key": "_head",
+                     "elem": 1},
+                    {"action": "set", "obj": obj_id, "key": "x:1",
+                     "value": "a"}]}]
+    late = [{"actor": "y", "seq": 1, "deps": {"x": 1},
+             "ops": [{"action": "set", "obj": obj_id, "key": "x:1",
+                      "value": "b"}]}]
+    gate.deliver_wire("d", [(wf.WireFrame(wf.encode_changes(late)), "py")])
+    assert gate.quarantined("d") == 1            # parked, not applied
+    gate.deliver_wire("d", [(wf.WireFrame(wf.encode_changes(dep)), "px")])
+    assert gate.quarantined("d") == 0            # released by the dep
+    assert "a" in am.to_json(ds.get_doc("d"))["t"] or \
+        "b" in am.to_json(ds.get_doc("d"))["t"]
+
+
+def test_poison_frame_rejects_typed_and_atomic():
+    ds = _seeded_doc_set(_seed_base()[0])
+    gate = inbound_gate(ds)
+    before = am.save(ds.get_doc("d"))
+    poison = [{"actor": "x", "seq": 1, "deps": {},
+               "ops": [{"action": "set", "obj": "no-such-object",
+                        "key": "a:1", "value": "!"}]}]
+    with pytest.raises(ProtocolError):
+        gate.deliver_wire("d", [(wf.WireFrame(wf.encode_changes(poison)),
+                                 "px")])
+    assert am.save(ds.get_doc("d")) == before
+
+
+def test_combined_frames_one_apply():
+    """N same-object frames combine into ONE backend apply (the service
+    tick's grouped admission shape)."""
+    base, obj_id = _seed_base()
+    ds = _seeded_doc_set(base)
+    gate = inbound_gate(ds)
+    f1 = wf.WireFrame(wf.encode_changes(
+        [{"actor": "x", "seq": 1, "deps": {},
+          "ops": [{"action": "ins", "obj": obj_id, "key": "_head",
+                   "elem": 1},
+                  {"action": "set", "obj": obj_id, "key": "x:1",
+                   "value": "1"}]}]))
+    f2 = wf.WireFrame(wf.encode_changes(
+        [{"actor": "y", "seq": 1, "deps": {},
+          "ops": [{"action": "ins", "obj": obj_id, "key": "_head",
+                   "elem": 1},
+                  {"action": "set", "obj": obj_id, "key": "y:1",
+                   "value": "2"}]}]))
+    gate.deliver_wire("d", [(f1, "tx"), (f2, "ty")])
+    txt = am.to_json(ds.get_doc("d"))["t"]
+    assert "1" in txt and "2" in txt
+    assert gate.stats["delivered"] == 2
+    assert gate.stats["applied_ops"] == 4
+
+
+# ---------------------------------------------------------------------------
+# hub integration: binary native, mixed peers, flag matrix
+# ---------------------------------------------------------------------------
+
+
+def _pair():
+    a, b = DocSet(), DocSet()
+    qa, qb = [], []
+    ca, cb = Connection(a, qa.append), Connection(b, qb.append)
+    ca.open()
+    cb.open()
+    return a, b, ca, cb, qa, qb
+
+
+def _pump(ca, cb, qa, qb, flag_a="1", flag_b="1"):
+    for _ in range(80):
+        if not qa and not qb:
+            return
+        os.environ["AMTPU_WIRE_BINARY"] = flag_b
+        while qa:
+            cb.receive_msg(qa.pop(0))
+        os.environ["AMTPU_WIRE_BINARY"] = flag_a
+        while qb:
+            ca.receive_msg(qb.pop(0))
+    raise AssertionError("hub pair never quiesced")
+
+
+def _bulk_edit(doc, text):
+    return am.change(doc, lambda d: d["t"].insert_at(0, *list(text)))
+
+
+@pytest.mark.parametrize("cross", ["0", "1"])
+@pytest.mark.parametrize("binary", ["0", "1"])
+def test_hub_flag_matrix_byte_identical(binary, cross, monkeypatch):
+    """The same seeded edit session converges to byte-identical save
+    bytes + text across the AMTPU_WIRE_BINARY x AMTPU_CROSS_DOC_PLAN
+    matrix (binary leg verified to actually put frames on the wire)."""
+    monkeypatch.setenv("AMTPU_CROSS_DOC_PLAN", cross)
+    monkeypatch.setenv("AMTPU_WIRE_BINARY", binary)
+    if "base" not in _MATRIX_SEED:
+        doc = am.init("author")
+        doc = am.change(doc, lambda d: d.__setitem__("t", Text("seed")))
+        from automerge_tpu.backend import default as B
+        _MATRIX_SEED["base"] = B.get_missing_changes(
+            am.frontend.get_backend_state(doc), {})
+    a, b, ca, cb, qa, qb = _pair()
+    sent_wire = 0
+
+    def pump():
+        nonlocal sent_wire
+        for _ in range(80):
+            if not qa and not qb:
+                return
+            while qa:
+                msg = qa.pop(0)
+                sent_wire += 1 if msg.get("wire") is not None else 0
+                cb.receive_msg(msg)
+            while qb:
+                msg = qb.pop(0)
+                sent_wire += 1 if msg.get("wire") is not None else 0
+                ca.receive_msg(msg)
+        raise AssertionError("never quiesced")
+
+    a.set_doc("doc", am.apply_changes(am.init("author"),
+                                      _MATRIX_SEED["base"]))
+    pump()
+    # the hub auto-creates b's replica with a RANDOM actor id; pin it so
+    # save bytes are comparable across the flag legs
+    b.set_doc("doc", am.frontend.set_actor_id(b.get_doc("doc"), "peer-b"))
+    rng = random.Random(7)
+    for r in range(4):
+        side, ds = (a, a) if r % 2 == 0 else (b, b)
+        text = "".join(chr(97 + rng.randrange(26)) for _ in range(48))
+        ds.set_doc("doc", _bulk_edit(ds.get_doc("doc"), text))
+        pump()
+    assert am.to_json(a.get_doc("doc")) == am.to_json(b.get_doc("doc"))
+    assert am.save(a.get_doc("doc")) == am.save(b.get_doc("doc"))
+    if binary == "1":
+        assert sent_wire > 0, "binary leg never minted a frame"
+    else:
+        assert sent_wire == 0, "dict leg minted a frame"
+    result = (am.save(a.get_doc("doc")), am.to_json(a.get_doc("doc"))["t"])
+    # cross-leg byte identity: stash per (cross) and compare across binary
+    key = f"cross={cross}"
+    stash = _MATRIX_RESULTS.setdefault(key, result)
+    assert stash == result, \
+        f"binary={binary} diverged from the other wire at {key}"
+
+
+_MATRIX_RESULTS: dict = {}
+_MATRIX_SEED: dict = {}
+
+
+def test_mixed_binary_dict_peers_one_hub(monkeypatch):
+    """A binary-minting peer and a dict-minting peer on one server hub
+    converge byte-identically (decode is unconditional; the flag only
+    gates encoding)."""
+    server = DocSet()
+    q_c1, q_c2, q_s1, q_s2 = [], [], [], []
+    s1 = Connection(server, q_s1.append)      # server's face to client 1
+    s2 = Connection(server, q_s2.append)
+    c1_ds, c2_ds = DocSet(), DocSet()
+    c1 = Connection(c1_ds, q_c1.append)
+    c2 = Connection(c2_ds, q_c2.append)
+    for conn in (s1, s2, c1, c2):
+        conn.open()
+    doc = am.init("author")
+    doc = am.change(doc, lambda d: d.__setitem__("t", Text("seed")))
+    server.set_doc("doc", doc)
+
+    def pump():
+        for _ in range(120):
+            if not (q_c1 or q_c2 or q_s1 or q_s2):
+                return
+            # client 1 is a BINARY peer, client 2 a DICT peer; the
+            # server hub mints per the process flag (binary)
+            os.environ["AMTPU_WIRE_BINARY"] = "1"
+            while q_s1:
+                c1.receive_msg(q_s1.pop(0))
+            while q_c1:
+                s1.receive_msg(q_c1.pop(0))
+            os.environ["AMTPU_WIRE_BINARY"] = "0"
+            while q_s2:
+                c2.receive_msg(q_s2.pop(0))
+            while q_c2:
+                s2.receive_msg(q_c2.pop(0))
+        raise AssertionError("never quiesced")
+
+    monkeypatch.setenv("AMTPU_WIRE_BINARY", "1")
+    pump()
+    rng = random.Random(11)
+    for r in range(3):
+        os.environ["AMTPU_WIRE_BINARY"] = "1"
+        c1_ds.set_doc("doc", _bulk_edit(
+            c1_ds.get_doc("doc"),
+            "".join(chr(97 + rng.randrange(26)) for _ in range(40))))
+        pump()
+        os.environ["AMTPU_WIRE_BINARY"] = "0"
+        c2_ds.set_doc("doc", _bulk_edit(
+            c2_ds.get_doc("doc"),
+            "".join(chr(65 + rng.randrange(26)) for _ in range(40))))
+        pump()
+    os.environ["AMTPU_WIRE_BINARY"] = "1"
+    docs = [server.get_doc("doc"), c1_ds.get_doc("doc"),
+            c2_ds.get_doc("doc")]
+    assert len({json.dumps(am.to_json(d), sort_keys=True)
+                for d in docs}) == 1
+    assert len({am.save(d) for d in docs}) == 1
+
+
+def test_snapshot_bootstrap_tail_rides_wire(monkeypatch):
+    """A joining peer bootstrapping from a checkpoint gets the op-log
+    tail as a binary frame and converges."""
+    monkeypatch.setenv("AMTPU_WIRE_BINARY", "1")
+    monkeypatch.setenv("AMTPU_WIRE_MIN_OPS", "1")
+    from automerge_tpu.sync.hub import SyncHub
+    monkeypatch.setattr(SyncHub, "snapshot_min_changes", 16)
+    a, b, ca, cb, qa, qb = _pair()
+    doc = am.init("author")
+    doc = am.change(doc, lambda d: d.__setitem__("t", Text("x")))
+    for r in range(20):
+        doc = _bulk_edit(doc, f"r{r:02d}")
+    a.set_doc("doc", doc)
+    # prime the snapshot cache with a first joiner, then grow a tail
+    saw_ckpt_wire = [0]
+
+    def pump():
+        for _ in range(120):
+            if not qa and not qb:
+                return
+            while qa:
+                msg = qa.pop(0)
+                if msg.get("checkpoint") is not None \
+                        and msg.get("wire") is not None:
+                    saw_ckpt_wire[0] += 1
+                cb.receive_msg(msg)
+            while qb:
+                ca.receive_msg(qb.pop(0))
+
+    pump()
+    assert am.save(a.get_doc("doc")) == am.save(b.get_doc("doc"))
+    # a second fresh joiner after a small tail grew past the cache
+    a.set_doc("doc", _bulk_edit(a.get_doc("doc"), "tail"))
+    c_ds = DocSet()
+    qc, q_s3 = [], []
+    s3 = Connection(a, q_s3.append)
+    cc = Connection(c_ds, qc.append)
+    s3.open()
+    cc.open()
+    for _ in range(120):
+        if not qc and not q_s3 and not qa and not qb:
+            break
+        while q_s3:
+            msg = q_s3.pop(0)
+            if msg.get("checkpoint") is not None \
+                    and msg.get("wire") is not None:
+                saw_ckpt_wire[0] += 1
+            cc.receive_msg(msg)
+        while qc:
+            s3.receive_msg(qc.pop(0))
+        while qa:
+            cb.receive_msg(qa.pop(0))
+        while qb:
+            ca.receive_msg(qb.pop(0))
+    assert saw_ckpt_wire[0] >= 1, "no checkpoint+wire bootstrap seen"
+    assert am.save(a.get_doc("doc")) == am.save(c_ds.get_doc("doc"))
+
+
+# ---------------------------------------------------------------------------
+# channel: cached encodings, byte accounting
+# ---------------------------------------------------------------------------
+
+
+def test_channel_retransmits_cached_bytes():
+    sent = []
+    chan = ResilientChannel(sent.append, lambda p: None, base_rto=1)
+    frame = wf.WireFrame(_valid_frame_bytes())
+    msg = {"docId": "d", "clock": {}, "wire": frame}
+    chan.send(msg)
+    n0 = chan.stats["bytes_sent"]
+    assert n0 > frame.nbytes            # frame + envelope estimate
+    assert chan.stats["bytes_resent"] == 0
+    for _ in range(6):                  # no acks: retransmit fires
+        chan.tick()
+    assert chan.stats["retransmits"] >= 1
+    assert chan.stats["bytes_resent"] == chan.stats["retransmits"] * n0
+    # the retransmitted payload is the SAME object — bytes never
+    # re-encoded (and the frame's data is the same buffer)
+    payloads = [env["payload"] for env in sent if env["kind"] == "data"]
+    assert all(p is msg for p in payloads)
+    assert all(p["wire"].data is frame.data for p in payloads)
+
+
+def test_approx_msg_bytes_counts_frames():
+    from automerge_tpu.service.budget import approx_msg_bytes
+    frame = wf.WireFrame(_valid_frame_bytes())
+    with_frame = approx_msg_bytes({"docId": "d", "clock": {},
+                                   "wire": frame})
+    assert with_frame > frame.nbytes
+    assert approx_msg_bytes({"docId": "d", "clock": {}}) < frame.nbytes
+
+
+# ---------------------------------------------------------------------------
+# service-scale A/B parity
+# ---------------------------------------------------------------------------
+
+
+def _service_session(binary: str, base, n_clients=6, n_rounds=3):
+    from collections import deque
+
+    from automerge_tpu.service import ServiceConfig, SyncService, \
+        TenantBudget
+
+    os.environ["AMTPU_WIRE_BINARY"] = binary
+    svc = SyncService(ServiceConfig(default_budget=TenantBudget(
+        ops_per_tick=4096, bytes_per_tick=1 << 20, inbox_cap=64)))
+    svc.seed_doc("room", am.apply_changes(am.init("server"), base))
+
+    class Client:
+        def __init__(self, i):
+            self.tid = f"t{i}"
+            self.to_server, self.to_client = deque(), deque()
+            self.ds = DocSet()
+            self.ds.set_doc("room", am.apply_changes(
+                am.init(f"c-{i}"), base))
+            svc.connect(self.tid, "room", self.to_client.append)
+            self.chan = ResilientChannel(self.to_server.append, None)
+            self.conn = Connection(self.ds, self.chan.send)
+            self.chan._deliver = self.conn.receive_msg
+            self.conn.open()
+
+        def pump(self):
+            while self.to_server:
+                sess = svc.session(self.tid)
+                env = self.to_server.popleft()
+                if sess is not None:
+                    sess.on_wire(env)
+            while self.to_client:
+                self.chan.on_wire(self.to_client.popleft())
+            self.chan.tick()
+
+    clients = [Client(i) for i in range(n_clients)]
+
+    def settle():
+        for _ in range(400):
+            for c in clients:
+                c.pump()
+            svc.tick()
+            if svc.idle() and all(c.chan.idle and not c.to_server
+                                  and not c.to_client for c in clients):
+                return
+        raise AssertionError("service never quiesced")
+
+    settle()
+    rng = random.Random(42)
+    for r in range(n_rounds):
+        for c in clients:
+            text = "".join(chr(97 + rng.randrange(26)) for _ in range(40))
+            c.ds.set_doc("room", _bulk_edit(c.ds.get_doc("room"), text))
+            c.pump()
+        svc.tick()
+    settle()
+    server_doc = svc.room("room").doc_set.get_doc("room")
+    docs = [server_doc] + [c.ds.get_doc("room") for c in clients]
+    # within-leg convergence (history ORDER may differ per replica —
+    # replicas hear changes in different orders; content must not)
+    assert len({json.dumps(am.to_json(d), sort_keys=True)
+                for d in docs}) == 1, "service population diverged"
+    return ([am.save(d) for d in docs], am.to_json(server_doc)["t"],
+            svc.stats["admitted_ops"])
+
+
+@pytest.mark.slow
+def test_service_binary_vs_dict_byte_identical(monkeypatch):
+    """The same seeded service session (bulk text edits, grouped tick
+    admission, hub fan-out) commits byte-identical state across
+    AMTPU_WIRE_BINARY=0/1."""
+    prior = os.environ.get("AMTPU_WIRE_BINARY")
+    doc0 = am.change(am.init("origin"),
+                     lambda d: d.__setitem__("t", Text("seed")))
+    base = am.get_all_changes(doc0)
+    try:
+        save_b, text_b, ops_b = _service_session("1", base)
+        save_d, text_d, ops_d = _service_session("0", base)
+    finally:
+        if prior is None:
+            os.environ.pop("AMTPU_WIRE_BINARY", None)
+        else:
+            os.environ["AMTPU_WIRE_BINARY"] = prior
+    assert text_b == text_d
+    # per-replica byte identity across the wire A/B: replica i heard
+    # the same deliveries in the same tick order in both legs
+    assert save_b == save_d
+    assert ops_b == ops_d
+
+
+def test_combine_frames_preserves_dep_order():
+    """Cross-frame dep interning keys on ORDERED items: two tenants at
+    the same frontier with differently-ordered deps dicts must both
+    materialize with their sender's insertion order (review regression:
+    intern_deps' sorted-content collapse replaced the second frame's
+    order with the first's)."""
+    obj = "o"
+    ch_x = [{"actor": "X", "seq": 3, "deps": {},
+             "ops": [{"action": "ins", "obj": obj, "key": "_head",
+                      "elem": 9}]}]
+    ch_a = [{"actor": "a", "seq": 1, "deps": {"X": 3, "Y": 4},
+             "ops": [{"action": "ins", "obj": obj, "key": "_head",
+                      "elem": 1}]}]
+    ch_b = [{"actor": "b", "seq": 1, "deps": {"Y": 4, "X": 3},
+             "ops": [{"action": "ins", "obj": obj, "key": "_head",
+                      "elem": 1}]}]
+    # frames decoded from RAW bytes (no sender-side dict cache), the
+    # chaos-codec delivery shape
+    fa = wf.WireFrame(wf.encode_changes(ch_a))
+    fb = wf.WireFrame(wf.encode_changes(ch_b))
+    combined = wf.combine_frames([fa, fb])
+    out = wf.materialize_changes(combined.batch()) \
+        if combined._changes is None else combined.changes()
+    assert json.dumps(out) == json.dumps(ch_a + ch_b)
+    del ch_x
+
+
+def test_snapshot_cache_survives_repeated_tail_serves(monkeypatch):
+    """The hub's per-doc checkpoint cache gains a 4th slot (the cached
+    tail-frame encode) once a tail is served; later serves must keep
+    unpacking it (review regression: a fixed 3-target unpack crashed
+    the THIRD serve for a doc — the join-storm path the cache exists
+    for)."""
+    monkeypatch.setenv("AMTPU_WIRE_BINARY", "1")
+    monkeypatch.setenv("AMTPU_WIRE_MIN_OPS", "1")
+    from automerge_tpu.sync.hub import SyncHub
+    monkeypatch.setattr(SyncHub, "snapshot_min_changes", 8)
+    server = DocSet()
+    doc = am.change(am.init("author"),
+                    lambda d: d.__setitem__("t", Text("x")))
+    for r in range(12):
+        doc = _bulk_edit(doc, f"r{r}")
+    server.set_doc("doc", doc)
+    joins = []
+    for i in range(3):
+        # each joiner: fresh doc set, full handshake; between joiners
+        # the history grows a small tail past the cached capture
+        peer = DocSet()
+        q_s, q_c = [], []
+        s_conn = Connection(server, q_s.append)
+        c_conn = Connection(peer, q_c.append)
+        s_conn.open()
+        c_conn.open()
+        for _ in range(80):
+            if not q_s and not q_c:
+                break
+            while q_s:
+                c_conn.receive_msg(q_s.pop(0))
+            while q_c:
+                s_conn.receive_msg(q_c.pop(0))
+        assert am.save(peer.get_doc("doc")) == am.save(
+            server.get_doc("doc"))
+        joins.append(peer)
+        s_conn.close()
+        c_conn.close()
+        server.set_doc("doc", _bulk_edit(server.get_doc("doc"),
+                                         f"tail{i}"))
+    assert len(joins) == 3
