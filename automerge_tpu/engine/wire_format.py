@@ -62,7 +62,7 @@ from ..resilience.errors import ProtocolError
 __all__ = ["WireFormatError", "WireFrame", "encode_batch", "encode_changes",
            "decode", "materialize_changes", "split_outgoing",
            "combine_frames", "as_frame", "wire_binary_enabled",
-           "wire_min_ops"]
+           "wire_min_ops", "validate_trace_context"]
 
 MAGIC = b"AMTPUWIRE1\n"
 FORMAT = "automerge-tpu-wire"
@@ -259,7 +259,42 @@ def _wire_dep_groups(deps_list, local_rank: dict, n: int):
     return dgid, g_off, np.asarray(ga, np.int32), np.asarray(gs, np.int64)
 
 
-def encode_batch(batch, deps=None) -> bytes:
+def validate_trace_context(trace):
+    """Schema-check one lineage trace-context value (the optional
+    ``trace`` manifest entry / dict-wire field, INTERNALS §18.2):
+    ``[[actor, seq, origin_ns, origin_site], ...]``, bounded.  Raises
+    the typed :class:`WireFormatError` (a ``ProtocolError``) on any
+    malformation — context must never be able to crash a decoder, and
+    old decoders that predate it simply never look."""
+    from ..obs.lineage import MAX_CONTEXT_ENTRIES
+    if not isinstance(trace, list) or len(trace) > MAX_CONTEXT_ENTRIES:
+        raise WireFormatError("malformed trace context: must be a "
+                              "bounded list of [actor, seq, origin_ns, "
+                              "origin_site] entries")
+    for ent in trace:
+        if not isinstance(ent, list) or len(ent) != 4:
+            raise WireFormatError(
+                "malformed trace-context entry: expected [actor, seq, "
+                f"origin_ns, origin_site], got {ent!r}")
+        actor, seq, t0, site = ent
+        if not isinstance(actor, str) or not actor:
+            raise WireFormatError("trace-context actor must be a "
+                                  "non-empty string")
+        if not isinstance(seq, int) or isinstance(seq, bool) \
+                or not 1 <= seq <= INT32_MAX:
+            raise WireFormatError("trace-context seq outside the int32 "
+                                  "envelope")
+        if not isinstance(t0, int) or isinstance(t0, bool) \
+                or not 0 <= t0 < 2**63:
+            raise WireFormatError("trace-context origin_ns must be a "
+                                  "non-negative int64")
+        if not isinstance(site, str):
+            raise WireFormatError("trace-context origin_site must be a "
+                                  "string")
+    return trace
+
+
+def encode_batch(batch, deps=None, trace=None) -> bytes:
     """Serialize an op-columnar batch (with its per-change columns) to
     one byte-deterministic ``AMTPUWIRE1`` frame.
 
@@ -268,7 +303,11 @@ def encode_batch(batch, deps=None) -> bytes:
     ``MapChangeBatch.from_changes`` always are. ``deps`` optionally
     carries the ORIGINAL per-change deps dicts (pre ``intern_deps``
     content collapse) so the wire preserves their exact insertion
-    order."""
+    order. ``trace`` optionally attaches lineage trace context
+    (INTERNALS §18.2) as a manifest entry: version-tolerant — decoders
+    that predate it ignore unknown manifest keys — and covered by the
+    manifest hash, so a flipped bit in the context is a typed rejection
+    like any other corruption."""
     from .columnar import MapChangeBatch, TextChangeBatch
     from .wire_columns import change_columns
     cols = change_columns(batch)
@@ -308,10 +347,12 @@ def encode_batch(batch, deps=None) -> bytes:
     manifest = {"kind": kind, "obj_id": batch.obj_id,
                 "n_changes": batch.n_changes, "n_ops": batch.n_ops,
                 "n_change_actors": cols.n_change_actors}
+    if trace:
+        manifest["trace"] = validate_trace_context(trace)
     return _pack(manifest, arrays)
 
 
-def encode_changes(changes, obj_id: str = None) -> bytes:
+def encode_changes(changes, obj_id: str = None, trace=None) -> bytes:
     """Encode wire-dict changes (all frame-scoped, one object) to a
     frame. Raises ``WireFormatError`` when out of scope — callers that
     want graceful degradation use :func:`split_outgoing`."""
@@ -324,7 +365,7 @@ def encode_changes(changes, obj_id: str = None) -> bytes:
             f"changes target {obj!r}, frame requested for {obj_id!r}")
     cls = TextChangeBatch if kind == "text" else MapChangeBatch
     return encode_batch(cls.from_changes(changes, obj),
-                        deps=[c["deps"] for c in changes])
+                        deps=[c["deps"] for c in changes], trace=trace)
 
 
 # -- outbound scope classification ------------------------------------------
@@ -455,12 +496,14 @@ def change_in_scope(change):
     return kind, obj
 
 
-def split_outgoing(changes, min_ops: int = None):
+def split_outgoing(changes, min_ops: int = None, trace=None):
     """Peel the longest frame-scoped suffix off an outbound change list:
     -> (dict_prefix, frame_bytes_or_None). The common history shape —
     one creation change followed by a long single-object tail — becomes
     one small dict prefix plus one frame; fully out-of-scope payloads
-    come back unchanged with no frame."""
+    come back unchanged with no frame. ``trace`` (lineage context for
+    the WHOLE change list, prefix included) rides the frame's
+    manifest."""
     if min_ops is None:
         min_ops = wire_min_ops()
     if not isinstance(changes, list) or not changes:
@@ -487,10 +530,11 @@ def split_outgoing(changes, min_ops: int = None):
     cls = TextChangeBatch if kind == "text" else MapChangeBatch
     try:
         frame = encode_batch(cls.from_changes(suffix, obj),
-                             deps=[c["deps"] for c in suffix])
+                             deps=[c["deps"] for c in suffix],
+                             trace=trace)
     except (ValueError, OverflowError, TypeError):
         return changes, None             # stay on the dict wire
-    return changes[:start], WireFrame(frame, changes=suffix)
+    return changes[:start], WireFrame(frame, changes=suffix, trace=trace)
 
 
 # ---------------------------------------------------------------------------
@@ -563,6 +607,13 @@ def decode(data):
     _require(isinstance(n, int) and n >= 1, "bad n_changes")
     _require(isinstance(m, int) and m >= 1, "bad n_ops")
     _require(isinstance(nca, int) and 1 <= nca <= n, "bad n_change_actors")
+    # optional lineage trace context (INTERNALS §18.2): absent on frames
+    # from peers that predate it (or run lineage off) — decode is
+    # unconditional and tolerant either way, but a PRESENT context must
+    # be schema-clean (typed rejection, like every other section)
+    trace = manifest.get("trace")
+    if trace is not None:
+        validate_trace_context(trace)
 
     local_actors = _json_list(sections, "local_actors")
     _require(local_actors is not None, "missing section 'local_actors'")
@@ -705,6 +756,7 @@ def decode(data):
         all_seq1=bool((seq_list == 1).all()),
         distinct_actors=bool(nca == n))
     batch._change_columns = cols
+    batch._trace = trace
     return batch
 
 
@@ -794,15 +846,16 @@ class WireFrame:
     materializes the canonical dicts once (the quarantine/park and
     history paths)."""
 
-    __slots__ = ("data", "_batch", "_changes")
+    __slots__ = ("data", "_batch", "_changes", "_trace")
 
-    def __init__(self, data: bytes, batch=None, changes=None):
+    def __init__(self, data: bytes, batch=None, changes=None, trace=None):
         if not isinstance(data, (bytes, bytearray, memoryview)):
             raise WireFormatError(
                 f"wire frame must be bytes, got {type(data).__name__}")
         self.data = bytes(data)
         self._batch = batch
         self._changes = changes
+        self._trace = trace
 
     # -- cheap introspection (decodes on first use) --------------------
 
@@ -819,6 +872,17 @@ class WireFrame:
         from .columnar import TextChangeBatch
         return "text" if isinstance(self.batch(), TextChangeBatch) \
             else "map"
+
+    @property
+    def trace(self):
+        """Lineage trace context carried in the frame manifest, or None
+        (absent / frame not yet decoded — reads never force a decode:
+        the receive side decodes via validate_msg before any hop
+        runs)."""
+        if self._trace is not None:
+            return self._trace
+        b = self._batch
+        return getattr(b, "_trace", None) if b is not None else None
 
     @property
     def n_changes(self) -> int:
@@ -993,6 +1057,17 @@ def combine_frames(frames):
     combined.data = b""                 # synthetic: never retransmitted
     combined._batch = batch
     combined._changes = None
+    # merged lineage context, deduped by change identity (N tenants'
+    # frames may carry overlapping sampled entries)
+    merged_trace: list = []
+    seen_trace: set = set()
+    for f in frames:
+        for ent in f.trace or ():
+            key = (ent[0], ent[1])
+            if key not in seen_trace:
+                seen_trace.add(key)
+                merged_trace.append(ent)
+    combined._trace = merged_trace or None
     cached = [f._changes for f in frames]
     if all(c is not None for c in cached):
         combined._changes = [c for sub in cached for c in sub]

@@ -49,6 +49,12 @@ Category taxonomy (full schema in docs/INTERNALS.md §11):
           join / rejoin / protocol_error events (INTERNALS §13)
   ckpt    checkpoint writer (grab spans, conflicts, degrades)
   bench   harness-side regions (stream reps, explicit device waits)
+  lineage per-change provenance hops (obs/lineage.py, INTERNALS §18):
+          origin / chan/send / chan/retransmit / hub/flush / svc/admit
+          / svc/defer / svc/shed / quar/park / quar/release / quar/pen
+          / plan/stacked / commit / ckpt/adopt — emitted here only when
+          BOTH tracing and lineage sampling are on; the ledger itself
+          is independent of the trace ring
 """
 
 from __future__ import annotations
@@ -247,3 +253,8 @@ def write_trace(path: str, since_ns: int = 0) -> str:
 # needs no code path to remember to call enable() before the first span
 if os.environ.get("AMTPU_TRACE", "0") not in ("", "0"):
     enable()
+
+# the change-lineage tier (its own module flag + AMTPU_LINEAGE_RATE env
+# bootstrap); imported last so `obs` is fully initialized when lineage's
+# emit path reaches back for the trace-ring flag
+from . import lineage  # noqa: E402,F401

@@ -30,6 +30,7 @@ import operator
 import numpy as np
 
 from .. import obs
+from ..obs import lineage
 from .errors import PeerDeadError, ProtocolError
 
 ENVELOPE_KINDS = ("data", "ack")
@@ -100,9 +101,12 @@ class ResilientChannel:
                  base_rto: int = 2, max_rto: int = 16,
                  recv_window: int = RECV_WINDOW,
                  max_retries: int = MAX_RETRIES,
-                 on_dead=None, admit=None):
+                 on_dead=None, admit=None, label: str = None):
         self._send_raw = send_raw
         self._deliver = deliver
+        #: lineage site label for chan/* hops (the service names tenant
+        #: channels after the tenant); None -> anonymous hops
+        self.label = label
         self._rng = np.random.default_rng(seed)
         self._base_rto = base_rto
         self._max_rto = max_rto
@@ -144,6 +148,12 @@ class ResilientChannel:
                               "rto": self._base_rto, "tries": 0}
         self.stats["sent"] += 1
         self.stats["bytes_sent"] += nbytes
+        if lineage.ENABLED:
+            # extra=seq: one send hop per envelope carrying the change —
+            # a dup-delivered envelope dedups, a distinct envelope
+            # (e.g. a re-extracted resend on a fresh channel) records
+            for a, s in lineage.payload_keys(payload):
+                lineage.hop(a, s, "chan/send", site=self.label, extra=seq)
         self._send_raw({"kind": "data", "seq": seq,
                         "ack": self._recv_high, "payload": payload})
 
@@ -179,6 +189,13 @@ class ResilientChannel:
             if obs.ENABLED:
                 obs.event("chan", "retransmit",
                           args={"seq": seq, "rto": entry["rto"]})
+            if lineage.ENABLED:
+                # a retransmission adds a DISTINCT chan/retransmit hop
+                # per attempt (extra carries the attempt number) — never
+                # a duplicate chain, never a deduped-away repeat
+                for a, s in lineage.payload_keys(entry["payload"]):
+                    lineage.hop(a, s, "chan/retransmit", site=self.label,
+                                extra=(seq, entry["tries"]))
             self._send_raw({"kind": "data", "seq": seq,
                             "ack": self._recv_high,
                             "payload": entry["payload"]})

@@ -30,6 +30,7 @@ from __future__ import annotations
 import logging
 
 from .. import obs
+from ..obs import lineage
 from .errors import ProtocolError
 from .quarantine import DEFAULT_CAPACITY, QuarantineQueue
 from .validation import prevalidated, validate_changes
@@ -63,6 +64,8 @@ def absorb_msg(doc_set, msg: dict):
     Connection, or a hub peer removed mid-flight: absorb inbound changes
     through the shared gate, never write to the (torn-down) transport.
     `msg` must already be validated. Returns the doc."""
+    if lineage.ENABLED and msg.get("trace"):
+        lineage.adopt(msg["trace"])
     if msg.get("wire") is not None:
         from ..engine.wire_format import as_frame
         return inbound_gate(doc_set).deliver_wire(
@@ -145,6 +148,11 @@ class InboundGate:
         state (the parity contract, tests/test_wire_format.py)."""
         from ..engine.wire_format import as_frame, combine_frames
         frames = [(as_frame(f).validate(), s) for f, s in frames]
+        if lineage.ENABLED:
+            for f, _s in frames:
+                ctx = f.trace
+                if ctx:
+                    lineage.adopt(ctx)
         if not changes and frames and doc_id not in self._busy \
                 and not self.quarantined(doc_id):
             delivery = combine_frames([f for f, _ in frames]) \
@@ -294,6 +302,12 @@ class InboundGate:
         self._n_parked += len(q) - before
         if self._n_parked > self.stats["peak_parked"]:
             self.stats["peak_parked"] = self._n_parked
+        if lineage.ENABLED:
+            # one park hop per (change, site) — a requeue dedups, so
+            # the quarantine dwell (park -> release) spans the WHOLE
+            # parked period, not the last requeue
+            lineage.hop(change["actor"], change["seq"], "quar/park",
+                        site=lineage.site_of(self._doc_set), doc=doc_id)
 
     def _drain_loop(self, doc_id: str, incoming, senders=None):
         """Drain until quiescent: a change handler may feed further
@@ -356,6 +370,14 @@ class InboundGate:
                        sender=senders.get(id(change)))
         if not ready:
             return self._doc_set.get_doc(doc_id), 0
+        if lineage.ENABLED and drained_keys:
+            # release hops BEFORE the apply, so a completed chain reads
+            # park -> release -> commit (the commit hop is the apply's)
+            site = lineage.site_of(self._doc_set)
+            for c in ready:
+                if (c["actor"], c["seq"]) in drained_keys:
+                    lineage.hop(c["actor"], c["seq"], "quar/release",
+                                site=site, doc=doc_id)
         try:
             doc = self._apply(doc_id, ready)
         except ProtocolError:
@@ -443,4 +465,11 @@ class InboundGate:
         self.stats["applied_ops"] += (
             int(changes.n_ops) if hasattr(changes, "n_ops")
             else sum(len(c.get("ops") or ()) for c in changes))
+        if lineage.ENABLED:
+            # THE visibility hop: the change is committed on this
+            # replica's document — what end-to-end visibility latency
+            # measures against the chain's origin timestamp
+            lineage.hop_delivery(changes, "commit",
+                                 site=lineage.site_of(self._doc_set),
+                                 doc=doc_id)
         return doc

@@ -225,6 +225,15 @@ def validate_msg(msg) -> dict:
             # (in-process senders already pass WireFrame objects and are
             # untouched)
             msg["wire"] = frame
+    trace = msg.get("trace")
+    if trace is not None:
+        # optional lineage trace context (INTERNALS §18.2): peers that
+        # predate it never send or read it; a PRESENT value must be
+        # schema-clean — WireFormatError is a ProtocolError, so a
+        # malformed context degrades per-tenant like any other
+        # malformed message, never crashes the tick
+        from ..engine.wire_format import validate_trace_context
+        validate_trace_context(trace)
     ckpt = msg.get("checkpoint")
     if ckpt is not None and not isinstance(ckpt, str):
         raise ProtocolError(f"message `checkpoint` must be a base64 string, "

@@ -49,6 +49,7 @@ from collections import deque
 from contextlib import ExitStack, nullcontext
 
 from .. import obs
+from ..obs import lineage
 from ..obs.telemetry import Telemetry
 from ..resilience.channel import ResilientChannel
 from ..resilience.errors import ProtocolError
@@ -78,6 +79,10 @@ class Room:
         self.room_id = room_id
         self.lane = lane
         self.doc_set = DocSet()
+        # the room's lineage replica-site label: commit hops recorded by
+        # this room's gate carry it, so a change's chain names WHICH
+        # server replica made it visible (INTERNALS §18.1)
+        self.doc_set._lineage_site = f"svc:{room_id}"
         self.gate = InboundGate(
             self.doc_set, capacity=config.quarantine_capacity,
             global_capacity=config.quarantine_global_capacity)
@@ -274,7 +279,7 @@ class SyncService:
             base_rto=cfg.base_rto, max_rto=cfg.max_rto,
             recv_window=cfg.recv_window, max_retries=cfg.max_retries,
             on_dead=lambda ch, s=sess: self._mark_dead(s, "retransmit_cap"),
-            admit=sess._admit_frame)
+            admit=sess._admit_frame, label=tenant_id)
         self._tenants[tenant_id] = sess
         self._order.append(tenant_id)
         room.tenants.add(tenant_id)
@@ -367,6 +372,12 @@ class SyncService:
                     if backlog:
                         shed += backlog
                         sess.stats["shed"] += backlog
+                        if lineage.ENABLED:
+                            # head of the shed backlog only (bounded)
+                            for a, s in lineage.payload_keys(
+                                    sess.inbox[0][0]):
+                                lineage.hop(a, s, "svc/shed",
+                                            site=sess.tenant_id)
                         self._starve(sess)
                     continue
                 admitted = self._admit_tenant(sess, groups)
@@ -508,6 +519,14 @@ class SyncService:
                 # must not inflate the stat N times over
                 sess.stats["deferred"] += 1
                 self.stats["deferrals"] += 1
+                if lineage.ENABLED:
+                    # the HEAD deferred message only (bounded: never an
+                    # O(backlog) walk) — its sampled changes gain one
+                    # svc/defer hop whose dwell ends at the eventual
+                    # svc/admit, i.e. the full deferral wait
+                    for a, s in lineage.payload_keys(msg):
+                        lineage.hop(a, s, "svc/defer",
+                                    site=sess.tenant_id)
                 self._note("defer", tenant=sess.tenant_id,
                            backlog=len(sess.inbox))
                 if obs.ENABLED:
@@ -533,6 +552,14 @@ class SyncService:
         room = self._rooms[sess.room_id]
         changes = msg.get("changes")
         wire = msg.get("wire")
+        if lineage.ENABLED:
+            # adopt the tenant's origin context before grouping (frames'
+            # manifest context is adopted again at the gate — idempotent)
+            if msg.get("trace"):
+                lineage.adopt(msg["trace"])
+            for a, s in lineage.payload_keys(msg):
+                lineage.hop(a, s, "svc/admit", site=sess.tenant_id,
+                            doc=msg.get("docId"))
         if (changes or wire is not None) and msg.get("checkpoint") is None \
                 and not msg.get("noSnapshot"):
             # strip changes/frames for the cross-tenant per-doc group;
@@ -768,9 +795,16 @@ class SyncService:
                            for item in room.gate.quarantine_items()[:64]],
             }
         lag_table = self.replication_lag()
+        # the per-change lineage block (INTERNALS §18.4): the K
+        # most-stuck sampled changes WITH their full hop chains — a
+        # failed soak names the hop a change is stuck on, not just a
+        # byte diff. Omitted entirely when lineage never ran.
+        lin = lineage.postmortem(k=8) if lineage.ledger() is not None \
+            else None
         return {
             "schema": "amtpu-postmortem-v1",
             "tick": self._tick_no,
+            **({"lineage": lin} if lin is not None else {}),
             "config": {"tick_budget_ms": cfg.tick_budget_ms,
                        "heartbeat_ticks": cfg.heartbeat_ticks,
                        "suspect_grace_ticks": cfg.suspect_grace_ticks,
@@ -846,6 +880,10 @@ class SyncService:
                 [({"tenant": tid, "room": v["room"]}, v["ticks"])
                  for tid, v in lag]))
         fams += prom.telemetry_families(self.telemetry, "amtpu_svc")
+        if lineage.ledger() is not None:
+            # per-stage dwell histograms + end-to-end visibility
+            # quantiles for the sampled change population (§18.3)
+            fams += lineage.families("amtpu_lineage")
         if obs.ENABLED and obs.telemetry() is not None:
             fams += prom.telemetry_families(obs.telemetry(), "amtpu_obs")
         return prom.expose(fams)

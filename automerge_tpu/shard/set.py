@@ -39,6 +39,7 @@ per-room — shard-LOCAL — so adding lanes never grows a global clock.
 from __future__ import annotations
 
 from .. import obs
+from ..obs import lineage
 from ..obs.telemetry import Telemetry
 from ..resilience.inbound import _ready_under
 from ..resilience.quarantine import QuarantineQueue
@@ -156,6 +157,9 @@ class ShardedDocSet:
         for ch in changes:
             q.park(ch)
             self.stats["parked"] += 1
+            if lineage.ENABLED:
+                lineage.hop(ch["actor"], ch["seq"], "quar/park",
+                            site="router", doc=doc_id)
         total = sum(len(q) for q in self._quarantine.values())
         if total > self.stats["peak_parked"]:
             self.stats["peak_parked"] = total
@@ -181,6 +185,9 @@ class ShardedDocSet:
                 # nothing may apply until the new shard owns it
                 self._migrating[doc_id].append(changes)
                 self.stats["migration_parked"] += len(changes)
+                if lineage.ENABLED:
+                    lineage.hop_delivery(changes, "quar/pen",
+                                         site="router", doc=doc_id)
                 continue
             lane = self.lane_of(doc_id)
             doc = lane.docs.get(doc_id)
@@ -193,6 +200,8 @@ class ShardedDocSet:
         admitted = 0
         for idx in sorted(per_lane):
             admitted += self.lanes[idx].ingest(per_lane[idx])
+            if lineage.ENABLED:
+                self._hop_committed(idx, per_lane[idx])
         admitted += self._drain_quarantine()
         self.stats["rounds"] += 1
         self.stats["admitted_ops"] += admitted
@@ -225,10 +234,23 @@ class ShardedDocSet:
                 if ready:
                     per_lane.setdefault(lane.index, {})[doc_id] = ready
                     self.stats["released"] += len(ready)
+                    if lineage.ENABLED:
+                        lineage.hop_delivery(ready, "quar/release",
+                                             site="router", doc=doc_id)
             for idx in sorted(per_lane):
                 admitted += self.lanes[idx].ingest(per_lane[idx])
+                if lineage.ENABLED:
+                    self._hop_committed(idx, per_lane[idx])
                 progress = True
         return admitted
+
+    def _hop_committed(self, lane_idx: int, deliveries: dict):
+        """Visibility hops for a lane ingest: every sampled change the
+        router just handed the lane is now committed on that lane's
+        replica (one hop per change per lane site)."""
+        site = f"lane{lane_idx}"
+        for doc_id, changes in deliveries.items():
+            lineage.hop_delivery(changes, "commit", site=site, doc=doc_id)
 
     # -- migration ------------------------------------------------------
 

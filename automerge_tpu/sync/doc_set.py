@@ -89,6 +89,17 @@ class DocSet:
         from ..resilience.inbound import inbound_gate
         doc = restore_doc_or_replay(checkpoint, fallback_changes)
         self.set_doc(doc_id, doc)
+        from ..obs import lineage
+        if lineage.ENABLED:
+            # snapshot-bootstrap visibility: every sampled chain the
+            # restored clock covers became visible on this replica
+            # INSIDE the bundle (it never re-crossed the wire) — the
+            # ckpt/adopt hop keeps those chains complete here
+            state = Frontend.get_backend_state(doc)
+            if state is not None:
+                lineage.adopt_clock(dict(state.clock),
+                                    site=lineage.site_of(self),
+                                    doc=doc_id)
         gate = inbound_gate(self)
         if wire is not None:
             gate.deliver_wire(doc_id, [(wire, None)],

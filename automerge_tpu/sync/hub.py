@@ -20,6 +20,7 @@ from contextlib import contextmanager
 from ..backend import default as Backend
 from .. import frontend as Frontend
 from .. import obs
+from ..obs import lineage
 from .._common import less_or_equal
 from ..resilience.inbound import absorb_msg, inbound_gate
 from ..resilience.validation import validate_msg
@@ -233,6 +234,7 @@ class SyncHub:
         binary = wire_binary_enabled()
         extracted: dict = {}
         encoded: dict = {}
+        contexts: dict = {}   # same (doc, clock) key -> trace context
         for peer_id, doc_id in self._matrix.pending():
             if peer_id not in self._peers:
                 continue
@@ -261,13 +263,30 @@ class SyncHub:
                 continue
             self._matrix.update_theirs(peer_id, doc_id, clock)
             self._advertised[(peer_id, doc_id)] = clock
+            ctx = None
+            if lineage.ENABLED:
+                # one context derivation per (doc, clock) group — the
+                # same sharing discipline as the extraction/encode — and
+                # one hub/flush hop per (sampled change, peer): the hop
+                # chain shows which peers this flush fanned out to
+                if key in contexts:
+                    ctx = contexts[key]
+                else:
+                    ctx = contexts[key] = lineage.context_for(changes)
+                lineage.hop_delivery(changes, "hub/flush", site=peer_id,
+                                     doc=doc_id)
             msg = {"docId": doc_id, "clock": clock, "changes": changes}
+            if ctx:
+                msg["trace"] = ctx
             if binary:
                 parts = encoded.get(key)
                 if parts is None:
-                    parts = encoded[key] = split_outgoing(changes)
+                    parts = encoded[key] = split_outgoing(changes,
+                                                          trace=ctx)
                 prefix, frame = parts
                 if frame is not None:
+                    # the frame manifest carries the full context
+                    # (prefix changes included); no msg-level field
                     msg = {"docId": doc_id, "clock": clock}
                     if prefix:
                         msg["changes"] = prefix
@@ -293,6 +312,10 @@ class SyncHub:
                         msg["wire"] = tail_parts[1]
                     else:
                         msg["changes"] = tail
+                        if lineage.ENABLED:
+                            tail_ctx = lineage.context_for(tail)
+                            if tail_ctx:
+                                msg["trace"] = tail_ctx
             self._peers[peer_id].send_msg(msg)
 
     def _doc_checkpoint(self, doc_id: str, state):
@@ -342,7 +365,9 @@ class SyncHub:
                 tail_parts = cached[3][1]
             else:
                 from ..engine.wire_format import split_outgoing
-                tail_parts = split_outgoing(tail)
+                tail_ctx = lineage.context_for(tail) \
+                    if lineage.ENABLED else None
+                tail_parts = split_outgoing(tail, trace=tail_ctx)
                 entry = (state.history_len, tail_parts)
                 if len(cached) > 3:
                     cached[3] = entry
@@ -371,6 +396,12 @@ class SyncHub:
             # believed clocks, document state, or the doc clock
             msg = validate_msg(msg)
         doc_id = msg["docId"]
+        if lineage.ENABLED and msg.get("trace"):
+            # adopt the sender's origin context BEFORE any application,
+            # so the commit hops this delivery triggers stitch onto the
+            # right origin timestamps (frame-borne context is adopted by
+            # the gate's deliver_wire)
+            lineage.adopt(msg["trace"])
         if peer_id not in self._peers:
             # late in-flight message for a removed peer (shared contract
             # with the closed-Connection path)

@@ -1358,6 +1358,290 @@ def main_wire():
     return 0
 
 
+LINEAGE_TIMED_REGION = (
+    "change-lineage tracing A/B at service scale (obs/lineage.py, "
+    "INTERNALS §18): the cfg11-shaped seeded service session — N tenant "
+    "sessions over lossless queue transports into one tick-scheduled "
+    "SyncService, every client appending a bulk text run per round — "
+    "run with lineage disabled and with deterministic 1/RATE sampling "
+    "(AMTPU_LINEAGE_RATE). dt = first edit -> full quiescence; value = "
+    "admitted wire ops/s of the SAMPLED leg (the feature-on number). "
+    "overhead_pct = (off - sampled) / off * 100 between the paired "
+    "legs. The off leg also pairs against an identical second disabled "
+    "leg (off_ratio_vs_baseline, the cfg11-paired control per the "
+    "3-attempt contention discipline): the DISABLED-path <=1% claim "
+    "itself is structural — one module-flag check per hop site, timed "
+    "and bounded in tests/test_lineage.py — and this ratio guards the "
+    "committed rows against a regression that makes the off path do "
+    "work. Sampled-leg machine checks, asserted in-run: committed "
+    "per-replica save bytes byte-identical to the off leg (tracing "
+    "must never perturb state), every sampled chain the server "
+    "committed is COMPLETE (origin -> commit on the server and every "
+    "client replica of its room), and visibility quantiles come from "
+    "the ledger's own log-bucket telemetry (conservative upper "
+    "bounds).")
+
+
+def measure_lineage(n_sessions: int = 48, room_size: int = 8,
+                    n_rounds: int = 4, chars_per_round: int = 1024,
+                    rate: int = 64, quick: bool = False) -> dict:
+    """cfg14: lineage off/sampled A/B on the cfg11 service session
+    (ISSUE 14).
+
+    Machine checks, asserted in-run: byte-identical per-replica
+    committed state across the legs; >= 1 sampled chain; 100% complete
+    origin->commit chains on the clean path; sampled overhead <= 5%."""
+    import gc
+    from collections import deque
+
+    import automerge_tpu as am
+    from automerge_tpu import Connection, DocSet, Text
+    from automerge_tpu.obs import lineage
+    from automerge_tpu.resilience import ResilientChannel
+    from automerge_tpu.service import ServiceConfig, SyncService, \
+        TenantBudget
+
+    if quick:
+        n_sessions, n_rounds = 16, 2
+    n_rooms = max(1, n_sessions // room_size)
+
+    bases = {}
+    for g in range(n_rooms):
+        rid = f"room-{g}"
+        doc0 = am.change(am.init(f"{rid}-origin"), lambda d: (
+            d.__setitem__("t", Text("svc"))))
+        bases[rid] = am.get_all_changes(doc0)
+
+    def leg(lineage_rate):
+        """One full seeded session; lineage_rate None = disabled."""
+        was_enabled = lineage.ENABLED
+        if lineage_rate is None:
+            lineage.disable()
+        else:
+            lineage.enable(rate=lineage_rate)
+            lineage.clear()
+        try:
+            svc = SyncService(ServiceConfig(default_budget=TenantBudget(
+                ops_per_tick=8192, bytes_per_tick=4 << 20, inbox_cap=64)))
+            for g in range(n_rooms):
+                rid = f"room-{g}"
+                svc.seed_doc(rid, am.apply_changes(am.init(f"server-{g}"),
+                                                   bases[rid]))
+
+            class Client:
+                def __init__(self, i):
+                    self.tid = f"t{i}"
+                    rid = self.rid = f"room-{i % n_rooms}"
+                    self.to_server, self.to_client = deque(), deque()
+                    self.ds = DocSet()
+                    self.ds._lineage_site = self.tid
+                    self.ds.set_doc(rid, am.apply_changes(
+                        am.init(f"c-{i}"), bases[rid]))
+                    svc.connect(self.tid, rid, self.to_client.append)
+                    self.chan = ResilientChannel(self.to_server.append,
+                                                 None, label=self.tid)
+                    self.conn = Connection(self.ds, self.chan.send)
+                    self.chan._deliver = self.conn.receive_msg
+                    self.conn.open()
+
+                def pump(self):
+                    while self.to_server:
+                        sess = svc.session(self.tid)
+                        env = self.to_server.popleft()
+                        if sess is not None:
+                            sess.on_wire(env)
+                    while self.to_client:
+                        self.chan.on_wire(self.to_client.popleft())
+                    self.chan.tick()
+
+            clients = [Client(i) for i in range(n_sessions)]
+
+            def settle(max_ticks=1200):
+                for _ in range(max_ticks):
+                    for c in clients:
+                        c.pump()
+                    svc.tick()
+                    if svc.idle() and all(
+                            c.chan.idle and not c.to_server
+                            and not c.to_client for c in clients):
+                        return
+                raise AssertionError(
+                    f"lineage bench never quiesced: {svc.metrics()}")
+
+            settle()                   # join handshake off the clock
+            ops0 = svc.stats["admitted_ops"]
+            rng = __import__("random").Random(1414)
+            gc.collect()
+            t0 = time.perf_counter()
+            for _r in range(n_rounds):
+                for c in clients:
+                    text = "".join(chr(97 + rng.randrange(26))
+                                   for _ in range(chars_per_round))
+                    c.ds.set_doc(c.rid, am.change(
+                        c.ds.get_doc(c.rid),
+                        lambda d, t=text: d["t"].insert_at(0, *list(t))))
+                    c.pump()
+                svc.tick()
+            settle()
+            dt = time.perf_counter() - t0
+            admitted = svc.stats["admitted_ops"] - ops0
+            assert admitted >= n_sessions * n_rounds * chars_per_round, (
+                admitted, svc.metrics())
+            states = []
+            for g in range(n_rooms):
+                rid = f"room-{g}"
+                states.append(am.save(svc.room(rid).doc_set.get_doc(rid)))
+            for c in clients:
+                states.append(am.save(c.ds.get_doc(c.rid)))
+            ledger_view = None
+            if lineage_rate is not None:
+                led = lineage.ledger()
+                room_clients = {f"room-{g}": set() for g in range(n_rooms)}
+                for c in clients:
+                    room_clients[c.rid].add(c.tid)
+                total = complete = 0
+                for ch in led.chains():
+                    vis = led.visible_sites(ch)
+                    for rid in {d for d in ch["docs"]
+                                if d in room_clients}:
+                        if f"svc:{rid}" not in vis:
+                            continue
+                        origin = ch["origin_site"] or ""
+                        expected = {f"svc:{rid}"} | room_clients[rid]
+                        if origin.startswith("c-"):
+                            # client actor c-{i} maps to tenant t{i}
+                            expected.discard("t" + origin[2:])
+                        total += 1
+                        complete += (ch["origin_ns"] is not None
+                                     and expected <= vis)
+                ledger_view = {
+                    "sampled_chains": led.n_chains,
+                    "commit_population": total,
+                    "complete": complete,
+                    "hops_per_sampled_change": round(
+                        led.stats["hops_recorded"]
+                        / max(1, led.stats["chains_started"]), 2),
+                    "visibility_p50_ms": led.visibility_ms(0.50),
+                    "visibility_p99_ms": led.visibility_ms(0.99),
+                    "max_quarantine_dwell_ms":
+                        led.max_dwell_ms("quar/park"),
+                    "max_defer_dwell_ms": led.max_dwell_ms("svc/defer"),
+                    "stats": dict(led.stats),
+                }
+            return {
+                "ops_per_sec": round(admitted / dt),
+                "admitted_ops": admitted,
+                "dt_s": round(dt, 4),
+                "p99_tick_ms": svc.metrics()["p99_tick_ms"],
+            }, states, ledger_view
+        finally:
+            if was_enabled:
+                lineage.enable()
+            else:
+                lineage.disable()
+
+    leg(None)                       # untimed warmup: jit compiles
+    # paired disabled control, then (off, sampled) pairs under the
+    # PR-4/PR-12 3-attempt contention discipline: both the 0.99
+    # disabled-control ratio and the 5% sampled-overhead bar compare
+    # single legs on a shared box, so one gc/scheduler swing must not
+    # fail a bar a real regression is meant to trip — the best PAIRED
+    # attempt is recorded, never a best-of mixed across attempts
+    paired, _s, _l = leg(None)
+    off = sampled = ledger_view = None
+    off_ratio = overhead_pct = None
+    best_key = None
+    for _attempt in range(3):
+        off_try, states_off, _l = leg(None)
+        sampled_try, states_sampled, lv_try = leg(rate)
+        assert states_off == states_sampled, \
+            "the sampled leg committed different bytes than the off " \
+            "leg — lineage tracing must never perturb document state"
+        ov_try = max(0.0, 100.0 * (off_try["ops_per_sec"]
+                                   - sampled_try["ops_per_sec"])
+                     / max(off_try["ops_per_sec"], 1))
+        ratio_try = off_try["ops_per_sec"] / max(paired["ops_per_sec"], 1)
+        # an attempt that meets BOTH committed-row bars beats any that
+        # misses one, regardless of raw overhead (a pair with overhead
+        # 2% but a gc-swung ratio 0.97 must not shadow a 4%/1.00 pair —
+        # slo_gate enforces both on the row); within a class, lowest
+        # overhead wins
+        key = (not (ov_try <= 5.0 and ratio_try >= 0.99), ov_try)
+        if best_key is None or key < best_key:
+            best_key = key
+            overhead_pct, off_ratio = ov_try, ratio_try
+            off, sampled, ledger_view = off_try, sampled_try, lv_try
+        if overhead_pct <= 3.0 and off_ratio >= 0.99:
+            break
+
+    assert ledger_view is not None and ledger_view["sampled_chains"] >= 1, \
+        f"1/{rate} sampling selected nothing at this scale"
+    assert ledger_view["commit_population"] >= 1, ledger_view
+    assert ledger_view["complete"] == ledger_view["commit_population"], \
+        f"incomplete chains on the clean path: {ledger_view}"
+    assert overhead_pct <= 5.0, (
+        f"sampled-mode overhead {overhead_pct:.2f}% exceeds the 5% bar "
+        f"(off {off['ops_per_sec']} vs sampled {sampled['ops_per_sec']} "
+        f"ops/s)")
+
+    from datetime import datetime, timezone
+
+    import jax as _jax
+    return {
+        "metric": f"cfg14_lineage_service_{n_sessions}_sessions",
+        "value": sampled["ops_per_sec"],
+        "unit": "ops/s",
+        "threshold": (
+            "asserted in code: byte-identical per-replica save bytes "
+            "across lineage off/sampled on the same seeded session; "
+            ">= 1 sampled chain with 100% complete origin->commit "
+            "chains on the clean path; sampled overhead <= 5% — "
+            "re-enforced by the slo_gate rules on this committed row "
+            "(overhead_pct + off_ratio_vs_baseline absolute, value + "
+            "visibility_p99_ms relative)"),
+        "timed_region": LINEAGE_TIMED_REGION,
+        "sessions": n_sessions,
+        "rooms": n_rooms,
+        "n_rounds": n_rounds,
+        "chars_per_round": chars_per_round,
+        "lineage_rate": rate,
+        "aggregate_ops_per_sec": sampled["ops_per_sec"],
+        "lineage_off_ops_per_sec": off["ops_per_sec"],
+        "baseline_ops_per_sec": paired["ops_per_sec"],
+        "off_ratio_vs_baseline": round(off_ratio, 4),
+        "overhead_pct": round(overhead_pct, 3),
+        "sampled_chains": ledger_view["sampled_chains"],
+        "hops_per_sampled_change":
+            ledger_view["hops_per_sampled_change"],
+        "visibility_p50_ms": ledger_view["visibility_p50_ms"],
+        "visibility_p99_ms": ledger_view["visibility_p99_ms"],
+        "max_quarantine_dwell_ms":
+            ledger_view["max_quarantine_dwell_ms"],
+        "max_defer_dwell_ms": ledger_view["max_defer_dwell_ms"],
+        "admitted_ops": sampled["admitted_ops"],
+        "p99_tick_ms": sampled["p99_tick_ms"],
+        "off_p99_tick_ms": off["p99_tick_ms"],
+        "platform": _jax.devices()[0].platform,
+        "recorded_at_utc": datetime.now(timezone.utc).isoformat(),
+    }
+
+
+def main_lineage():
+    """`bench.py --lineage`: the cfg14 lineage-overhead A/B entry point
+    (append to the committed session log with ``--session``)."""
+    from benchmarks.common import preflight_device
+    budget = float(os.environ.get("AMTPU_PREFLIGHT_BUDGET_S", "420"))
+    if not preflight_device(total_budget_s=budget, allow_cpu=True):
+        print("bench.py --lineage: no reachable jax device — refusing "
+              "to hang", file=sys.stderr)
+        return 3
+    rec = measure_lineage(quick="--quick" in sys.argv)
+    print(json.dumps(rec))
+    if is_chip_platform(rec["platform"]) or "--session" in sys.argv:
+        append_session_log(rec)
+    return 0
+
+
 TEXT_PREPARE_TIMED_REGION = (
     "cross-doc cold text planning (engine/cross_doc.py + the batch-update "
     "range index, INTERNALS §16): a text-doc population in the serving "
@@ -1816,6 +2100,8 @@ if __name__ == "__main__":
         sys.exit(main_sharded())
     if "--wire" in sys.argv:
         sys.exit(main_wire())
+    if "--lineage" in sys.argv:
+        sys.exit(main_lineage())
     if "--text-prepare" in sys.argv:
         sys.exit(main_text_prepare())
     sys.exit(main_pipeline()

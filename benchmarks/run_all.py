@@ -555,6 +555,48 @@ def config13_wire(quick: bool = False, record_session: bool = False):
          threshold=rec["threshold"])
 
 
+def config14_lineage(quick: bool = False, record_session: bool = False):
+    """Change-lineage overhead A/B at service scale (ISSUE 14,
+    INTERNALS §18): the cfg14 row — the cfg11-shaped seeded service
+    session with lineage off vs deterministic 1/64 sampling,
+    byte-identical committed state and 100% clean-path chain
+    completeness asserted in-run, sampled overhead <= 5%, visibility
+    quantiles + per-stage dwell maxima recorded. Subprocess for a clean
+    obs/lineage/jax state; ``--session`` appends the row to
+    BENCH_SESSIONS.jsonl."""
+    import os
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "AMTPU_SKIP_PREFLIGHT": "1"}
+    env.pop("AMTPU_LINEAGE_RATE", None)   # the bench drives the flag
+    cmd = [sys.executable, os.path.join(root, "bench.py"), "--lineage"]
+    if quick:
+        cmd.append("--quick")
+    if record_session:
+        cmd.append("--session")
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=root,
+                         env=env, timeout=1200)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"cfg14 lineage bench failed rc={out.returncode}: "
+            f"{out.stderr[-800:]}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    emit("cfg14_lineage_service_ops_per_sec", rec["value"], "ops/s",
+         sessions=rec["sessions"],
+         lineage_rate=rec["lineage_rate"],
+         lineage_off_ops_per_sec=rec["lineage_off_ops_per_sec"],
+         off_ratio_vs_baseline=rec["off_ratio_vs_baseline"],
+         overhead_pct=rec["overhead_pct"],
+         sampled_chains=rec["sampled_chains"],
+         hops_per_sampled_change=rec["hops_per_sampled_change"],
+         visibility_p50_ms=rec["visibility_p50_ms"],
+         visibility_p99_ms=rec["visibility_p99_ms"],
+         max_quarantine_dwell_ms=rec["max_quarantine_dwell_ms"],
+         measured_platform=rec["platform"],
+         threshold=rec["threshold"])
+
+
 def config5b_residual_heavy(n_actors: int = 10_000, quick: bool = False):
     """Adversarial headline shape: 20% of ops are RESIDUALS (bare deletes
     of distinct base elements + bare inserts without values) that cannot
@@ -1289,6 +1331,10 @@ def main():
         # the chip_session.sh cfg13 step: ONLY the binary-wire A/B row
         config13_wire(quick=quick, record_session=True)
         return
+    if "--lineage-session" in sys.argv:
+        # the chip_session.sh cfg14 step: ONLY the lineage A/B row
+        config14_lineage(quick=quick, record_session=True)
+        return
     record_round = None
     record_path = None
     if "--record" in sys.argv:
@@ -1374,6 +1420,7 @@ def main():
         lambda: config12_sharded(quick=quick),
         lambda: config12t_text_prepare(quick=quick),
         lambda: config13_wire(quick=quick),
+        lambda: config14_lineage(quick=quick),
     ]
     if record_path is not None:
         steps.insert(0, fold_headline)

@@ -58,6 +58,12 @@ SLOS = [
     # the absolute decode bars below still pass)
     ("cfg13_wire_service", "value", "min", 0.8),
     ("cfg13_wire_service", "wire_bytes_per_op", "max", 1.25),
+    # ISSUE 14: lineage rows — feature-on throughput floor, plus a
+    # relative ceiling on the sampled population's end-to-end
+    # visibility p99 (a hop-site or tick regression that slows the
+    # change's actual journey pages here even while throughput holds)
+    ("cfg14_lineage", "value", "min", 0.8),
+    ("cfg14_lineage", "visibility_p99_ms", "max", 1.5),
 ]
 
 #: Absolute SLOs: (metric_prefix, dotted field, op, bound) checked on
@@ -87,6 +93,14 @@ ABS_SLOS = [
     # tick budget (the "decode term ~vanishes" contract)
     ("cfg13_wire_service", "decode_speedup_vs_dict", ">=", 5.0),
     ("cfg13_wire_service", "decode_share_of_tick", "<=", 0.05),
+    # the ISSUE-14 acceptance bars on every committed cfg14 row,
+    # forever: sampled-mode overhead <= 5% vs the paired disabled leg,
+    # and the disabled leg within 1% of its own paired disabled control
+    # (the structural <=1% disabled-path claim is enforced by the timed
+    # flag-check bound in tests/test_lineage.py; this guards the rows
+    # against an off-path that starts doing work)
+    ("cfg14_lineage", "overhead_pct", "<=", 5.0),
+    ("cfg14_lineage", "off_ratio_vs_baseline", ">=", 0.99),
 ]
 
 #: Derived fields computable from any row that carries the inputs.

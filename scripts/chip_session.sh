@@ -130,6 +130,12 @@ run "cfg12t_text_prepare" 1200 python -m benchmarks.run_all --text-prepare-sessi
 # tick-share bar asserted inside the measurement, wire bytes/op both
 # legs; appended to BENCH_SESSIONS.jsonl
 run "cfg13_wire" 1200 python -m benchmarks.run_all --wire-session
+# change-lineage tracing A/B (ISSUE 14): the cfg14 row on the chip
+# host — the cfg11-shaped service session lineage off vs 1/64 sampled,
+# byte-identity + clean-path chain completeness + the <=5% sampled
+# overhead bar asserted inside the measurement, visibility quantiles
+# and per-stage dwell maxima recorded; appended to BENCH_SESSIONS.jsonl
+run "cfg14_lineage" 1200 python -m benchmarks.run_all --lineage-session
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu

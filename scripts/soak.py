@@ -573,6 +573,10 @@ class _SvcClient:
         self.slow = 1          # pump every `slow` ticks
         self.alive = True
         self.ds = DocSet()
+        # lineage replica-site label: commit hops on this client's gate
+        # name the tenant, so the per-replica completeness bar below can
+        # ask "did THIS surviving replica see the change" (§18)
+        self.ds._lineage_site = tid
         if not empty:
             # a rejoiner starts EMPTY instead: it must bootstrap from the
             # server (snapshot bundle when the history is long enough)
@@ -645,8 +649,19 @@ def session_service(seed: int, n_clients: int = 24, n_ticks: int = 30,
     seed. With ``--scrape`` the Prometheus endpoint is served live for
     the whole session and validated over real HTTP at the end."""
     am = _am()
+    from automerge_tpu.obs import lineage as _lin
     from automerge_tpu.service import ServiceConfig, SyncService, \
         TenantBudget
+
+    # sample-EVERYTHING lineage (the acceptance/debug mode, rate=1)
+    # adds measurable per-message work to the admission loop (~40% on
+    # tick p50 at 100 clients on this box), which the population-scaled
+    # deadline below doesn't know about — scale the budget so the
+    # no-starvation bar keeps measuring scheduling fairness, not
+    # tracing overhead. Production-rate sampling (1/64) is bounded at
+    # <= 5% by the committed cfg14 row and gets no allowance.
+    lineage_full = (_lin.ENABLED and _lin.ledger() is not None
+                    and _lin.ledger().rate == 1)
 
     cfg = ServiceConfig(
         heartbeat_ticks=12, suspect_grace_ticks=12, max_retries=24,
@@ -660,11 +675,17 @@ def session_service(seed: int, n_clients: int = 24, n_ticks: int = 30,
         # is O(tenants), so a flat sub-ms budget that sheds honestly at
         # 100 clients starves everything at 1000 (measured: 972k sheds,
         # zero drain progress) while a flat generous one never fires
-        tick_budget_ms=max(0.5, n_clients / 200.0),
+        tick_budget_ms=max(0.5, n_clients / 200.0)
+        * (1.5 if lineage_full else 1.0),
         default_budget=TenantBudget(ops_per_tick=64,
                                     bytes_per_tick=32 * 1024,
                                     inbox_cap=32))
     svc = SyncService(cfg)
+    # each seeded session is an independent deployment: a fresh ledger,
+    # or seed N's acceptance would evaluate seed N-1's chains against
+    # rooms that share names across sessions
+    if _lin.ENABLED:
+        _lin.clear()
     scrape_srv = svc.serve_metrics() if SCRAPE else None
     try:
         _service_scenario(am, svc, cfg, seed, n_clients, n_ticks,
@@ -898,6 +919,73 @@ def _service_scenario(am, svc, cfg, seed, n_clients, n_ticks, room_size,
         f"service seed {seed}: replication lag nonzero at quiescence: " \
         f"{dict(list(laggards.items())[:5])}"
     assert m["max_lag_ops"] == 0 and m["max_lag_ticks"] == 0, m
+    # 6. lineage acceptance (ISSUE 14, when AMTPU_LINEAGE_RATE enabled
+    #    sampling): >= 99% of sampled changes the server committed show
+    #    a COMPLETE origin->visibility hop chain on every surviving
+    #    replica of their room at quiescence, and the worst quarantine/
+    #    defer dwell folds into the summary line
+    _lineage_acceptance(svc, clients, seed)
+
+
+def _lineage_acceptance(svc, clients, seed):
+    from automerge_tpu.obs import lineage as lin
+    led = lin.ledger()
+    if led is None or not lin.ENABLED:
+        return
+    live_by_room: dict = {}
+    for tid, c in clients.items():
+        if c.alive and svc.session(tid) is not None:
+            live_by_room.setdefault(c.room_id, set()).add(tid)
+    total = complete = 0
+    incomplete_sample = []
+    for ch in led.chains():
+        vis = led.visible_sites(ch)
+        for room_id in {d for d in ch["docs"]
+                        if isinstance(d, str) and d in svc._rooms}:
+            server_site = f"svc:{room_id}"
+            if server_site not in vis:
+                # never committed at the authority over the wire: either
+                # pre-seeded history (every replica was born with it) or
+                # a dead client's change no survivor holds — out of the
+                # per-replica completeness population either way
+                continue
+            origin = ch["origin_site"] or ""
+            # map the origin actor back to its replica: soak client
+            # actors are f"c-{tid}-e{epoch}"; everything else (seed
+            # docs, server drain edits) originates at the server
+            if origin.startswith("c-") and "-e" in origin:
+                origin_replica = origin[2:].rsplit("-e", 1)[0]
+            else:
+                origin_replica = server_site
+            expected = {server_site} | live_by_room.get(room_id, set())
+            expected.discard(origin_replica)
+            total += 1
+            if ch["origin_ns"] is not None and expected <= vis:
+                complete += 1
+            elif len(incomplete_sample) < 5:
+                incomplete_sample.append(
+                    (ch["actor"], ch["seq"], sorted(expected - vis),
+                     [h[0] for h in ch["hops"]]))
+    ratio = complete / total if total else 1.0
+    LAST_SERVICE_METRICS.update(
+        lineage_rate=led.rate,
+        lineage_sampled_chains=led.n_chains,
+        lineage_commit_population=total,
+        lineage_complete_ratio=round(ratio, 4),
+        lineage_hops_per_chain=round(
+            led.stats["hops_recorded"] / max(1, led.stats[
+                "chains_started"]), 2),
+        lineage_max_quarantine_dwell_ms=led.max_dwell_ms("quar/park"),
+        lineage_max_defer_dwell_ms=led.max_dwell_ms("svc/defer"),
+        lineage_visibility_p99_ms=led.visibility_ms(0.99))
+    assert total > 0, \
+        f"service seed {seed}: lineage sampling enabled but no sampled " \
+        f"chain committed at any server replica (rate {led.rate} too " \
+        f"selective for this population?)"
+    assert ratio >= 0.99, (
+        f"service seed {seed}: only {ratio:.2%} of sampled changes have "
+        f"a complete origin->visibility chain on every surviving "
+        f"replica; first incomplete: {incomplete_sample}")
 
 
 def _sharded_stream(seed: int, n_docs: int, n_actors: int, n_seqs: int,

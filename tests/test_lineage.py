@@ -1,0 +1,509 @@
+"""Distributed change-lineage tracing (ISSUE 14, INTERNALS §18).
+
+Pins the tentpole contracts:
+
+- **Deterministic zero-coordination sampling**: whether a change is
+  traced is a pure function of (actor, seq) — independent ledgers (the
+  multi-process stand-in) select the identical subset with no shared
+  state, and a 3-peer chaos soak commits every sampled chain on every
+  replica despite drop/dup/reorder/retransmit.
+- **Dedup-clean chains**: hops dedup by (stage, site, extra); a
+  retransmission adds a distinct chan/retransmit hop (attempt-tagged),
+  never a duplicate chain.
+- **Bounded memory**: at most AMTPU_LINEAGE_CAPACITY chains (oldest
+  evicted) and AMTPU_LINEAGE_MAX_HOPS hops per chain, with the exact
+  counters surviving eviction (the PR-6 wraparound discipline).
+- **Disabled-path overhead**: one module-flag check per hop site —
+  timed and bounded here, like obs.ENABLED in tests/test_obs.py.
+- **Read side**: per-stage dwell + visibility telemetry, prom-clean
+  export, Perfetto flow events that pair up, and a postmortem whose
+  most-stuck entry NAMES the hop a change is wedged on.
+"""
+
+import json
+import os
+import random
+import time
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import Connection, DocSet, Text
+from automerge_tpu.obs import lineage
+from automerge_tpu.obs.lineage import LineageLedger, sample_key
+from automerge_tpu.resilience.chaos import ChaosLink
+from automerge_tpu.resilience.channel import ResilientChannel
+
+
+@pytest.fixture(autouse=True)
+def _lineage_off_after():
+    """Every test leaves the module flag and ledger as it found them."""
+    was = lineage.ENABLED
+    yield
+    if not was:
+        lineage.disable()
+    lineage.clear()
+
+
+# ---------------------------------------------------------------------------
+# sampling determinism
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_is_pure_function_of_identity():
+    """Independent ledgers — different creation order, different
+    observation order — select the IDENTICAL subset: the zero-
+    coordination contract."""
+    keys = [(f"actor-{i % 7}", 1 + i // 7) for i in range(500)]
+    a = LineageLedger(rate=8)
+    b = LineageLedger(rate=8)
+    sampled_a = {k for k in keys if a.sampled(*k)}
+    shuffled = list(keys)
+    random.Random(3).shuffle(shuffled)
+    sampled_b = {k for k in shuffled if b.sampled(*k)}
+    assert sampled_a == sampled_b
+    assert 0 < len(sampled_a) < len(keys)
+    # and the subset is stable across processes by construction: pinned
+    # against the content hash itself
+    for k in list(sampled_a)[:10]:
+        assert sample_key(*k) % 8 == 0
+
+
+def test_rate_one_samples_everything():
+    led = LineageLedger(rate=1)
+    for i in range(50):
+        assert led.sampled(f"a{i}", i + 1)
+
+
+def test_unsampled_changes_never_enter_the_ledger():
+    led = LineageLedger(rate=10**6)   # astronomically selective
+    n = sum(led.record(f"a{i}", 1, "origin") for i in range(200))
+    assert led.n_chains == n <= 1
+
+
+# ---------------------------------------------------------------------------
+# chain semantics: dedup, retransmit, bounds
+# ---------------------------------------------------------------------------
+
+
+def test_hop_dedup_by_stage_site_extra():
+    led = LineageLedger(rate=1)
+    assert led.record("a", 1, "origin", site="a")
+    assert not led.record("a", 1, "origin", site="a")      # dup drops
+    assert led.record("a", 1, "commit", site="B")
+    assert not led.record("a", 1, "commit", site="B")      # dup drops
+    assert led.record("a", 1, "commit", site="C")          # new site
+    c = led.chain("a", 1)
+    assert [h[0] for h in c["hops"]] == ["origin", "commit", "commit"]
+    assert led.stats["hops_deduped"] == 2
+    assert led.visible_sites(c) == {"B", "C"}
+
+
+def test_retransmit_attempts_are_distinct_hops_never_dup_chains():
+    led = LineageLedger(rate=1)
+    led.record("a", 1, "origin", site="a")
+    led.record("a", 1, "chan/send", site="ch", extra=5)
+    led.record("a", 1, "chan/retransmit", site="ch", extra=(5, 1))
+    led.record("a", 1, "chan/retransmit", site="ch", extra=(5, 2))
+    # the duplicated DELIVERY of attempt 2 dedups
+    led.record("a", 1, "chan/retransmit", site="ch", extra=(5, 2))
+    c = led.chain("a", 1)
+    assert [h[0] for h in c["hops"]] == [
+        "origin", "chan/send", "chan/retransmit", "chan/retransmit"]
+    assert led.stats["chains_started"] == 1
+
+
+def test_bounded_capacity_oldest_evicted_counters_exact():
+    led = LineageLedger(rate=1, capacity=8)
+    for i in range(20):
+        led.record(f"a{i}", 1, "origin", site=f"a{i}")
+        led.record(f"a{i}", 1, "commit", site="B")
+    assert led.n_chains == 8
+    assert led.stats["chains_started"] == 20
+    assert led.stats["chains_evicted"] == 12
+    assert led.stats["hops_recorded"] == 40     # exact ACROSS eviction
+    # oldest evicted: the survivors are the 8 newest
+    survivors = {c["actor"] for c in led.chains()}
+    assert survivors == {f"a{i}" for i in range(12, 20)}
+
+
+def test_max_hops_cap_counted():
+    led = LineageLedger(rate=1, max_hops=4)
+    for i in range(10):
+        led.record("a", 1, "commit", site=f"s{i}")
+    c = led.chain("a", 1)
+    assert len(c["hops"]) == 4
+    assert led.stats["hops_dropped_cap"] == 6
+
+
+def test_dwell_and_visibility_telemetry():
+    led = LineageLedger(rate=1)
+    t0 = 1_000_000
+    led.record("a", 1, "origin", site="a", t_ns=t0)
+    led.record("a", 1, "quar/park", site="B", t_ns=t0 + 1_000)
+    led.record("a", 1, "quar/release", site="B", t_ns=t0 + 51_000)
+    led.record("a", 1, "commit", site="B", t_ns=t0 + 60_000)
+    agg = led.telemetry.span_aggregates()
+    # quarantine dwell = park -> release
+    assert agg[("lineage", "dwell:quar/park")]["total_ns"] == 50_000
+    # visibility = origin -> commit on a REMOTE site
+    assert agg[("lineage", "visibility")]["total_ns"] == 60_000
+    assert led.max_dwell_ms("quar/park") == 0.05
+    # a commit at the ORIGIN site is not remote visibility
+    led.record("b", 1, "origin", site="b", t_ns=t0)
+    led.record("b", 1, "commit", site="b", t_ns=t0 + 9_000)
+    assert led.telemetry.span_aggregates()[
+        ("lineage", "visibility")]["count"] == 1
+
+
+def test_context_adoption_and_hostile_context_ignored():
+    led = LineageLedger(rate=2)
+    keys = [(f"k{i}", 1) for i in range(40)]
+    in_subset = [k for k in keys if led.sampled(*k)]
+    out_subset = [k for k in keys if not led.sampled(*k)]
+    assert in_subset and out_subset
+    ctx = [[a, s, 777, "origin-X"] for a, s in in_subset] + \
+          [[a, s, 777, "evil"] for a, s in out_subset]
+    led.adopt(ctx)
+    assert led.n_chains == len(in_subset)
+    assert led.stats["context_ignored"] == len(out_subset)
+    c = led.chain(*in_subset[0])
+    assert c["origin_ns"] == 777 and c["origin_site"] == "origin-X"
+
+
+def test_adopt_clock_marks_covered_chains_visible():
+    led = LineageLedger(rate=1)
+    led.record("a", 1, "origin", site="a")
+    led.record("a", 2, "origin", site="a")
+    led.record("b", 5, "origin", site="b")
+    led.adopt_clock({"a": 1, "b": 5}, site="joiner", doc="d")
+    assert led.visible_sites(led.chain("a", 1)) == {"joiner"}
+    assert led.visible_sites(led.chain("a", 2)) == set()   # not covered
+    assert led.visible_sites(led.chain("b", 5)) == {"joiner"}
+
+
+# ---------------------------------------------------------------------------
+# disabled-path overhead (the PR-6 discipline)
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_emit_path_is_one_flag_check():
+    assert not lineage.ENABLED
+    n = 200_000
+    deadline = time.perf_counter() + 10.0
+    t0 = time.perf_counter_ns()
+    acc = 0
+    for _ in range(n):
+        if lineage.ENABLED:       # the exact hop-site pattern
+            acc += 1
+    dt = time.perf_counter_ns() - t0
+    assert time.perf_counter() < deadline
+    assert acc == 0
+    per_call = dt / n
+    # generous CI bound; the real point is no call/no hash/no lock
+    assert per_call < 1_000, f"{per_call:.0f} ns per disabled check"
+
+
+def test_change_keys_never_forces_a_frame_decode():
+    """payload_keys on the send path reads the frame's cached change
+    list / decoded batch — an undecoded frame contributes nothing (the
+    receive side decodes before its hops run)."""
+    from automerge_tpu.engine import wire_format as wf
+    ch = [{"actor": "a", "seq": 1, "deps": {},
+           "ops": [{"action": "ins", "obj": "o", "key": "_head",
+                    "elem": 1}]}]
+    _prefix, frame = wf.split_outgoing(ch, min_ops=1)
+    assert frame is not None and frame._changes is not None
+    assert lineage.change_keys(frame) == [("a", 1)]
+    cold = wf.WireFrame(frame.data)          # undecoded receiver frame
+    assert lineage.change_keys(cold) == []
+    assert cold._batch is None               # stayed undecoded
+    assert lineage.payload_keys(
+        {"docId": "d", "clock": {}, "changes": ch, "wire": frame}) \
+        == [("a", 1), ("a", 1)]
+
+
+# ---------------------------------------------------------------------------
+# 3-peer chaos soak: identical subsets, chains survive dup/reorder
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_three_peer_chaos_identical_sampling(seed):
+    """Three replicas over seeded chaotic channels (drop/dup/reorder +
+    retransmission): at convergence every sampled chain is visible on
+    every replica, the sampled subset equals the pure-function subset
+    of the full history (zero coordination), and no chain carries a
+    duplicate (stage, site, extra) hop."""
+    rng = random.Random(1000 + seed)
+    led = lineage.enable(rate=4, capacity=2048)
+    led.clear()
+    try:
+        names = ["P0", "P1", "P2"]
+        sets = {}
+        links = {}
+        for n in names:
+            ds = DocSet()
+            ds._lineage_site = n
+            sets[n] = ds
+        doc0 = am.change(am.init("seed-origin"),
+                         lambda d: d.__setitem__("t", Text("base")))
+        base = am.get_all_changes(doc0)
+        for n in names:
+            sets[n].set_doc("d", am.apply_changes(am.init(f"rep-{n}"),
+                                                  base))
+        # full mesh of chaotic duplex links with reliable channels on top
+        chaos = dict(drop=0.08, dup=0.08, reorder=0.15)
+        for i, a in enumerate(names):
+            for b in names[i + 1:]:
+                la = ChaosLink(None, seed=seed * 31 + i, **chaos)
+                lb = ChaosLink(None, seed=seed * 31 + i + 7, **chaos)
+                ch_a = ResilientChannel(la.send, None, seed=1,
+                                        label=f"{a}->{b}")
+                ch_b = ResilientChannel(lb.send, None, seed=2,
+                                        label=f"{b}->{a}")
+                la._deliver = ch_b.on_wire     # a's sends reach b's end
+                lb._deliver = ch_a.on_wire
+                ca = Connection(sets[a], ch_a.send)
+                cb = Connection(sets[b], ch_b.send)
+                ch_a._deliver = ca.receive_msg
+                ch_b._deliver = cb.receive_msg
+                ca.open()
+                cb.open()
+                links[(a, b)] = (la, lb, ch_a, ch_b)
+
+        def pump(rounds=60):
+            for _ in range(rounds):
+                busy = False
+                for la, lb, ch_a, ch_b in links.values():
+                    la.pump()
+                    lb.pump()
+                    ch_a.tick()
+                    ch_b.tick()
+                    busy = busy or not (la.idle and lb.idle
+                                        and ch_a.idle and ch_b.idle)
+                if not busy:
+                    return
+        pump()
+        for r in range(4):
+            n = names[r % 3]
+            doc = sets[n].get_doc("d")
+            text = "".join(chr(97 + rng.randrange(26)) for _ in range(20))
+            sets[n].set_doc("d", am.change(
+                doc, lambda d, t=text: d["t"].insert_at(0, *list(t))))
+            pump()
+        pump(200)
+        saves = {n: am.save(sets[n].get_doc("d")) for n in names}
+        assert len(set(saves.values())) == 1, "mesh diverged"
+
+        history = am.get_all_changes(sets["P0"].get_doc("d"))
+        expected = {(c["actor"], c["seq"]) for c in history
+                    if led.sampled(c["actor"], c["seq"])}
+        assert expected, "seeded run sampled nothing; lower the rate"
+        chains = {(c["actor"], c["seq"]): c for c in led.chains()}
+        # the sampled subset IS the pure-function subset of the history
+        assert expected <= set(chains), \
+            f"missing chains: {expected - set(chains)}"
+        for key in expected:
+            c = chains[key]
+            vis = led.visible_sites(c)
+            # the ORIGIN replica applied its change locally (no gate
+            # commit); every OTHER replica must show visibility
+            others = {n for n in names
+                      if c["origin_site"] != f"rep-{n}"
+                      and not c["origin_site"].startswith("seed")}
+            missing = {n for n in others if n not in vis}
+            assert not missing, (key, vis, c["hops"])
+            # dedup-clean: no duplicate (stage, site, extra)
+            hop_keys = [(h[0], h[1], h[3]) for h in c["hops"]]
+            assert len(hop_keys) == len(set(hop_keys)), c["hops"]
+        # chaos genuinely exercised the dedup/retransmit paths
+        assert led.stats["hops_deduped"] >= 0
+    finally:
+        lineage.disable()
+
+
+# ---------------------------------------------------------------------------
+# read side: flows, prom, postmortem
+# ---------------------------------------------------------------------------
+
+
+def test_flow_events_pair_up_and_validate():
+    import automerge_tpu.obs as obs
+    from automerge_tpu.obs.export import (to_chrome_trace,
+                                          validate_chrome_trace)
+    led = lineage.enable(rate=1, capacity=256)
+    led.clear()
+    with obs.tracing():
+        obs.clear()
+        a, b = DocSet(), DocSet()
+        a._lineage_site, b._lineage_site = "A", "B"
+        qa, qb = [], []
+        ca, cb = Connection(a, qa.append), Connection(b, qb.append)
+        doc = am.change(am.init("flow-author"),
+                        lambda d: d.__setitem__("t", Text("x")))
+        a.set_doc("d", doc)
+        ca.open()
+        cb.open()
+        for _ in range(40):
+            if not qa and not qb:
+                break
+            while qa:
+                cb.receive_msg(qa.pop(0))
+            while qb:
+                ca.receive_msg(qb.pop(0))
+        a.set_doc("d", am.change(a.get_doc("d"),
+                                 lambda d: d["t"].insert_at(0, "Q")))
+        for _ in range(40):
+            if not qa and not qb:
+                break
+            while qa:
+                cb.receive_msg(qa.pop(0))
+            while qb:
+                ca.receive_msg(qb.pop(0))
+        trace = to_chrome_trace(obs.snapshot(), t0_ns=obs.recorder().t0_ns)
+    res = validate_chrome_trace(trace, require_flows=True)
+    assert res["n_flows"] >= 1
+    # every flow is well-formed by construction; a dangling start fails
+    broken = dict(trace)
+    broken["traceEvents"] = [e for e in trace["traceEvents"]
+                             if e.get("ph") != "f"]
+    from automerge_tpu.obs.export import TraceValidationError
+    with pytest.raises(TraceValidationError):
+        validate_chrome_trace(broken)
+    lineage.disable()
+
+
+def test_prom_families_validate_clean():
+    from automerge_tpu.obs import prom
+    led = lineage.enable(rate=1, capacity=64)
+    led.clear()
+    led.record("a", 1, "origin", site="a", t_ns=1000)
+    led.record("a", 1, "commit", site="B", t_ns=5_002_000)
+    page = prom.expose(led.families("amtpu_lineage"))
+    res = prom.validate_prom(page)
+    assert res["samples"] > 0
+    assert "amtpu_lineage_span_seconds" in page
+    assert "amtpu_lineage_visibility_ms" in page
+    assert 'name="chains_started"' in page
+    lineage.disable()
+
+
+def test_service_postmortem_names_the_quarantine_hop():
+    """An induced stuck change — premature forever — shows up in
+    SyncService.describe()['lineage']['stuck'] with its chain ending at
+    the quar/park hop, and the whole postmortem JSON round-trips."""
+    from automerge_tpu.service import ServiceConfig, SyncService
+    led = lineage.enable(rate=1, capacity=256)
+    led.clear()
+    svc = SyncService(ServiceConfig())
+    doc = am.change(am.init("server-pm"),
+                    lambda d: d.__setitem__("t", Text("x")))
+    svc.seed_doc("room-pm", doc)
+    room = svc.room("room-pm")
+    # a premature change: depends on a seq nobody has
+    obj_id = next(op["obj"] for c in am.get_all_changes(doc)
+                  for op in c["ops"] if op["action"] == "makeText")
+    stuck = {"actor": "ghost", "seq": 2, "deps": {"never": 9},
+             "ops": [{"action": "set", "obj": obj_id, "key": "ghost:1",
+                      "value": "!"}]}
+    led.record("ghost", 2, "origin", site="ghost")
+    room.gate.deliver("room-pm", [stuck], sender="t-ghost")
+    assert room.gate.quarantined("room-pm") == 1
+    dump = json.loads(json.dumps(svc.describe(), default=str))
+    lin = dump["lineage"]
+    assert lin["schema"] == "amtpu-lineage-v1"
+    entry = next(e for e in lin["stuck"]
+                 if e["actor"] == "ghost" and e["seq"] == 2)
+    assert entry["mid_flight"] is True
+    assert entry["stuck_at"] == "quar/park"     # the named hop
+    assert entry["hops"][-1][0] == "quar/park"
+    assert lin["stats"]["hops_recorded"] >= 2
+    lineage.disable()
+
+
+def test_service_scrape_includes_lineage_families():
+    from automerge_tpu.obs import prom
+    from automerge_tpu.service import ServiceConfig, SyncService
+    led = lineage.enable(rate=1, capacity=64)
+    led.clear()
+    led.record("a", 1, "origin", site="a", t_ns=10)
+    led.record("a", 1, "commit", site="svc:r", t_ns=2_000_010)
+    svc = SyncService(ServiceConfig())
+    page = svc.scrape()
+    prom.validate_prom(page)
+    assert "amtpu_lineage_visibility_ms" in page
+    lineage.disable()
+
+
+# ---------------------------------------------------------------------------
+# router (sharded) hops
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_router_quarantine_and_lane_commit_hops():
+    from automerge_tpu.shard.set import ShardedDocSet
+    led = lineage.enable(rate=1, capacity=256)
+    led.clear()
+    sds = ShardedDocSet(n_shards=1, assert_budget=False)
+    late = {"actor": "y", "seq": 1, "deps": {"x": 1},
+            "ops": [{"action": "ins", "obj": "d", "key": "_head",
+                     "elem": 1}]}
+    dep = {"actor": "x", "seq": 1, "deps": {},
+           "ops": [{"action": "ins", "obj": "d", "key": "_head",
+                    "elem": 1}]}
+    led.record("y", 1, "origin", site="y")
+    led.record("x", 1, "origin", site="x")
+    sds.deliver("d", [late])
+    assert sds.quarantined("d") == 1
+    c = led.chain("y", 1)
+    assert ("quar/park", "router") in {(h[0], h[1]) for h in c["hops"]}
+    sds.deliver("d", [dep])
+    assert sds.quarantined("d") == 0
+    c = led.chain("y", 1)
+    stages = [(h[0], h[1]) for h in c["hops"]]
+    assert ("quar/release", "router") in stages
+    assert ("commit", "lane0") in stages
+    assert led.visible_sites(led.chain("x", 1)) == {"lane0"}
+    lineage.disable()
+
+
+def test_paired_dwell_survives_interleaved_hops():
+    """An interleaved hop from another site (a retransmit mid-park)
+    must not truncate the quarantine dwell: park -> release pairs at
+    the SAME site, whatever landed between."""
+    led = LineageLedger(rate=1)
+    t0 = 1_000_000
+    led.record("a", 1, "origin", site="a", t_ns=t0)
+    led.record("a", 1, "quar/park", site="B", t_ns=t0 + 1_000)
+    led.record("a", 1, "chan/retransmit", site="ch", extra=(1, 1),
+               t_ns=t0 + 10_000)                     # interleaves
+    led.record("a", 1, "quar/release", site="B", t_ns=t0 + 51_000)
+    agg = led.telemetry.span_aggregates()
+    assert agg[("lineage", "dwell:quar/park")]["total_ns"] == 50_000
+    # and the opener's slot is never charged to the interloper
+    assert ("lineage", "dwell:chan/retransmit") not in agg or \
+        agg[("lineage", "dwell:chan/retransmit")]["max_ns"] <= 41_000
+
+
+def test_late_origin_adoption_prepends_and_stays_complete():
+    """Wire context arriving AFTER the chain already committed (a
+    lineage-off sender's delivery committed first) must not resurrect
+    the chain onto the most-stuck list, and the visibility sample is
+    emitted retroactively."""
+    led = LineageLedger(rate=1)
+    led.record("a", 1, "commit", site="B", doc="d", t_ns=5_000_000)
+    assert led.telemetry.span_aggregates().get(
+        ("lineage", "visibility")) is None      # no origin yet
+    led.adopt([["a", 1, 1_000_000, "origin-A"]])
+    c = led.chain("a", 1)
+    assert c["hops"][0][0] == "origin"           # prepended, not last
+    assert c["origin_ns"] == 1_000_000
+    vis = led.telemetry.span_aggregates()[("lineage", "visibility")]
+    assert vis["count"] == 1 and vis["total_ns"] == 4_000_000
+    entry = led.stuck(k=4, at_ns=9_000_000)[0]
+    assert entry["mid_flight"] is False          # committed != stuck
+    # a second origin claim dedups (first adopted origin wins)
+    led.adopt([["a", 1, 999, "evil-origin"]])
+    assert led.chain("a", 1)["origin_ns"] == 1_000_000

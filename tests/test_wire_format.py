@@ -159,7 +159,10 @@ def _valid_frame_bytes(n_changes=12, seed=3):
     rng = random.Random(seed)
     changes = _frame_scoped(rand_text_changes(rng, n_changes=n_changes,
                                               premature=False, dups=False))
-    return wf.encode_changes(changes)
+    # carry a lineage trace-context entry so the fuzz/truncation sweeps
+    # below extend over the ISSUE-14 manifest section too
+    trace = [[changes[0]["actor"], changes[0]["seq"], 123456, "origin-A"]]
+    return wf.encode_changes(changes, trace=trace)
 
 
 def test_bit_flips_reject_typed():
@@ -789,3 +792,112 @@ def test_snapshot_cache_survives_repeated_tail_serves(monkeypatch):
         server.set_doc("doc", _bulk_edit(server.get_doc("doc"),
                                          f"tail{i}"))
     assert len(joins) == 3
+
+
+# ---------------------------------------------------------------------------
+# lineage trace context on the wire (ISSUE 14, INTERNALS §18.2)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_section_round_trip_and_absent():
+    """Frames with and without the trace manifest entry decode on both
+    current and lineage-off peers; the context survives byte-exact."""
+    rng = random.Random(7)
+    changes = _frame_scoped(rand_text_changes(rng, n_changes=8,
+                                              premature=False, dups=False))
+    ctx = [[changes[0]["actor"], changes[0]["seq"], 987654321, "site-A"],
+           [changes[1]["actor"], changes[1]["seq"], 0, ""]]
+    with_ctx = wf.encode_changes(changes, trace=ctx)
+    without = wf.encode_changes(changes)
+    assert with_ctx != without              # the context is ON the wire
+    batch = wf.decode(with_ctx)
+    assert batch._trace == ctx
+    assert wf.decode(without)._trace is None
+    # the payload itself is identical either way (context is metadata)
+    assert json.dumps(wf.materialize_changes(batch)) == \
+        json.dumps(wf.materialize_changes(wf.decode(without)))
+    # a lineage-off peer (module flag down) decodes + applies normally
+    from automerge_tpu.obs import lineage
+    was = lineage.ENABLED
+    lineage.disable()
+    try:
+        frame = wf.WireFrame(with_ctx)
+        assert frame.validate().trace == ctx
+        msg = validate_msg({"docId": "d", "clock": {}, "wire": with_ctx})
+        assert msg["wire"].trace == ctx
+    finally:
+        if was:
+            lineage.enable()
+
+
+def test_trace_context_malformed_rejects_typed():
+    """A malformed trace context — on the frame manifest OR the dict
+    wire — is a typed ProtocolError before any state is touched."""
+    bads = [
+        "not-a-list",
+        [["a", 1, 2]],                       # wrong arity
+        [["", 1, 2, "s"]],                   # empty actor
+        [["a", 0, 2, "s"]],                  # seq below 1
+        [["a", 1, -5, "s"]],                 # negative origin_ns
+        [["a", 1, 2, 7]],                    # non-string site
+        [["a", True, 2, "s"]],               # bool masquerading as int
+    ]
+    for bad in bads:
+        with pytest.raises(ProtocolError):
+            wf.validate_trace_context(bad)
+        with pytest.raises(ProtocolError):
+            validate_msg({"docId": "d", "clock": {},
+                          "changes": [], "trace": bad})
+    with pytest.raises(ProtocolError):
+        wf.validate_trace_context([["a", 1, 0, "s"]] * 9000)  # oversize
+    rng = random.Random(8)
+    changes = _frame_scoped(rand_text_changes(rng, n_changes=4,
+                                              premature=False, dups=False))
+    with pytest.raises(wf.WireFormatError):
+        wf.encode_changes(changes, trace=[["a", 1]])
+
+
+def test_mixed_peers_converge_with_context_attached(monkeypatch):
+    """A binary peer and a dict peer on one hub, lineage sampling
+    everything: byte-identical convergence AND the receiving replicas'
+    chains carry origin context adopted from the wire (both the frame
+    manifest and the dict-wire field)."""
+    from automerge_tpu.obs import lineage
+    monkeypatch.setenv("AMTPU_WIRE_MIN_OPS", "8")
+    led = lineage.enable(rate=1, capacity=512)
+    led.clear()
+    try:
+        a, b, ca, cb, qa, qb = _pair()
+        a._lineage_site = "site-a"
+        b._lineage_site = "site-b"
+        doc = am.change(am.init("author"),
+                        lambda d: d.__setitem__("t", Text("x")))
+        a.set_doc("d", doc)
+        _pump(ca, cb, qa, qb)
+        # binary leg a->b, then a dict leg (flag off at the sender)
+        a.set_doc("d", _bulk_edit(a.get_doc("d"), "binary-leg " * 8))
+        _pump(ca, cb, qa, qb)
+        os.environ["AMTPU_WIRE_BINARY"] = "0"
+        try:
+            b.set_doc("d", _bulk_edit(b.get_doc("d"), "dict-leg " * 8))
+            _pump(ca, cb, qa, qb)
+        finally:
+            os.environ.pop("AMTPU_WIRE_BINARY", None)
+        assert am.save(a.get_doc("d")) == am.save(b.get_doc("d"))
+        chains = led.chains()
+        assert chains, "sampling everything recorded nothing"
+        committed = [c for c in chains
+                     if {"site-a", "site-b"} & led.visible_sites(c)]
+        assert committed, "no replica recorded a commit hop"
+        # every committed chain knows its origin (local hop or adopted
+        # wire context) — the stitching contract
+        for c in committed:
+            assert c["origin_ns"] is not None, c
+        # and adopted context agrees with the sender's origin hop: the
+        # author's changes committed on b carry the author origin site
+        on_b = [c for c in committed if "site-b" in led.visible_sites(c)
+                and c["actor"] == "author"]
+        assert on_b and all(c["origin_site"] == "author" for c in on_b)
+    finally:
+        lineage.disable()
+        lineage.clear()
