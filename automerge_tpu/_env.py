@@ -32,3 +32,36 @@ def virtual_cpu_env(n_devices: int, base: dict | None = None) -> dict:
                    os.path.join(_REPO, ".jax_cache"))
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
     return env
+
+
+def compile_cache_state(env: dict | None = None) -> dict:
+    """The persistent-compile-cache defaulting, as observable state
+    (ISSUE 15): the directory this process resolves (the env override,
+    else the repo default every entry point sets), whether the cache is
+    enabled, the configured min-compile-time threshold, and what is on
+    disk right now. jax-free — safe from `metrics_snapshot()` and the
+    bench record path in any process. Session-level first-compile vs
+    cache-served counts live next to this in
+    ``obs.device_truth.compile_cache_snapshot()``."""
+    e = os.environ if env is None else env
+    cache_dir = e.get("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(_REPO, ".jax_cache"))
+    enabled = cache_dir not in ("", None)
+    entries = 0
+    exists = False
+    if enabled:
+        try:
+            names = os.listdir(cache_dir)
+            exists = True
+            # the cache writes one `-cache` payload per executable plus
+            # an `-atime` sidecar; count payloads only
+            entries = sum(1 for n in names if not n.endswith("-atime"))
+        except OSError:
+            pass
+    try:
+        min_compile_s = float(e.get(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5"))
+    except ValueError:
+        min_compile_s = None
+    return {"dir": cache_dir, "enabled": enabled, "exists": exists,
+            "entries": entries, "min_compile_time_secs": min_compile_s}

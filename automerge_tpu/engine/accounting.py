@@ -46,6 +46,14 @@ waited on the device.
 The regression bars: tests/test_dispatch_budget.py pins the write-behind
 `am.change` path and the ring's per-commit budget; `bench.py --pipeline`
 and benchmarks cfg7 carry the measured counts in their records.
+
+Since ISSUE 15 the same counters also meter BYTES, not just counts:
+`record_h2d(nbytes)` at the engine's staging seams (prepare_batch's
+summed plan staging, the stacked round uploads, the slow-register
+writeback) and the `d2h_bytes=` argument of `record_sync` at every
+blocking fetch site — so `track()` deltas carry exact
+`h2d_bytes`/`d2h_bytes` and the device-truth tier (obs/device_truth.py,
+INTERNALS §19) can report bytes-staged-per-op without estimating.
 """
 
 from __future__ import annotations
@@ -57,7 +65,7 @@ from .. import obs
 _LOCK = threading.Lock()
 
 # process-wide running totals; monotonically increasing
-TOTALS = {"dispatches": 0, "syncs": 0}
+TOTALS = {"dispatches": 0, "syncs": 0, "h2d_bytes": 0, "d2h_bytes": 0}
 
 # per-label histograms: label -> {"n": launches/syncs, "ns": total
 # blocked ns (syncs with a measured duration only)}. Same lock as TOTALS.
@@ -73,7 +81,8 @@ _TLS = threading.local()
 def _thread_totals() -> dict:
     t = getattr(_TLS, "totals", None)
     if t is None:
-        t = _TLS.totals = {"dispatches": 0, "syncs": 0}
+        t = _TLS.totals = {"dispatches": 0, "syncs": 0,
+                           "h2d_bytes": 0, "d2h_bytes": 0}
     return t
 
 
@@ -104,18 +113,42 @@ def record_dispatch(n: int = 1, acct: dict = None, label: str = None):
 
 
 def record_sync(n: int = 1, acct: dict = None, label: str = None,
-                dur_ns: int = 0):
+                dur_ns: int = 0, d2h_bytes: int = 0):
     """Count `n` blocking device->host syncs; `dur_ns` (optional) is the
-    measured blocked time for the labeled duration histogram."""
+    measured blocked time for the labeled duration histogram;
+    `d2h_bytes` (optional) the exact bytes the fetch pulled host-side —
+    fed at the site where the numpy result is at hand, so the meter is
+    exact, never estimated."""
     with _LOCK:
         TOTALS["syncs"] += n
+        if d2h_bytes:
+            TOTALS["d2h_bytes"] += d2h_bytes
         if acct is not None:
             acct["syncs"] += n
+            if d2h_bytes:
+                acct["d2h_bytes"] = acct.get("d2h_bytes", 0) + d2h_bytes
         if label is not None:
             _bump_label("sync", label, n, dur_ns)
-    _thread_totals()["syncs"] += n
+    t = _thread_totals()
+    t["syncs"] += n
+    if d2h_bytes:
+        t["d2h_bytes"] += d2h_bytes
     if obs.ENABLED and label is not None:
         obs.counter("device", f"sync:{label}", n)
+
+
+def record_h2d(nbytes: int, acct: dict = None):
+    """Count exact host->device staged bytes at an engine staging seam
+    (prepare_batch plan staging, stacked round uploads, slow-register
+    writeback). Transfer COUNTS stay where they were (dispatches /
+    staged upload stats); this meters volume."""
+    if not nbytes:
+        return
+    with _LOCK:
+        TOTALS["h2d_bytes"] += nbytes
+        if acct is not None:
+            acct["h2d_bytes"] = acct.get("h2d_bytes", 0) + nbytes
+    _thread_totals()["h2d_bytes"] += nbytes
 
 
 def snapshot() -> dict:

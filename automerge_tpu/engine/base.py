@@ -166,9 +166,11 @@ class CausalDeviceDoc:
         # remains, so every later access fails loudly via
         # _check_device_alive (recovery = checkpoint restore or replay;
         # INTERNALS §9 donation invariants)
-        self._acct = {"dispatches": 0, "syncs": 0}  # device-interaction
-        # counters (engine/accounting.py): every jitted program launch and
-        # every blocking d2h sync this document performs
+        self._acct = {"dispatches": 0, "syncs": 0,
+                      "h2d_bytes": 0, "d2h_bytes": 0}  # device-interaction
+        # counters (engine/accounting.py): every jitted program launch,
+        # every blocking d2h sync, and the exact staged bytes each way
+        # (ISSUE 15) this document performs
         self.last_commit_stats: Optional[dict] = None  # delta of the most
         # recent commit_prepared (the pipeline ring's per-batch budget)
         self._gen = 0                         # bumps on every state mutation
@@ -204,11 +206,64 @@ class CausalDeviceDoc:
         if region is not None:
             region["dispatches"] += n
 
-    def _count_sync(self, n: int = 1, label: str = None, dur_ns: int = 0):
-        accounting.record_sync(n, self._acct, label=label, dur_ns=dur_ns)
+    def _count_sync(self, n: int = 1, label: str = None, dur_ns: int = 0,
+                    d2h_bytes: int = 0):
+        accounting.record_sync(n, self._acct, label=label, dur_ns=dur_ns,
+                               d2h_bytes=d2h_bytes)
         region = getattr(_ACCT_TLS, "region", None)
         if region is not None:
             region["syncs"] += n
+
+    def _count_h2d(self, nbytes: int):
+        accounting.record_h2d(nbytes, self._acct)
+
+    # ------------------------------------------------------------------
+    # device-resident footprint (obs/device_truth.py; INTERNALS §19)
+    # ------------------------------------------------------------------
+
+    def device_footprint(self) -> dict:
+        """Device-resident bytes of this document, computed from
+        dtype x shape over the live engine tables (9 for text, 5 for
+        map) plus subclass extras — never a device sync; parity with the
+        live ``jax.Array`` buffer sizes is pinned in
+        tests/test_device_truth.py. Host-side companion state (index
+        ranges, value pool, conflicts) rides along as counts so the
+        footprint names where non-device memory scales."""
+        table_bytes = 0
+        n_tables = 0
+        if self._dev is not None:
+            for arr in self._dev.values():
+                n = 1
+                for d in arr.shape:
+                    n *= int(d)
+                table_bytes += n * np.dtype(arr.dtype).itemsize
+                n_tables += 1
+        extra = self._device_footprint_extra()
+        return {
+            "device_bytes": table_bytes + extra,
+            "table_bytes": table_bytes,
+            "n_tables": n_tables,
+            "extra_bytes": extra,
+            "host": {"value_pool": len(self.value_pool),
+                     "conflicts": len(self.conflicts),
+                     **self._host_footprint_extra()},
+        }
+
+    def _device_footprint_extra(self) -> int:
+        """Subclass hook: device bytes held OUTSIDE the table dict
+        (staged scalars, cached materializations)."""
+        return 0
+
+    def _host_footprint_extra(self) -> dict:
+        return {}
+
+    def _note_footprint(self):
+        """Feed the always-on per-doc footprint gauge at a commit
+        boundary (obs/device_truth.py peaks + prom families)."""
+        from ..obs import device_truth
+        if device_truth.ENABLED:
+            device_truth.REGISTRY.note_footprint(
+                "doc", self.obj_id, self.device_footprint()["device_bytes"])
 
     @property
     def dispatch_stats(self) -> dict:
@@ -760,6 +815,7 @@ class CausalDeviceDoc:
             self._plan_failed()
             raise
         self._invalidate()
+        self._note_footprint()
         return self
 
     @staticmethod
@@ -1052,6 +1108,9 @@ class CausalDeviceDoc:
              for x in p.staged])
         self._count_sync(label="stage_barrier",
                          dur_ns=(obs.now() - _tb) if _tb else 0)
+        # exact h2d byte meter (ISSUE 15): the plan's summed staged
+        # bytes, counted once at the seam where they are already known
+        self._count_h2d(staged_bytes)
         if obs.ENABLED:
             obs.span("plan", "prepare_batch", _t0, args={
                 "doc": self.obj_id, "n_ops": getattr(batch, "n_ops", 0),
@@ -1095,6 +1154,7 @@ class CausalDeviceDoc:
         # streaming tier budgets (asserted <= a small constant on the
         # write-behind path; carried in bench --pipeline records)
         self.last_commit_stats = {**region, "n_rounds": n_rounds}
+        self._note_footprint()
         return out
 
     def _commit_prepared(self, prepared: PreparedBatch):
@@ -1365,6 +1425,7 @@ class CausalDeviceDoc:
         regs_in = (dev["value"], dev["has_value"], dev["win_actor"],
                    dev["win_seq"], dev["win_counter"])
         self._count_dispatch(label="scatter_registers")
+        self._count_h2d(wb.nbytes)   # the packed (6, S) writeback upload
         try:
             if self.packed_residual_writeback:
                 # ONE packed h2d upload: with the packed slow_info fetch
@@ -1411,7 +1472,8 @@ class CausalDeviceDoc:
         _tf = obs.now() if obs.ENABLED else 0
         packed = np.asarray(pack_rows(*(dev[k] for k in keys)))
         self._count_sync(label="mirror_fetch",       # the packed d2h fetch
-                         dur_ns=(obs.now() - _tf) if _tf else 0)
+                         dur_ns=(obs.now() - _tf) if _tf else 0,
+                         d2h_bytes=packed.nbytes)
         out = {}
         for i, k in enumerate(keys):
             row = packed[i]

@@ -146,6 +146,9 @@ class DeviceMapDoc(CausalDeviceDoc):
             conflict_slots[: len(self.conflicts)] = list(self.conflicts)
 
         self._count_dispatch(label="apply_map_round")
+        # exact h2d meter: the round's op columns (one int8 + four int32
+        # M-padded arrays) + the conflict-slot vector
+        self._count_h2d(M * (1 + 4 * 4) + K * 4)
         (value_n, has_n, wa_n, ws_n, wc_n, slow_info) = apply_map_round(
             dev["value"], dev["has_value"], dev["win_actor"],
             dev["win_seq"], dev["win_counter"],
@@ -162,9 +165,13 @@ class DeviceMapDoc(CausalDeviceDoc):
         # one packed transfer: slow mask + slots + register state
         from .. import obs
         _ts = obs.now() if obs.ENABLED else 0
-        info = np.asarray(slow_info)[:, :n_ops]
+        # count the FULL padded buffer: that is what crosses the link —
+        # the n_ops slice is a host-side view after the transfer
+        info_full = np.asarray(slow_info)
         self._count_sync(label="slow_info_fetch",
-                         dur_ns=(obs.now() - _ts) if _ts else 0)
+                         dur_ns=(obs.now() - _ts) if _ts else 0,
+                         d2h_bytes=info_full.nbytes)
+        info = info_full[:, :n_ops]
         if info[0].any():
             idxs = np.nonzero(info[0])[0]
             self._apply_slow(

@@ -154,10 +154,19 @@ def _count(stats: dict, label: str):
     stats["dispatches"] += 1
 
 
-def _count_sync(stats: dict, label: str, t0_ns: int):
+def _count_sync(stats: dict, label: str, t0_ns: int, d2h_bytes: int = 0):
     accounting.record_sync(1, None, label=label,
-                           dur_ns=(obs.now() - t0_ns) if t0_ns else 0)
+                           dur_ns=(obs.now() - t0_ns) if t0_ns else 0,
+                           d2h_bytes=d2h_bytes)
     stats["syncs"] += 1
+
+
+def _note_h2d(stats: dict, n_uploads: int, nbytes: int):
+    """One stacked upload seam: transfer COUNT into the per-apply stats
+    (the budget surface), exact BYTES into the process meter
+    (engine/accounting.py h2d_bytes; ISSUE 15)."""
+    stats["h2d"] += n_uploads
+    accounting.record_h2d(nbytes)
 
 
 class _LaneSet:
@@ -210,7 +219,7 @@ class _LaneSet:
                 tables, jnp.asarray(rem), jnp.asarray(n_elems),
                 out_cap=out_cap)
         self.cap = out_cap
-        stats["h2d"] += 1
+        _note_h2d(stats, 1, rem.nbytes)
 
 
 def _host_remap(doc, remap: np.ndarray):
@@ -479,7 +488,7 @@ def _exec_map_pass(lane_set: _LaneSet, plans, stats: dict):
         ops[d, K.MOP_WIN_ACTOR, :n] = p["win_actor"]
         ops[d, K.MOP_WIN_SEQ, :n] = p["win_seq"]
     _count(stats, "stacked_map_round")
-    stats["h2d"] += 2
+    _note_h2d(stats, 2, ops.nbytes + conflict.nbytes)
     out = K.stacked_map_round(*lane_set.cols, jnp.asarray(ops),
                               jnp.asarray(conflict), out_cap=out_cap)
     lane_set.cols = out[:5]
@@ -487,7 +496,7 @@ def _exec_map_pass(lane_set: _LaneSet, plans, stats: dict):
     # ONE packed d2h fetch serves every object's slow residue
     _ts = obs.now() if obs.ENABLED else 0
     info = np.asarray(out[5])
-    _count_sync(stats, "stacked_slow_info", _ts)
+    _count_sync(stats, "stacked_slow_info", _ts, d2h_bytes=info.nbytes)
     wbs = {}
     for d, (doc, b, p) in active.items():
         row = info[d][:, : p["n_ops"]]
@@ -524,7 +533,7 @@ def _stacked_slow_scatter(lane_set: _LaneSet, wbs: dict, out_cap: int,
         stacked_wb[d, :, : wb.shape[1]] = wb
     regs = lane_set.cols[reg_offset: reg_offset + 5]
     _count(stats, "stacked_scatter")
-    stats["h2d"] += 1
+    _note_h2d(stats, 1, stacked_wb.nbytes)
     out = K.stacked_scatter_registers(*regs, jnp.asarray(stacked_wb))
     lane_set.cols = (lane_set.cols[:reg_offset] + tuple(out)
                      + lane_set.cols[reg_offset + 5:])
@@ -609,8 +618,9 @@ def _exec_text_pass(lane_set: _LaneSet, plans, stats: dict):
             doc._begin_round_host(p)
 
         _count(stats, "stacked_mixed_round")
-        stats["h2d"] += sum(x is not None for x in
-                            (desc_g, blob_g, res_g, touch_g, conflict_g))
+        uploads = [x for x in (desc_g, blob_g, res_g, touch_g, conflict_g)
+                   if x is not None]
+        _note_h2d(stats, len(uploads), sum(x.nbytes for x in uploads))
         out = K.stacked_mixed_round(
             *lane_set.cols,
             jnp.asarray(desc_g) if desc_g is not None else dummy,
@@ -630,7 +640,8 @@ def _exec_text_pass(lane_set: _LaneSet, plans, stats: dict):
         if with_res:
             _ts = obs.now() if obs.ENABLED else 0
             info = np.asarray(out[9])
-            _count_sync(stats, "stacked_slow_info", _ts)
+            _count_sync(stats, "stacked_slow_info", _ts,
+                        d2h_bytes=info.nbytes)
             wbs = {}
             for d, (doc, b, p) in active.items():
                 row = info[d][:, : p.n_res]
@@ -698,7 +709,7 @@ def _finalize(lane_set: _LaneSet, stats: dict):
         n_el = np.asarray([doc.n_elems for doc in lane_set.docs],
                           np.int32)
         _count(stats, "stacked_linearize")
-        stats["h2d"] += 1
+        _note_h2d(stats, 1, n_el.nbytes)
         fetch_cols.append(stacked_linearize(
             lane_set.cols[lane_set.keys.index("parent")][:, :w],
             lane_set.cols[lane_set.keys.index("ctr")][:, :w],
@@ -708,7 +719,8 @@ def _finalize(lane_set: _LaneSet, stats: dict):
     _count(stats, "stacked_mirror_fetch")
     _ts = obs.now() if obs.ENABLED else 0
     packed = np.asarray(K.stacked_pack_rows(*fetch_cols))
-    _count_sync(stats, "stacked_mirror_fetch", _ts)
+    _count_sync(stats, "stacked_mirror_fetch", _ts,
+                d2h_bytes=packed.nbytes)
     for d, doc in enumerate(lane_set.docs):
         doc._dev = dict(zip(lane_set.keys, rows[d]))
         doc._cap = cap

@@ -250,6 +250,26 @@ class DeviceTextDoc(CausalDeviceDoc):
             }
         return self._dev
 
+    def _device_footprint_extra(self) -> int:
+        # device bytes held outside the 9-table dict: the staged n_elems
+        # scalar and the cached materialization buffers (codes/pos live
+        # on device until a pull fetches them)
+        extra = 4 if self._n_elems_dev else 0
+        if self._mat is not None:
+            for a in self._mat:
+                if (not isinstance(a, np.ndarray)
+                        and hasattr(a, "dtype") and hasattr(a, "shape")):
+                    n = 1
+                    for d in a.shape:
+                        n *= int(d)
+                    extra += n * np.dtype(a.dtype).itemsize
+        return extra
+
+    def _host_footprint_extra(self) -> dict:
+        return {"index_ranges": int(self.index.n_ranges),
+                "segments": (self.seg_mirror.n_segs
+                             if self.seg_mirror is not None else 0)}
+
     def _invalidate(self):
         self._host = None
         self._scal = None
@@ -847,10 +867,14 @@ class DeviceTextDoc(CausalDeviceDoc):
                     # the ONE d2h round trip of the residual path: slow
                     # mask + slots + register state, one packed transfer
                     _ts = obs.now() if obs.ENABLED else 0
-                    slow_info_np = np.asarray(out[9])[:, : plan.n_res]
+                    # full padded buffer bytes: the M-bucketed matrix is
+                    # what crosses the link, the n_res slice is a view
+                    slow_full = np.asarray(out[9])
                     self._count_sync(label="slow_info_fetch",
                                      dur_ns=(obs.now() - _ts) if _ts
-                                     else 0)
+                                     else 0,
+                                     d2h_bytes=slow_full.nbytes)
+                    slow_info_np = slow_full[:, : plan.n_res]
         except BaseException:
             # poison ONLY when a donated kernel actually consumed the live
             # tables (a trace/compile failure consumes nothing and stays
@@ -955,8 +979,10 @@ class DeviceTextDoc(CausalDeviceDoc):
                 self._materialize(with_pos=False)
             heals = 0
             while True:
-                self._count_sync(label="scalars_fetch")  # the read path's one device sync
                 scalars = np.asarray(self._mat[-1])
+                self._count_sync(label="scalars_fetch",  # the read path's
+                                 # one device sync
+                                 d2h_bytes=scalars.nbytes)
                 n_segs = int(scalars[1])
                 if len(scalars) == 5:
                     # planned materialization: verify the host mirror against
@@ -1019,9 +1045,10 @@ class DeviceTextDoc(CausalDeviceDoc):
             elif self.use_condensed:
                 self._materialize(with_pos=True)
                 self._scalars()  # verify the S bucket fit (re-runs if not)
-                self._count_sync(label="positions_fetch")
-                self._pos_cache = np.asarray(
-                    self._mat[0])[: self.n_elems + 1]
+                pos_np = np.asarray(self._mat[0])
+                self._count_sync(label="positions_fetch",
+                                 d2h_bytes=pos_np.nbytes)
+                self._pos_cache = pos_np[: self.n_elems + 1]
             else:
                 self._pos_cache = self._positions_full()
         return self._pos_cache
@@ -1043,12 +1070,13 @@ class DeviceTextDoc(CausalDeviceDoc):
         valid = np.zeros(cap, bool)
         valid[:n] = True
         self._count_dispatch(label="rga_linearize")
-        self._count_sync(label="rga_linearize")
         pos = rga_linearize(jnp.asarray(padded(h["parent"])),
                             jnp.asarray(padded(h["ctr"])),
                             jnp.asarray(padded(h["actor"])),
                             jnp.asarray(valid))
-        return np.asarray(pos)[:n]
+        pos_np = np.asarray(pos)
+        self._count_sync(label="rga_linearize", d2h_bytes=pos_np.nbytes)
+        return pos_np[:n]
 
     def visible_order(self) -> np.ndarray:
         """Slots of visible elements in list order."""
@@ -1091,8 +1119,10 @@ class DeviceTextDoc(CausalDeviceDoc):
                     return out
             self._materialize(with_pos=False)
             n_vis = int(self._scalars()[0])   # may re-run w/ bigger S
-            self._count_sync(label="codes_pull")      # the O(doc) codes pull
-            values = np.asarray(self._mat[-2])[:n_vis]
+            codes_np = np.asarray(self._mat[-2])      # the O(doc) codes pull
+            self._count_sync(label="codes_pull",
+                             d2h_bytes=codes_np.nbytes)
+            values = codes_np[:n_vis]
             self.pull_stats = {"mode": "full",
                                "span_bytes": int(values.nbytes),
                                "n_spans": 1}
@@ -1147,9 +1177,11 @@ class DeviceTextDoc(CausalDeviceDoc):
         else:
             n = np.int32(self.n_elems)
         self._count_dispatch(label="segment_visible_counts")
-        self._count_sync(label="segment_visible_counts")
-        return np.asarray(segment_visible_counts(
+        counts = np.asarray(segment_visible_counts(
             dev["has_value"], n, segplan_dev, S=S, L=L))
+        self._count_sync(label="segment_visible_counts",
+                         d2h_bytes=counts.nbytes)
+        return counts
 
     def _seed_text_cache(self, text: str):
         """Record the per-segment table for the NEXT pull to diff against
@@ -1278,9 +1310,11 @@ class DeviceTextDoc(CausalDeviceDoc):
             spans_np[0, :n_spans] = span_starts
             spans_np[1, :n_spans] = span_lens
             self._count_dispatch(label="gather_spans")
-            self._count_sync(label="gather_spans")
-            buf = np.asarray(gather_spans(codes, jnp.asarray(spans_np),
-                                          P=P))[:total]
+            buf_full = np.asarray(gather_spans(codes, jnp.asarray(spans_np),
+                                               P=P))
+            self._count_sync(label="gather_spans",
+                             d2h_bytes=buf_full.nbytes)
+            buf = buf_full[:total]
             pulled = buf.tobytes().decode("ascii")
             span_bytes = int(buf.nbytes)
         else:

@@ -217,7 +217,9 @@ def metrics_snapshot(since_ns: int = 0) -> dict:
     is also always available directly via `span_totals(snapshot())`.
     """
     if _recorder is None:
-        return {"counters": {}, "spans": {}, "emitted": 0, "retained": 0}
+        out = {"counters": {}, "spans": {}, "emitted": 0, "retained": 0}
+        _merge_device_truth(out)
+        return out
     if since_ns == 0 and _telemetry is not None:
         spans = {f"{c}.{n}": dict(agg) for (c, n), agg
                  in sorted(_telemetry.span_aggregates().items())}
@@ -225,13 +227,27 @@ def metrics_snapshot(since_ns: int = 0) -> dict:
         spans = {f"{c}.{n}": agg for (c, n), agg
                  in sorted(span_totals(_recorder.snapshot(since_ns))
                            .items())}
-    return {
+    out = {
         "counters": {f"{c}.{n}": v
                      for (c, n), v in sorted(_recorder.counters().items())},
         "spans": spans,
         "emitted": _recorder.n_emitted,
         "retained": _recorder.n_retained,
     }
+    _merge_device_truth(out)
+    return out
+
+
+def _merge_device_truth(out: dict):
+    """Attach the always-on device-truth aggregates (compile registry,
+    footprint gauges, persistent-compile-cache state; INTERNALS §19)
+    when the session touched a device — independent of the trace ring,
+    like the lineage ledger."""
+    from . import device_truth
+    reg = device_truth.REGISTRY
+    if reg.compiles_total or reg.peak_bytes or any(
+            h.calls for h in reg._kernels.values()):
+        out["device_truth"] = device_truth.summary()
 
 
 def clear():
@@ -258,3 +274,8 @@ if os.environ.get("AMTPU_TRACE", "0") not in ("", "0"):
 # bootstrap); imported last so `obs` is fully initialized when lineage's
 # emit path reaches back for the trace-ring flag
 from . import lineage  # noqa: E402,F401
+
+# the device-truth tier (its own always-on module flag; INTERNALS §19):
+# imported for the same reason — metrics_snapshot and write_trace reach
+# into it, and ops/ingest.py re-binds its kernels through it at import
+from . import device_truth  # noqa: E402,F401

@@ -100,6 +100,11 @@ def to_chrome_trace(records, t0_ns: Optional[int] = None,
             ev["s"] = "t"
         events.append(ev)
     events += lineage_flow_events(records, t0_ns, pid)
+    # device-truth counter tracks (INTERNALS §19): compile totals and
+    # device-resident bytes as "C"-phase samples on the same timeline —
+    # Perfetto draws them as counter lanes under the span tracks
+    from .device_truth import REGISTRY as _dt_registry
+    events += _dt_registry.counter_events(t0_ns, pid)
     meta = [{"ph": "M", "name": "process_name", "pid": pid, "ts": 0,
              "args": {"name": "automerge_tpu"}}]
     meta += [{"ph": "M", "name": "thread_name", "pid": pid, "tid": t,
@@ -128,6 +133,8 @@ def validate_chrome_trace(obj, require_stream_nesting: bool = False,
       FAILS — a --trace run that recorded nothing is a wiring bug);
     - every "X" span carries name/cat/ts/dur with dur >= 0;
     - every "i" instant carries name/cat/ts;
+    - every "C" counter sample carries name/cat/ts plus a numeric
+      args value (the device-truth counter tracks, INTERNALS §19);
     - flow events ("s"/"t"/"f") PAIR UP: every flow id with a start has
       exactly one finish, steps/finishes never appear without a start,
       and each flow's timestamps are monotone — a dangling flow is a
@@ -147,6 +154,7 @@ def validate_chrome_trace(obj, require_stream_nesting: bool = False,
         raise TraceValidationError("trace must be an object with a "
                                    "traceEvents list")
     spans, instants, streams, rings = [], [], [], []
+    counters: list = []
     flows: dict = {}    # id -> {"s": [...], "t": [...], "f": [...]}
     for ev in obj["traceEvents"]:
         ph = ev.get("ph")
@@ -168,6 +176,13 @@ def validate_chrome_trace(obj, require_stream_nesting: bool = False,
                 rings.append(ev)
         elif ph == "i":
             instants.append(ev)
+        elif ph == "C":
+            vals = ev.get("args")
+            if not isinstance(vals, dict) or not vals or any(
+                    not isinstance(v, (int, float)) for v in vals.values()):
+                raise TraceValidationError(
+                    f"counter sample without numeric args: {ev!r}")
+            counters.append(ev)
         elif ph in ("s", "t", "f"):
             if "id" not in ev:
                 raise TraceValidationError(f"flow event without an "
@@ -205,4 +220,4 @@ def validate_chrome_trace(obj, require_stream_nesting: bool = False,
                     f"span: {ev!r}")
     return {"n_spans": len(spans), "n_events": len(instants),
             "n_streams": len(streams), "n_ring_spans": len(rings),
-            "n_flows": len(flows)}
+            "n_flows": len(flows), "n_counter_samples": len(counters)}

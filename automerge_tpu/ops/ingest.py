@@ -1236,3 +1236,70 @@ def unstack_rows(cols):
     stacked apply costs one dispatch, not one slice per (doc, table)."""
     D = cols[0].shape[0]
     return tuple(tuple(c[d] for c in cols) for d in range(D))
+
+
+# ---------------------------------------------------------------------------
+# Device-truth registry (obs/device_truth.py; INTERNALS §19)
+#
+# Every kernel the engine DISPATCHES (the module attributes the labeled
+# `_count_dispatch` sites launch) is re-bound to an instrumented handle:
+# one ~60 ns cache-size probe per launch detects compile events (wall
+# time + shape signature + default device), and the registry lazily
+# captures XLA cost/memory analysis once per compiled executable. The
+# building-block kernels that only ever run INSIDE fused programs
+# (expand_runs*, break_chains*, apply_residual*) are deliberately NOT
+# wrapped — they never launch on their own from the engine, and wrapping
+# them would record phantom compile events during the fused kernels'
+# traces. Call sites are unchanged: the handles ARE the module
+# attributes everyone already imports.
+# ---------------------------------------------------------------------------
+
+from ..obs import device_truth as _device_truth  # noqa: E402
+
+apply_mixed_round, apply_mixed_round_donated = \
+    _device_truth.instrument_pair(
+        (apply_mixed_round, apply_mixed_round_donated), "apply_mixed_round")
+apply_map_round = _device_truth.instrument(apply_map_round,
+                                           "apply_map_round")
+merge_and_materialize_dense, merge_and_materialize_dense_donated = \
+    _device_truth.instrument_pair(
+        (merge_and_materialize_dense, merge_and_materialize_dense_donated),
+        "merge_and_materialize_dense")
+(merge_and_materialize_dense_planned,
+ merge_and_materialize_dense_planned_donated) = \
+    _device_truth.instrument_pair(
+        (merge_and_materialize_dense_planned,
+         merge_and_materialize_dense_planned_donated),
+        "merge_and_materialize_dense_planned")
+scatter_registers = _device_truth.instrument(scatter_registers,
+                                             "scatter_registers")
+scatter_registers_packed, scatter_registers_packed_donated = \
+    _device_truth.instrument_pair(
+        (scatter_registers_packed, scatter_registers_packed_donated),
+        "scatter_registers_packed")
+pack_rows = _device_truth.instrument(pack_rows, "pack_rows")
+remap_ranks = _device_truth.instrument(remap_ranks, "remap_ranks")
+remap_actors = _device_truth.instrument(remap_actors, "remap_actors")
+materialize_text = _device_truth.instrument(materialize_text,
+                                            "materialize_text")
+materialize_codes = _device_truth.instrument(materialize_codes,
+                                             "materialize_codes")
+materialize_text_planned = _device_truth.instrument(
+    materialize_text_planned, "materialize_text_planned")
+materialize_codes_planned = _device_truth.instrument(
+    materialize_codes_planned, "materialize_codes_planned")
+segment_visible_counts = _device_truth.instrument(
+    segment_visible_counts, "segment_visible_counts")
+stack_register_tables = _device_truth.instrument(
+    stack_register_tables, "stack_register_tables")
+stack_element_tables = _device_truth.instrument(
+    stack_element_tables, "stack_element_tables")
+stacked_map_round = _device_truth.instrument(stacked_map_round,
+                                             "stacked_map_round")
+stacked_mixed_round = _device_truth.instrument(stacked_mixed_round,
+                                               "stacked_mixed_round")
+stacked_scatter_registers = _device_truth.instrument(
+    stacked_scatter_registers, "stacked_scatter_registers")
+stacked_pack_rows = _device_truth.instrument(stacked_pack_rows,
+                                             "stacked_pack_rows")
+unstack_rows = _device_truth.instrument(unstack_rows, "unstack_rows")

@@ -68,9 +68,8 @@ def pad_capacity(n: int, minimum: int = 16) -> int:
     return cap
 
 
-@jax.jit
-def rga_linearize(parent: jax.Array, ctr: jax.Array, actor: jax.Array,
-                  valid: jax.Array) -> jax.Array:
+def _rga_linearize(parent: jax.Array, ctr: jax.Array, actor: jax.Array,
+                   valid: jax.Array) -> jax.Array:
     """Compute RGA list positions for a padded element table.
 
     Index 0 is the virtual head; real elements live at indexes 1..n-1 (padded
@@ -140,6 +139,11 @@ def rga_linearize(parent: jax.Array, ctr: jax.Array, actor: jax.Array,
     return pos
 
 
+# jitted form the engine dispatches; the stacked kernel vmaps the CORE
+# so its trace never re-enters the instrumented jit boundary below
+rga_linearize = jax.jit(_rga_linearize)
+
+
 @jax.jit
 def stacked_linearize(parent: jax.Array, ctr: jax.Array, actor: jax.Array,
                       n_elems: jax.Array) -> jax.Array:
@@ -154,7 +158,7 @@ def stacked_linearize(parent: jax.Array, ctr: jax.Array, actor: jax.Array,
     paying one linearize dispatch + sync per text object."""
     idx = jnp.arange(parent.shape[1], dtype=jnp.int32)[None, :]
     valid = idx <= n_elems[:, None]
-    return jax.vmap(rga_linearize)(parent, ctr, actor, valid)
+    return jax.vmap(_rga_linearize)(parent, ctr, actor, valid)
 
 
 @jax.jit
@@ -226,3 +230,16 @@ def rga_linearize_segments(parent: jax.Array, attach_off: jax.Array,
     # dist[i] = total weight from segment i (inclusive) to the end
     start = dist[HEAD] - dist[:n]
     return jnp.where(is_seg, start, jnp.where(idx == HEAD, 0, big))
+
+
+# --- device-truth registry (obs/device_truth.py; INTERNALS §19) ------------
+# the three linearize-side kernels the engine dispatches under labels
+# ("rga_linearize", "gather_spans", "stacked_linearize") get the same
+# compile/cost instrumentation as the ingest kernels; rga_linearize_segments
+# is host-experimented only and stays unwrapped until a label dispatches it
+from ..obs import device_truth as _device_truth  # noqa: E402
+
+rga_linearize = _device_truth.instrument(rga_linearize, "rga_linearize")
+gather_spans = _device_truth.instrument(gather_spans, "gather_spans")
+stacked_linearize = _device_truth.instrument(stacked_linearize,
+                                             "stacked_linearize")

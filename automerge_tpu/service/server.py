@@ -886,6 +886,11 @@ class SyncService:
             fams += lineage.families("amtpu_lineage")
         if obs.ENABLED and obs.telemetry() is not None:
             fams += prom.telemetry_families(obs.telemetry(), "amtpu_obs")
+        # device-truth families (INTERNALS §19): always-on like the
+        # service telemetry — kernel compile/call counters, persistent-
+        # cache outcomes, staged byte totals, per-doc/lane footprint
+        from ..obs import device_truth
+        fams += device_truth.families("amtpu_device")
         return prom.expose(fams)
 
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):

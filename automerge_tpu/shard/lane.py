@@ -151,7 +151,25 @@ class ShardLane:
             obs.span("shard", "lane_ingest", _t0, args={
                 "lane": self.index, "docs": len(items), "n_ops": n_ops,
                 "stacked": bool(st)})
+        self._note_footprint()
         return n_ops
+
+    def device_footprint(self) -> dict:
+        """Device-resident bytes of this lane: the sum of every resident
+        doc's table footprint (dtype x shape; obs/device_truth.py,
+        INTERNALS §19) — the per-shard-lane view the ``amtpu_device_``
+        footprint gauges carry next to the per-doc ones."""
+        per_doc = {doc_id: doc.device_footprint()["device_bytes"]
+                   for doc_id, doc in self.docs.items()}
+        return {"device_bytes": sum(per_doc.values()),
+                "n_docs": len(per_doc), "per_doc": per_doc}
+
+    def _note_footprint(self):
+        from ..obs import device_truth
+        if device_truth.ENABLED:
+            device_truth.REGISTRY.note_footprint(
+                "lane", f"lane{self.index}",
+                self.device_footprint()["device_bytes"])
 
     def ring(self, doc_id: str, slots: int = None, donate: bool = False):
         """A K-deep pipelined ingestion ring (engine/pipeline) bound to

@@ -597,6 +597,45 @@ def config14_lineage(quick: bool = False, record_session: bool = False):
          threshold=rec["threshold"])
 
 
+def config15_device_truth(quick: bool = False,
+                          record_session: bool = False):
+    """Device-truth observability row (ISSUE 15, INTERNALS §19): the
+    cfg15 steady-state stream — zero compile events asserted inside the
+    timed reps, exact h2d/d2h staged bytes per op, dtype x shape peak
+    device footprint, cost-model flops/bytes per op, and the
+    persistent-compile-cache state. Subprocess for a clean registry/jax
+    state; ``--session`` appends the row to BENCH_SESSIONS.jsonl."""
+    import os
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "AMTPU_SKIP_PREFLIGHT": "1"}
+    cmd = [sys.executable, os.path.join(root, "bench.py"),
+           "--device-truth"]
+    if quick:
+        cmd.append("--quick")
+    if record_session:
+        cmd.append("--session")
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=root,
+                         env=env, timeout=1200)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"cfg15 device-truth bench failed rc={out.returncode}: "
+            f"{out.stderr[-800:]}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    emit("cfg15_device_truth_ops_per_sec", rec["value"], "ops/s",
+         compile_count=rec["compile_count"],
+         recompiles_at_steady_state=rec["recompiles_at_steady_state"],
+         bytes_staged_per_op=rec["bytes_staged_per_op"],
+         d2h_bytes_per_op=rec["d2h_bytes_per_op"],
+         peak_device_bytes=rec["peak_device_bytes"],
+         cost_model_flops_per_op=rec["cost_model_flops_per_op"],
+         cost_model_bytes_per_op=rec["cost_model_bytes_per_op"],
+         compile_cache_entries=rec["compile_cache"]["entries"],
+         measured_platform=rec["platform"],
+         threshold=rec["threshold"])
+
+
 def config5b_residual_heavy(n_actors: int = 10_000, quick: bool = False):
     """Adversarial headline shape: 20% of ops are RESIDUALS (bare deletes
     of distinct base elements + bare inserts without values) that cannot
@@ -1335,6 +1374,10 @@ def main():
         # the chip_session.sh cfg14 step: ONLY the lineage A/B row
         config14_lineage(quick=quick, record_session=True)
         return
+    if "--device-truth-session" in sys.argv:
+        # the chip_session.sh cfg15 step: ONLY the device-truth row
+        config15_device_truth(quick=quick, record_session=True)
+        return
     record_round = None
     record_path = None
     if "--record" in sys.argv:
@@ -1421,6 +1464,7 @@ def main():
         lambda: config12t_text_prepare(quick=quick),
         lambda: config13_wire(quick=quick),
         lambda: config14_lineage(quick=quick),
+        lambda: config15_device_truth(quick=quick),
     ]
     if record_path is not None:
         steps.insert(0, fold_headline)

@@ -64,6 +64,12 @@ SLOS = [
     # change's actual journey pages here even while throughput holds)
     ("cfg14_lineage", "value", "min", 0.8),
     ("cfg14_lineage", "visibility_p99_ms", "max", 1.5),
+    # ISSUE 15: device-truth rows — throughput floor plus a relative
+    # ceiling on staged bytes per admitted op (a staging regression that
+    # re-uploads what donation kept resident, or fattens a packed
+    # matrix, pages here even while throughput still holds)
+    ("cfg15_device_truth", "value", "min", 0.8),
+    ("cfg15_device_truth", "bytes_staged_per_op", "max", 1.25),
 ]
 
 #: Absolute SLOs: (metric_prefix, dotted field, op, bound) checked on
@@ -101,6 +107,12 @@ ABS_SLOS = [
     # against an off-path that starts doing work)
     ("cfg14_lineage", "overhead_pct", "<=", 5.0),
     ("cfg14_lineage", "off_ratio_vs_baseline", ">=", 0.99),
+    # the ISSUE-15 acceptance bar on every committed cfg15 row, forever:
+    # the steady-state stream compiles NOTHING inside its timed region —
+    # a bucket-churn recompile is a structural regression of the
+    # static-shape discipline, not box weather (also asserted in-run by
+    # device_truth.steady_state)
+    ("cfg15_device_truth", "recompiles_at_steady_state", "<=", 0),
 ]
 
 #: Derived fields computable from any row that carries the inputs.

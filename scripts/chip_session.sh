@@ -136,6 +136,16 @@ run "cfg13_wire" 1200 python -m benchmarks.run_all --wire-session
 # overhead bar asserted inside the measurement, visibility quantiles
 # and per-stage dwell maxima recorded; appended to BENCH_SESSIONS.jsonl
 run "cfg14_lineage" 1200 python -m benchmarks.run_all --lineage-session
+# device-truth telemetry (ISSUE 15): the cfg15 row on the chip — the
+# FIRST run whose compile wall times, persistent-cache hit/miss split,
+# per-kernel cost-model flops/bytes, staged bytes/op and peak device
+# footprint are measured below the dispatch boundary on real hardware;
+# recompiles_at_steady_state == 0 asserted inside the measurement (a
+# TPU bucket-churn recompile is exactly what this step exists to
+# catch), roofline ratio against the chip's datasheet peaks via
+# AMTPU_PEAK_FLOPS / AMTPU_PEAK_BYTES_PER_S; appended to
+# BENCH_SESSIONS.jsonl
+run "cfg15_device_truth" 1200 python -m benchmarks.run_all --device-truth-session
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu

@@ -92,7 +92,9 @@ def test_track_thread_isolation_under_concurrent_dispatches():
     finally:
         stop.set()
         th.join()
-    assert t.thread_stats == {"dispatches": 2, "syncs": 0}, t.thread_stats
+    assert t.thread_stats == {"dispatches": 2, "syncs": 0,
+                              "h2d_bytes": 0, "d2h_bytes": 0}, \
+        t.thread_stats
     # the process-wide delta picked the noise up (>= its own work)
     assert t.stats["dispatches"] >= 2 and t.stats["syncs"] >= 1, t.stats
 
