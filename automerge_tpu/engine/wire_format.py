@@ -62,7 +62,8 @@ from ..resilience.errors import ProtocolError
 __all__ = ["WireFormatError", "WireFrame", "encode_batch", "encode_changes",
            "decode", "materialize_changes", "split_outgoing",
            "combine_frames", "as_frame", "wire_binary_enabled",
-           "wire_min_ops", "validate_trace_context"]
+           "wire_min_ops", "validate_trace_context",
+           "validate_group_token"]
 
 MAGIC = b"AMTPUWIRE1\n"
 FORMAT = "automerge-tpu-wire"
@@ -294,7 +295,35 @@ def validate_trace_context(trace):
     return trace
 
 
-def encode_batch(batch, deps=None, trace=None) -> bytes:
+def validate_group_token(group):
+    """Schema-check one per-replication-group ordering token (the
+    optional ``group`` manifest entry, INTERNALS §20.3):
+    ``[origin_region, room, token]`` — the Okapi-style cheap causal
+    metadata one federated region stamps on the frames it mints. One
+    monotone counter per (room, origin region): cross-region ordering
+    costs O(groups), never O(peers); full per-peer clocks stay
+    intra-region. Typed :class:`WireFormatError` on malformation —
+    like trace context, a flipped bit must reject, never crash, and
+    decoders that predate the entry simply never look."""
+    if not isinstance(group, list) or len(group) != 3:
+        raise WireFormatError(
+            "malformed group token: expected [origin_region, room, "
+            f"token], got {group!r}")
+    region, room, token = group
+    if not isinstance(region, str) or not region:
+        raise WireFormatError("group-token origin_region must be a "
+                              "non-empty string")
+    if not isinstance(room, str) or not room:
+        raise WireFormatError("group-token room must be a non-empty "
+                              "string")
+    if not isinstance(token, int) or isinstance(token, bool) \
+            or not 1 <= token < 2**63:
+        raise WireFormatError("group-token counter must be a positive "
+                              "int64")
+    return group
+
+
+def encode_batch(batch, deps=None, trace=None, group=None) -> bytes:
     """Serialize an op-columnar batch (with its per-change columns) to
     one byte-deterministic ``AMTPUWIRE1`` frame.
 
@@ -349,6 +378,10 @@ def encode_batch(batch, deps=None, trace=None) -> bytes:
                 "n_change_actors": cols.n_change_actors}
     if trace:
         manifest["trace"] = validate_trace_context(trace)
+    if group:
+        # per-replication-group ordering token (INTERNALS §20.3):
+        # version-tolerant like `trace`, covered by the manifest hash
+        manifest["group"] = validate_group_token(list(group))
     return _pack(manifest, arrays)
 
 
@@ -496,14 +529,15 @@ def change_in_scope(change):
     return kind, obj
 
 
-def split_outgoing(changes, min_ops: int = None, trace=None):
+def split_outgoing(changes, min_ops: int = None, trace=None, group=None):
     """Peel the longest frame-scoped suffix off an outbound change list:
     -> (dict_prefix, frame_bytes_or_None). The common history shape —
     one creation change followed by a long single-object tail — becomes
     one small dict prefix plus one frame; fully out-of-scope payloads
     come back unchanged with no frame. ``trace`` (lineage context for
-    the WHOLE change list, prefix included) rides the frame's
-    manifest."""
+    the WHOLE change list, prefix included) and ``group`` (the
+    federation's per-replication-group ordering token, INTERNALS §20.3)
+    ride the frame's manifest."""
     if min_ops is None:
         min_ops = wire_min_ops()
     if not isinstance(changes, list) or not changes:
@@ -531,10 +565,11 @@ def split_outgoing(changes, min_ops: int = None, trace=None):
     try:
         frame = encode_batch(cls.from_changes(suffix, obj),
                              deps=[c["deps"] for c in suffix],
-                             trace=trace)
+                             trace=trace, group=group)
     except (ValueError, OverflowError, TypeError):
         return changes, None             # stay on the dict wire
-    return changes[:start], WireFrame(frame, changes=suffix, trace=trace)
+    return changes[:start], WireFrame(frame, changes=suffix, trace=trace,
+                                      group=group)
 
 
 # ---------------------------------------------------------------------------
@@ -614,6 +649,11 @@ def decode(data):
     trace = manifest.get("trace")
     if trace is not None:
         validate_trace_context(trace)
+    # optional per-replication-group ordering token (INTERNALS §20.3):
+    # same version-tolerance contract as trace context
+    group = manifest.get("group")
+    if group is not None:
+        validate_group_token(group)
 
     local_actors = _json_list(sections, "local_actors")
     _require(local_actors is not None, "missing section 'local_actors'")
@@ -757,6 +797,7 @@ def decode(data):
         distinct_actors=bool(nca == n))
     batch._change_columns = cols
     batch._trace = trace
+    batch._group = group
     return batch
 
 
@@ -846,9 +887,10 @@ class WireFrame:
     materializes the canonical dicts once (the quarantine/park and
     history paths)."""
 
-    __slots__ = ("data", "_batch", "_changes", "_trace")
+    __slots__ = ("data", "_batch", "_changes", "_trace", "_group")
 
-    def __init__(self, data: bytes, batch=None, changes=None, trace=None):
+    def __init__(self, data: bytes, batch=None, changes=None, trace=None,
+                 group=None):
         if not isinstance(data, (bytes, bytearray, memoryview)):
             raise WireFormatError(
                 f"wire frame must be bytes, got {type(data).__name__}")
@@ -856,6 +898,7 @@ class WireFrame:
         self._batch = batch
         self._changes = changes
         self._trace = trace
+        self._group = group
 
     # -- cheap introspection (decodes on first use) --------------------
 
@@ -883,6 +926,18 @@ class WireFrame:
             return self._trace
         b = self._batch
         return getattr(b, "_trace", None) if b is not None else None
+
+    @property
+    def group(self):
+        """Per-replication-group ordering token carried in the frame
+        manifest (``[origin_region, room, token]``, INTERNALS §20.3),
+        or None — same no-forced-decode contract as ``trace``: set at
+        encode time on the sender's object, read from the manifest
+        after the receive side decodes."""
+        if self._group is not None:
+            return self._group
+        b = self._batch
+        return getattr(b, "_group", None) if b is not None else None
 
     @property
     def n_changes(self) -> int:
@@ -1068,6 +1123,14 @@ def combine_frames(frames):
                 seen_trace.add(key)
                 merged_trace.append(ent)
     combined._trace = merged_trace or None
+    # group tokens: a combined delivery spanning one (origin region,
+    # room) group keeps the HIGHEST token (observe() takes max anyway);
+    # mixed-group combines drop the token — the per-frame observation
+    # already happened at link delivery
+    groups = [tuple(f.group) for f in frames if f.group]
+    combined._group = None
+    if groups and len({g[:2] for g in groups}) == 1:
+        combined._group = list(max(groups, key=lambda g: g[2]))
     cached = [f._changes for f in frames]
     if all(c is not None for c in cached):
         combined._changes = [c for sub in cached for c in sub]

@@ -21,7 +21,9 @@ from .validation import (  # noqa: F401
     validate_op, validate_save_payload,
 )
 from .quarantine import DEFAULT_CAPACITY, QuarantineQueue  # noqa: F401
-from .chaos import ChaosLink  # noqa: F401
+from .chaos import (  # noqa: F401
+    WAN_PROFILES, ChaosLink, wan_pair, wan_profile,
+)
 from .channel import ResilientChannel, validate_envelope  # noqa: F401
 
 # `inbound` resolves lazily (PEP 562): it imports the frontend, which is

@@ -53,6 +53,18 @@ def validate_envelope(env) -> dict:
             raise ProtocolError(
                 f"channel envelope `{field}` must be an integer, got "
                 f"{env.get(field)!r}") from None
+    for field in ("epoch", "aepoch"):
+        # optional reconnect-epoch fields (revive()): absent == 0, so a
+        # pre-epoch peer's envelopes stay byte-identical and valid
+        if field in env:
+            try:
+                if operator.index(env[field]) < 0:
+                    raise ProtocolError(
+                        f"channel envelope `{field}` must be >= 0")
+            except TypeError:
+                raise ProtocolError(
+                    f"channel envelope `{field}` must be an integer, got "
+                    f"{env[field]!r}") from None
     if kind == "data" and "payload" not in env:
         raise ProtocolError("truncated data envelope: missing `payload`")
     return env
@@ -120,12 +132,58 @@ class ResilientChannel:
         self._recv_high = 0           # highest contiguously delivered seq
         self._recv_buf: dict = {}     # out-of-order seq -> payload
         self.dead = False
+        #: reconnect epochs (revive(), INTERNALS §20.2): `epoch` scopes
+        #: OUR seq numbering, `_peer_epoch` the highest sender epoch we
+        #: accept data under. Both start at 0 and the fields are omitted
+        #: from envelopes while 0, so a never-revived channel is
+        #: wire-identical to the pre-epoch protocol.
+        self.epoch = 0
+        self._peer_epoch = 0
         self.stats = {"sent": 0, "retransmits": 0, "acks_sent": 0,
                       "dup_dropped": 0, "held_out_of_order": 0,
                       "window_dropped": 0, "delivered": 0,
                       "deliver_errors": 0, "backpressured": 0,
                       "bytes_sent": 0, "bytes_resent": 0,
-                      "dead": False}
+                      "dead": False, "revives": 0,
+                      "stale_epoch_dropped": 0, "stale_acks": 0}
+
+    def _stamp(self, env: dict) -> dict:
+        """Attach the reconnect-epoch fields when nonzero: `epoch` scopes
+        this envelope's seq numbering, `aepoch` names the peer epoch its
+        cumulative ack refers to. Omitted at 0 (the common case), so a
+        never-revived channel's wire bytes are unchanged."""
+        if self.epoch:
+            env["epoch"] = self.epoch
+        if self._peer_epoch:
+            env["aepoch"] = self._peer_epoch
+        return env
+
+    def revive(self):
+        """Re-establish a channel declared dead by retransmit-cap
+        exhaustion (the partition-heal reconnect path, INTERNALS §20.2):
+        a FRESH seq/ack epoch — seq numbering restarts at 1, the send
+        window and reorder buffer reset, and both epoch counters bump so
+        (a) stale acks from the old epoch cannot delete new-epoch window
+        entries and (b) stale pre-epoch data frames still floating in
+        the network drop instead of replaying into the reset receive
+        window. Correctness does NOT depend on resending the cleared
+        window: the sync layer above re-advertises on reconnect (hub
+        peer remove/re-add), and the clock exchange re-extracts anything
+        the partition ate — the proven lossy-link recovery contract.
+        Both endpoints must revive for a reconnect cycle (the federation
+        hello handshake coordinates this); `revive()` on a live channel
+        is allowed and simply starts the next epoch."""
+        self.epoch += 1
+        self._peer_epoch += 1
+        self._next_seq = 1
+        self._unacked.clear()
+        self._recv_high = 0
+        self._recv_buf.clear()
+        self.dead = False
+        self.stats["dead"] = False
+        self.stats["revives"] += 1
+        if obs.ENABLED:
+            obs.event("chan", "revive", args={"epoch": self.epoch})
 
     # -- outbound -------------------------------------------------------
 
@@ -138,8 +196,9 @@ class ResilientChannel:
         bench report wire bytes per op for the dict-vs-binary A/B)."""
         if self.dead:
             raise PeerDeadError(
-                "channel is dead (retransmit cap exhausted); reconnect "
-                "with a fresh channel")
+                "channel is dead (retransmit cap exhausted); revive() "
+                "it after the partition heals, or reconnect with a "
+                "fresh channel")
         seq = self._next_seq
         self._next_seq += 1
         nbytes = payload_wire_bytes(payload)
@@ -154,8 +213,9 @@ class ResilientChannel:
             # (e.g. a re-extracted resend on a fresh channel) records
             for a, s in lineage.payload_keys(payload):
                 lineage.hop(a, s, "chan/send", site=self.label, extra=seq)
-        self._send_raw({"kind": "data", "seq": seq,
-                        "ack": self._recv_high, "payload": payload})
+        self._send_raw(self._stamp({"kind": "data", "seq": seq,
+                                    "ack": self._recv_high,
+                                    "payload": payload}))
 
     def tick(self):
         """Advance one time round; retransmit overdue unacked envelopes
@@ -196,9 +256,9 @@ class ResilientChannel:
                 for a, s in lineage.payload_keys(entry["payload"]):
                     lineage.hop(a, s, "chan/retransmit", site=self.label,
                                 extra=(seq, entry["tries"]))
-            self._send_raw({"kind": "data", "seq": seq,
-                            "ack": self._recv_high,
-                            "payload": entry["payload"]})
+            self._send_raw(self._stamp({"kind": "data", "seq": seq,
+                                        "ack": self._recv_high,
+                                        "payload": entry["payload"]}))
 
     def _declare_dead(self, seq: int, tries: int):
         self.dead = True
@@ -217,13 +277,38 @@ class ResilientChannel:
 
     def on_wire(self, env):
         env = validate_envelope(env)
-        # cumulative ack (piggybacked on data, or a pure ack frame)
+        # cumulative ack (piggybacked on data, or a pure ack frame) —
+        # applied only when it refers to OUR current send epoch: a stale
+        # ack from before a revive() must not delete new-epoch window
+        # entries that happen to share seq numbers
         ack = env["ack"]
+        if ack and env.get("aepoch", 0) != self.epoch:
+            self.stats["stale_acks"] += 1
+            ack = 0
         if ack:
             for seq in [s for s in self._unacked if s <= ack]:
                 del self._unacked[seq]
         if env["kind"] == "ack":
             return
+        epoch = env.get("epoch", 0)
+        if epoch < self._peer_epoch:
+            # pre-epoch data still floating in the network after a
+            # reconnect: its seq numbering belongs to the dead epoch's
+            # space — deliverable-looking against the reset receive
+            # window, so it MUST drop (un-acked; nobody retransmits a
+            # dead epoch) rather than dedup by seq
+            self.stats["stale_epoch_dropped"] += 1
+            if obs.ENABLED:
+                obs.event("chan", "stale_epoch_drop",
+                          args={"seq": env["seq"], "epoch": epoch})
+            return
+        if epoch > self._peer_epoch:
+            # the peer revived ahead of us (its hello raced this data
+            # frame): adopt its new epoch — the old epoch's receive
+            # state is dead bookkeeping now
+            self._peer_epoch = epoch
+            self._recv_high = 0
+            self._recv_buf.clear()
         seq = env["seq"]
         if seq <= self._recv_high or seq in self._recv_buf:
             self.stats["dup_dropped"] += 1
@@ -272,7 +357,8 @@ class ResilientChannel:
                     obs.event("chan", "deliver_error",
                               args={"seq": self._recv_high})
         self.stats["acks_sent"] += 1
-        self._send_raw({"kind": "ack", "seq": 0, "ack": self._recv_high})
+        self._send_raw(self._stamp({"kind": "ack", "seq": 0,
+                                    "ack": self._recv_high}))
         if deliver_err is not None:
             raise deliver_err
 

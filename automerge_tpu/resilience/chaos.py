@@ -63,7 +63,8 @@ def _codec_hook(d):
 class ChaosLink:
     def __init__(self, deliver, *, seed: int = 0, rng=None,
                  drop: float = 0.0, dup: float = 0.0, reorder: float = 0.0,
-                 delay: float = 0.0, max_delay: int = 3, codec: bool = True):
+                 delay: float = 0.0, max_delay: int = 3,
+                 bandwidth: int = 0, codec: bool = True):
         self._deliver = deliver
         self._rng = rng if rng is not None else np.random.default_rng(seed)
         self.drop = drop
@@ -71,13 +72,20 @@ class ChaosLink:
         self.reorder = reorder
         self.delay = delay
         self.max_delay = max_delay
+        #: per-direction bandwidth cap: at most this many payload wire
+        #: bytes delivered per pump round (0 = unlimited). Frames past
+        #: the budget HOLD to later rounds (never drop — a WAN's queue,
+        #: not its loss), counted in ``throttled``. Asymmetric
+        #: cross-region paths set different caps per direction (the WAN
+        #: profiles below).
+        self.bandwidth = bandwidth
         self.codec = codec
         self.partitioned = False
         self._queue: list = []        # [due_round, payload]
         self._round = 0
         self.stats = {"sent": 0, "delivered": 0, "dropped": 0,
                       "partition_dropped": 0, "duplicated": 0,
-                      "reordered": 0, "delayed": 0}
+                      "reordered": 0, "delayed": 0, "throttled": 0}
 
     # -- fault schedule -------------------------------------------------
 
@@ -137,12 +145,25 @@ class ChaosLink:
                 self._queue.append(entry)
 
     def pump(self) -> int:
-        """Advance one round and deliver every due frame; returns the
-        number delivered."""
+        """Advance one round and deliver every due frame — up to the
+        bandwidth cap when one is set; over-budget frames hold (queue
+        order preserved) and count as ``throttled``. Returns the number
+        delivered."""
         self._round += 1
+        budget = self.bandwidth or None
         due, held = [], []
         for entry in self._queue:
-            (due if entry[0] < self._round else held).append(entry)
+            if entry[0] >= self._round:
+                held.append(entry)
+                continue
+            if budget is not None:
+                if budget <= 0:
+                    self.stats["throttled"] += 1
+                    held.append(entry)
+                    continue
+                from .channel import payload_wire_bytes
+                budget -= payload_wire_bytes(entry[1])
+            due.append(entry)
         self._queue = held
         for _, payload in due:
             self._deliver(payload)
@@ -162,3 +183,67 @@ class ChaosLink:
     @property
     def idle(self) -> bool:
         return not self._queue
+
+
+#: Named seeded WAN profiles (ISSUE 16): per-direction fault kwargs for
+#: a cross-region path, deliberately ASYMMETRIC — real WANs are (a fat
+#: egress pipe toward a thin return path, jitter that differs by
+#: direction). ``fwd`` is the a->b direction of :func:`wan_pair`,
+#: ``rev`` the b->a direction. Delay units are pump rounds (the
+#: federation pumps once per service tick, so `max_delay=20` models a
+#: high-RTT path ~20 ticks deep); ``bandwidth`` is payload wire bytes
+#: per round. Shared by scripts/soak.py --federation and the tests —
+#: ONE definition, so the soak and the acceptance tests can never drift
+#: onto different fault models.
+WAN_PROFILES = {
+    # steady high-RTT inter-region path: mild loss, deep delay, fat
+    # forward / thin return bandwidth
+    "wan": {
+        "fwd": dict(drop=0.02, dup=0.01, reorder=0.10, delay=0.6,
+                    max_delay=12, bandwidth=96 * 1024),
+        "rev": dict(drop=0.03, dup=0.01, reorder=0.15, delay=0.7,
+                    max_delay=20, bandwidth=32 * 1024),
+    },
+    # a flapping path trending toward partition: heavy loss + jitter
+    # (the explicit partition()/heal() windows ride on top)
+    "wan_partitioned": {
+        "fwd": dict(drop=0.15, dup=0.02, reorder=0.20, delay=0.8,
+                    max_delay=24, bandwidth=48 * 1024),
+        "rev": dict(drop=0.20, dup=0.02, reorder=0.25, delay=0.8,
+                    max_delay=32, bandwidth=16 * 1024),
+    },
+    # the federation default: moderate chaos both ways, asymmetric
+    # delay/bandwidth — survivable by retransmission without tripping
+    # the retry cap against a live peer
+    "cross_region": {
+        "fwd": dict(drop=0.05, dup=0.02, reorder=0.15, delay=0.5,
+                    max_delay=8, bandwidth=64 * 1024),
+        "rev": dict(drop=0.08, dup=0.02, reorder=0.20, delay=0.6,
+                    max_delay=14, bandwidth=24 * 1024),
+    },
+}
+
+
+def wan_profile(name: str, direction: str = "fwd") -> dict:
+    """One direction's ChaosLink kwargs from a named WAN profile (typed
+    KeyError on an unknown name — a misspelled profile must not silently
+    run lossless)."""
+    prof = WAN_PROFILES.get(name)
+    if prof is None:
+        raise KeyError(f"unknown WAN profile {name!r}; known: "
+                       f"{sorted(WAN_PROFILES)}")
+    return dict(prof[direction])
+
+
+def wan_pair(deliver_fwd, deliver_rev, *, profile: str = "cross_region",
+             seed: int = 0):
+    """A seeded directed ChaosLink pair for one inter-region path:
+    ``(fwd, rev)`` where `fwd` carries a->b under the profile's ``fwd``
+    kwargs and `rev` carries b->a under ``rev``. The two links draw from
+    independent seeded generators (seed, seed+1), so one direction's
+    fault schedule replays bit-identically regardless of the other's
+    traffic order."""
+    fwd = ChaosLink(deliver_fwd, seed=seed, **wan_profile(profile, "fwd"))
+    rev = ChaosLink(deliver_rev, seed=seed + 1,
+                    **wan_profile(profile, "rev"))
+    return fwd, rev

@@ -98,7 +98,7 @@ class ServiceConfig:
                  "quarantine_capacity", "quarantine_global_capacity",
                  "starvation_boost_ticks", "tick_ring", "default_budget",
                  "lag_probe_ticks", "event_log", "prom_lag_series",
-                 "shard_lanes")
+                 "shard_lanes", "region")
 
     def __init__(self, *, tick_budget_ms: float = 0.0,
                  heartbeat_ticks: int = 30, suspect_grace_ticks: int = 30,
@@ -109,7 +109,8 @@ class ServiceConfig:
                  starvation_boost_ticks: int = 8, tick_ring: int = 4096,
                  default_budget: TenantBudget = None,
                  lag_probe_ticks: int = 1, event_log: int = 256,
-                 prom_lag_series: int = 64, shard_lanes: int = 0):
+                 prom_lag_series: int = 64, shard_lanes: int = 0,
+                 region: str = None):
         self.tick_budget_ms = tick_budget_ms
         self.heartbeat_ticks = heartbeat_ticks
         self.suspect_grace_ticks = suspect_grace_ticks
@@ -126,6 +127,12 @@ class ServiceConfig:
         self.event_log = event_log
         self.prom_lag_series = prom_lag_series
         self.shard_lanes = shard_lanes
+        #: federation (INTERNALS §20): the region name this service
+        #: instance serves, or None for a single-region deployment.
+        #: Region-qualifies the rooms' lineage replica-site labels
+        #: (``svc:<region>/<room>``), so a change's hop chain names
+        #: WHICH region's replica made it visible.
+        self.region = region
 
 
 def approx_msg_bytes(msg) -> int:

@@ -81,8 +81,12 @@ class Room:
         self.doc_set = DocSet()
         # the room's lineage replica-site label: commit hops recorded by
         # this room's gate carry it, so a change's chain names WHICH
-        # server replica made it visible (INTERNALS §18.1)
-        self.doc_set._lineage_site = f"svc:{room_id}"
+        # server replica made it visible (INTERNALS §18.1); a federated
+        # service region-qualifies it (§20.4) so chains spanning regions
+        # name which REGION's replica, too
+        self.doc_set._lineage_site = (
+            f"svc:{config.region}/{room_id}" if config.region
+            else f"svc:{room_id}")
         self.gate = InboundGate(
             self.doc_set, capacity=config.quarantine_capacity,
             global_capacity=config.quarantine_global_capacity)
@@ -213,6 +217,11 @@ class SyncService:
         # postmortem must work with tracing OFF, so the service keeps
         # its own bounded copy of the ladder events it obs-emits
         self._events = deque(maxlen=self.config.event_log)
+        #: federation attachment (INTERNALS §20): a FederatedRegion
+        #: installs itself here so scrape()/describe() export the
+        #: cross-region link states, lag-token gauges, and ladder
+        #: transition counters alongside the service families
+        self._federation = None
         self.stats = {"ticks": 0, "admitted_msgs": 0, "admitted_ops": 0,
                       "admitted_bytes": 0, "deferrals": 0, "shed_total": 0,
                       "evictions": 0, "joins": 0, "rejoins": 0,
@@ -820,6 +829,8 @@ class SyncService:
             "events": list(self._events),
             "tick_p99_ms_telemetry": self.tick_p99_ms_telemetry(),
             **({"shards": self.shard_map()} if self._shard_lanes else {}),
+            **({"federation": self._federation.describe()}
+               if self._federation is not None else {}),
         }
 
     def tick_p99_ms_telemetry(self) -> float:
@@ -880,6 +891,11 @@ class SyncService:
                 [({"tenant": tid, "room": v["room"]}, v["ticks"])
                  for tid, v in lag]))
         fams += prom.telemetry_families(self.telemetry, "amtpu_svc")
+        if self._federation is not None:
+            # cross-region link/lag families (INTERNALS §20.5): link
+            # ladder states, transition counters, per-(remote, room)
+            # lag-token gauges, buffered/shipped/received totals
+            fams += self._federation.families("amtpu_region")
         if lineage.ledger() is not None:
             # per-stage dwell histograms + end-to-end visibility
             # quantiles for the sampled change population (§18.3)

@@ -82,6 +82,14 @@ class SyncHub:
         self._no_snapshot: set = set()   # (peer, doc): peer declined a
         # bundle this session (corrupt restore or policy) — serve plain
         # changes for the rest of the add_peer..remove_peer lifetime
+        #: federation hook (INTERNALS §20.3): when installed (a callable
+        #: returning ``[origin_region, room, token]``), every frame this
+        #: hub's flush mints carries one per-replication-group ordering
+        #: token in its manifest — minted ONCE per (doc, clock) encode
+        #: group, destination-independent, so the one-encode-per-fanout
+        #: discipline is untouched. None (the default) leaves frames
+        #: byte-identical to the unfederated wire.
+        self.group_mint = None
 
     # -- lifecycle ------------------------------------------------------
 
@@ -281,8 +289,11 @@ class SyncHub:
             if binary:
                 parts = encoded.get(key)
                 if parts is None:
+                    gtok = self.group_mint() \
+                        if self.group_mint is not None else None
                     parts = encoded[key] = split_outgoing(changes,
-                                                          trace=ctx)
+                                                          trace=ctx,
+                                                          group=gtok)
                 prefix, frame = parts
                 if frame is not None:
                     # the frame manifest carries the full context

@@ -636,6 +636,151 @@ def config15_device_truth(quick: bool = False,
          threshold=rec["threshold"])
 
 
+def config16_federation(n_rounds: int = 12, n_rooms: int = 4,
+                        quick: bool = False,
+                        record_session: bool = False):
+    """Geo-federation replication throughput (ISSUE 16, INTERNALS §20):
+    the cfg16 row — three FederatedRegions full-meshed over the seeded
+    ``cross_region`` WAN chaos profile, every region writing every room
+    every round (concurrent cross-region merge), measured from first
+    write to full fabric quiescence.  value = replica-commits/s: each
+    write must become visible on ALL three regions, so the fabric does
+    3x the write volume in committed replica state.  Lineage runs at
+    rate=1 inside the timed region, so the row records the REAL
+    cross-region visibility quantiles (origin -> remote commit across
+    the WAN), plus the SLO terms the gate checks: residual lag tokens
+    (absolute zero bar) and group-token economy.  Clean-path capacity:
+    no partitions here — chaos partitions + region kill/rejoin live in
+    scripts/soak.py --federation."""
+    import time as _time
+
+    import automerge_tpu as am
+    from automerge_tpu.federation import FederatedRegion, connect_regions
+    from automerge_tpu.obs import lineage
+    from automerge_tpu.service import ServiceConfig, SyncService
+
+    if quick:
+        n_rounds, n_rooms = 6, 2
+
+    was_enabled = lineage.ENABLED
+    lineage.enable(rate=1)
+    lineage.clear()
+    try:
+        names = ["us", "eu", "ap"]
+        regions = {n: FederatedRegion(
+            SyncService(ServiceConfig(region=n)), n) for n in names}
+        s = 16
+        for i in range(len(names)):
+            for j in range(i + 1, len(names)):
+                connect_regions(regions[names[i]], regions[names[j]],
+                                profile="cross_region", seed=s)
+                s += 10
+        room_ids = [f"room-{g}" for g in range(n_rooms)]
+        for rid in room_ids:
+            doc0 = am.change(am.init(f"{rid}-origin"),
+                             lambda d: d.__setitem__("m", {}))
+            base = am.get_all_changes(doc0)
+            for r in regions.values():
+                r.svc.seed_doc(rid, am.apply_changes(
+                    am.init(f"srv-{r.name}-{rid}"), base))
+
+        def pump_all():
+            for r in regions.values():
+                r.pump()
+                r.svc.tick()
+
+        def settle(max_rounds=4000):
+            for q in range(max_rounds):
+                pump_all()
+                if q > 5 and all(r.idle() for r in regions.values()):
+                    return
+            raise AssertionError(
+                f"federation bench never quiesced: "
+                f"{ {n: r.lag_table() for n, r in regions.items()} }")
+
+        settle()                    # join adverts off the clock
+        lineage.clear()             # visibility stats: timed region only
+        n_writes = 0
+        t0 = _time.perf_counter()
+        for rnd in range(n_rounds):
+            for name, r in regions.items():
+                for rid in room_ids:
+                    ds = r.svc.room(rid).doc_set
+                    ds.set_doc(rid, am.change(
+                        ds.get_doc(rid),
+                        lambda d, n=name, rnd=rnd:
+                        d["m"].__setitem__(f"k-{n}", rnd)))
+                    n_writes += 1
+            pump_all()
+        settle()
+        dt = _time.perf_counter() - t0
+
+        # convergence: canonical saves byte-identical on all 3 regions
+        for rid in room_ids:
+            saves = set()
+            for r in regions.values():
+                doc = r.svc.room(rid).doc_set.get_doc(rid)
+                chs = sorted(am.get_all_changes(doc),
+                             key=lambda c: (c["actor"], c["seq"]))
+                saves.add(am.save(am.apply_changes(
+                    am.init("canon-probe"), chs)))
+            assert len(saves) == 1, f"cfg16 {rid}: replicas diverged"
+        residual = sum(e["lag_tokens"] for r in regions.values()
+                       for e in r.lag_table().values())
+        led = lineage.ledger()
+        links = [ln for r in regions.values()
+                 for ln in r.links.values()]
+        replica_commits = n_writes * len(regions)
+        emit("cfg16_federation", replica_commits / dt, "ops/s",
+             regions=len(regions), rooms=n_rooms, writes=n_writes,
+             replica_commits=replica_commits,
+             aggregate_replica_commits_per_sec=round(
+                 replica_commits / dt, 1),
+             cross_region_visibility_p50_ms=led.visibility_ms(0.50),
+             cross_region_visibility_p99_ms=led.visibility_ms(0.99),
+             residual_lag_tokens=residual,
+             group_tokens_minted=sum(r.clock.stats["minted"]
+                                     for r in regions.values()),
+             group_tokens_observed=sum(r.clock.stats["observed"]
+                                       for r in regions.values()),
+             envelopes_shipped=sum(ln.stats["shipped"] for ln in links),
+             envelopes_delivered=sum(ln.stats["delivered"]
+                                     for ln in links),
+             wan_profile="cross_region",
+             threshold=TRACKING_ONLY)
+    finally:
+        if not was_enabled:
+            lineage.disable()
+        lineage.clear()
+    if record_session:
+        import datetime
+
+        import bench as B
+        from benchmarks.common import RESULTS
+        row = dict(RESULTS[-1])
+        row["recorded_at_utc"] = datetime.datetime.now(
+            datetime.timezone.utc).isoformat()
+        row["git_sha"] = B._git_sha()
+        try:
+            import subprocess as _sp
+            if _sp.run(["git", "status", "--porcelain"],
+                       capture_output=True, text=True,
+                       timeout=10).stdout.strip():
+                row["git_dirty"] = True
+        except Exception:
+            pass
+        row["timed_region"] = (
+            f"3 federated regions x {n_rooms} rooms x {n_rounds} write "
+            "rounds over the seeded cross_region WAN chaos profile "
+            "(group-token manifests -> RegionLink channels -> remote "
+            "gate commits); dt = first write -> full fabric quiescence; "
+            "value = replica-commits/s (every write visible on all 3 "
+            "regions); lineage rate=1 inside the timed region supplies "
+            "the cross-region visibility quantiles.")
+        B.append_session_log(row)
+        print(f"# appended to {B.SESSION_LOG_PATH}", file=sys.stderr)
+
+
 def config5b_residual_heavy(n_actors: int = 10_000, quick: bool = False):
     """Adversarial headline shape: 20% of ops are RESIDUALS (bare deletes
     of distinct base elements + bare inserts without values) that cannot
@@ -1377,6 +1522,10 @@ def main():
     if "--device-truth-session" in sys.argv:
         # the chip_session.sh cfg15 step: ONLY the device-truth row
         config15_device_truth(quick=quick, record_session=True)
+        return
+    if "--federation-session" in sys.argv:
+        # the chip_session.sh cfg16 step: ONLY the federation row
+        config16_federation(quick=quick, record_session=True)
         return
     record_round = None
     record_path = None

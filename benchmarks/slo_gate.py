@@ -70,6 +70,12 @@ SLOS = [
     # matrix, pages here even while throughput still holds)
     ("cfg15_device_truth", "value", "min", 0.8),
     ("cfg15_device_truth", "bytes_staged_per_op", "max", 1.25),
+    # ISSUE 16: federation rows — replica-commit throughput floor plus
+    # a relative ceiling on the cross-region visibility p99 (a link,
+    # buffering, or handshake regression that slows a write's journey
+    # across the WAN pages here even while local throughput holds)
+    ("cfg16_federation", "value", "min", 0.8),
+    ("cfg16_federation", "cross_region_visibility_p99_ms", "max", 1.5),
 ]
 
 #: Absolute SLOs: (metric_prefix, dotted field, op, bound) checked on
@@ -113,6 +119,11 @@ ABS_SLOS = [
     # static-shape discipline, not box weather (also asserted in-run by
     # device_truth.steady_state)
     ("cfg15_device_truth", "recompiles_at_steady_state", "<=", 0),
+    # the ISSUE-16 acceptance bar on every committed cfg16 row, forever:
+    # the fabric quiesces before it records, so ANY residual
+    # cross-region lag (pending group-token envelopes) in a committed
+    # row is a wiring bug, not a tradeoff
+    ("cfg16_federation", "residual_lag_tokens", "<=", 0),
 ]
 
 #: Derived fields computable from any row that carries the inputs.

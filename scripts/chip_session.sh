@@ -146,6 +146,13 @@ run "cfg14_lineage" 1200 python -m benchmarks.run_all --lineage-session
 # AMTPU_PEAK_FLOPS / AMTPU_PEAK_BYTES_PER_S; appended to
 # BENCH_SESSIONS.jsonl
 run "cfg15_device_truth" 1200 python -m benchmarks.run_all --device-truth-session
+# geo-federation replication (ISSUE 16): the cfg16 row on the chip —
+# three federated regions full-meshed over the seeded cross_region WAN
+# chaos profile, replica-commits/s from first write to full fabric
+# quiescence, byte-identical canonical saves + residual lag == 0
+# asserted inside the measurement, cross-region visibility quantiles
+# from rate=1 lineage; appended to BENCH_SESSIONS.jsonl
+run "cfg16_federation" 1200 python -m benchmarks.run_all --federation-session
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu

@@ -526,7 +526,7 @@ def session_checkpoint(seed: int) -> None:
 #: JSON — the one-line artifact contract lives in emit_summary alone,
 #: so a new profile cannot regress it by copy-pasting emission logic).
 #: Non-empty entries fold into the summary as "<profile>_metrics".
-PROFILE_METRICS: dict = {"service": {}, "sharded": {}}
+PROFILE_METRICS: dict = {"service": {}, "sharded": {}, "federation": {}}
 
 #: back-compat alias: the service profile's registry entry
 LAST_SERVICE_METRICS = PROFILE_METRICS["service"]
@@ -1107,15 +1107,284 @@ def session_sharded(seed: int, n_docs: int = 8, n_actors: int = 2,
         released=results[multi][2]["released"])
 
 
+def session_federation(seed: int, n_rooms: int = 6,
+                       n_sessions: int = 1000, n_ticks: int = 80,
+                       quiesce_rounds: int = 6000) -> None:
+    """Three federated regions over WAN chaos (ISSUE 16 acceptance run:
+    ``--federation``): `n_sessions` write sessions land across the
+    fabric while region pairs partition and heal and one whole region
+    is KILLED mid-run and REJOINS empty (snapshot-bootstrapped by the
+    survivors through the probe/hello reconnect handshake).
+
+    Asserted at the end:
+      1. every room converges byte-identically on all three regions —
+         canonical saves (history replayed in deterministic order under
+         one probe actor) AND sorted change histories;
+      2. zero residual cross-region lag (pending group-token envelopes
+         + partition-buffered payloads) on every link, every link back
+         on the ``ok`` rung;
+      3. full reclamation: no parked quarantine changes, no partition
+         buffers, no channel reorder state anywhere in the fabric.
+
+    Any failure writes a federation postmortem (every region's
+    ``describe()``, federation block included) before re-raising."""
+    am = _am()
+    import json as _json
+
+    from automerge_tpu import Text
+    from automerge_tpu.federation import (
+        FederatedRegion, RegionPlacement, connect_regions,
+    )
+    from automerge_tpu.service import ServiceConfig, SyncService
+
+    rng = np.random.default_rng(seed)
+    names = ["us", "eu", "ap"]
+    placement = RegionPlacement(names)
+
+    def mk_region(name):
+        return FederatedRegion(
+            SyncService(ServiceConfig(region=name)), name,
+            placement=placement, probe_every=2, max_buffer=256,
+            max_retries=4)
+
+    regions = {n: mk_region(n) for n in names}
+    chaos = {}
+    s = seed * 7919 + 1
+    for i in range(len(names)):
+        for j in range(i + 1, len(names)):
+            a, b = names[i], names[j]
+            _, _, fwd, rev = connect_regions(
+                regions[a], regions[b], profile="cross_region", seed=s)
+            chaos[(a, b)] = (fwd, rev)      # fwd: a -> b, rev: b -> a
+            s += 10
+
+    room_ids = [f"room-{g}" for g in range(n_rooms)]
+    for room_id in room_ids:
+        doc0 = am.change(am.init(f"{room_id}-origin"), lambda d: (
+            d.__setitem__("t", Text("start")), d.__setitem__("m", {})))
+        base = am.get_all_changes(doc0)
+        for r in regions.values():
+            r.svc.seed_doc(room_id, am.apply_changes(
+                am.init(f"srv-{r.name}-{room_id}"), base))
+            # short histories at soak scale: a lowered threshold keeps
+            # the rejoined region exercising the snapshot bootstrap
+            r.svc.room(room_id).hub.snapshot_min_changes = 8
+
+    def pump_all(rounds=1):
+        for _ in range(rounds):
+            for r in regions.values():
+                r.pump()
+                r.svc.tick()
+
+    def edit(region_name, room_id):
+        ds = regions[region_name].svc.room(room_id).doc_set
+        doc = ds.get_doc(room_id)
+        if doc is None:
+            return False     # a rejoined region still bootstrapping
+        if int(rng.integers(0, 3)) == 0:
+            doc = _text_edit(am, doc, rng)
+        else:
+            k = KEYS[int(rng.integers(0, len(KEYS)))]
+            doc = am.change(doc, lambda d, k=k,
+                            v=int(rng.integers(0, 999)):
+                            d["m"].__setitem__(k, v))
+        ds.set_doc(room_id, doc)
+        return True
+
+    # fault schedule: two pair-partition windows + one region kill
+    cut_a = ("us", "eu")
+    cut_a_at, cut_a_len = n_ticks // 5, max(4, n_ticks // 6)
+    cut_b = ("eu", "ap")
+    cut_b_at, cut_b_len = (2 * n_ticks) // 3, max(4, n_ticks // 8)
+    kill_name = "ap"
+    kill_at = n_ticks // 2
+    rejoin_at = kill_at + max(4, n_ticks // 8)
+    killed = False
+    n_writes = 0
+    n_skipped = 0
+    per_tick = max(1, n_sessions // n_ticks)
+
+    def kill_edges(name):
+        """A vanished region: its WAN edges go dark in BOTH directions
+        (frames die in flight; survivors' channels hit the retransmit
+        cap and walk the ladder to `partitioned`)."""
+        for (a, b), (f, r) in chaos.items():
+            if name in (a, b):
+                f.partition()
+                r.partition()
+
+    def rejoin_region(name):
+        """A fresh, EMPTY region under the old name: new service, new
+        links, the same chaos edges rewired and healed — the survivors'
+        probe loop finds it and the hello handshake bootstraps it."""
+        fresh = mk_region(name)
+        ls = seed * 104729 + 17
+        for (a, b), (f, r) in chaos.items():
+            if b == name:     # fwd a->b delivers to name's link
+                ln = fresh.link_to(a, seed=ls)
+                f._deliver = ln.on_raw
+                ln.attach_transport(r)
+            elif a == name:   # rev b->a delivers to name's link
+                ln = fresh.link_to(b, seed=ls)
+                r._deliver = ln.on_raw
+                ln.attach_transport(f)
+            else:
+                continue
+            ls += 3
+            f.heal()
+            r.heal()
+        regions[name] = fresh
+
+    try:
+        for t in range(n_ticks):
+            if t == cut_a_at:
+                f, r = chaos[cut_a]
+                f.partition()
+                r.partition()
+            if t == cut_a_at + cut_a_len and not killed:
+                f, r = chaos[cut_a]
+                f.heal()
+                r.heal()
+            if t == cut_b_at:
+                f, r = chaos[cut_b]
+                f.partition()
+                r.partition()
+            if t == cut_b_at + cut_b_len:
+                f, r = chaos[cut_b]
+                f.heal()
+                r.heal()
+            if t == kill_at:
+                killed = True
+                regions.pop(kill_name)
+                kill_edges(kill_name)
+            if t == rejoin_at:
+                killed = False
+                rejoin_region(kill_name)
+            for _ in range(per_tick):
+                room_id = room_ids[int(rng.integers(0, n_rooms))]
+                # placement decides the normal write home; any region
+                # accepts writes (rung one: local-writes-always-accepted)
+                if int(rng.integers(0, 5)) == 0:
+                    target = list(regions)[int(rng.integers(0,
+                                                            len(regions)))]
+                else:
+                    target = placement.home(room_id)
+                    if target not in regions:   # its home is the corpse
+                        target = next(iter(regions))
+                if edit(target, room_id):
+                    n_writes += 1
+                else:
+                    n_skipped += 1
+            pump_all()
+
+        # ---- heal everything, then drain until the fabric is idle ----
+        if killed:
+            rejoin_region(kill_name)
+        for f, r in chaos.values():
+            f.heal()
+            r.heal()
+        for q in range(quiesce_rounds):
+            pump_all()
+            if q > 5 and all(r.idle() for r in regions.values()):
+                break
+        else:
+            raise AssertionError(
+                f"federation seed {seed}: never quiesced: "
+                f"{ {n: r.lag_table() for n, r in regions.items()} }")
+
+        # 1. byte-identical convergence: canonical saves AND histories
+        for room_id in room_ids:
+            docs = {n: r.svc.room(room_id).doc_set.get_doc(room_id)
+                    for n, r in regions.items()}
+            assert all(d is not None for d in docs.values()), \
+                f"federation seed {seed} {room_id}: missing replica in " \
+                f"{ {n: d is None for n, d in docs.items()} }"
+            saves = {}
+            hists = {}
+            for n, d in docs.items():
+                chs = sorted(am.get_all_changes(d),
+                             key=lambda c: (c["actor"], c["seq"]))
+                saves[n] = am.save(am.apply_changes(
+                    am.init("canon-probe"), chs))
+                hists[n] = sorted(_json.dumps(c, sort_keys=True)
+                                  for c in chs)
+            assert len(set(saves.values())) == 1, \
+                f"federation seed {seed} {room_id}: saves diverged " \
+                f"{ {n: len(sv) for n, sv in saves.items()} }"
+            ref = next(iter(hists.values()))
+            assert all(h == ref for h in hists.values()), \
+                f"federation seed {seed} {room_id}: histories diverged"
+        # 2. zero residual cross-region lag, every link healthy
+        residual = {(n, peer): entry
+                    for n, r in regions.items()
+                    for peer, entry in r.lag_table().items()
+                    if entry["lag_tokens"] or entry["state"] != "ok"}
+        assert not residual, \
+            f"federation seed {seed}: residual lag at quiescence: " \
+            f"{residual}"
+        # 3. full reclamation: no parked changes, no partition buffers,
+        #    no channel reorder state anywhere
+        for n, r in regions.items():
+            for room_id in room_ids:
+                gate = r.svc.room(room_id).gate
+                assert gate._n_parked == 0, \
+                    f"federation seed {seed}: {n}/{room_id} quarantine " \
+                    f"not drained"
+            for peer, link in r.links.items():
+                assert not link._buf_adverts and not link._buf_data, \
+                    f"federation seed {seed}: {n}->{peer} partition " \
+                    f"buffer not drained"
+                assert not link.chan._recv_buf, \
+                    f"federation seed {seed}: {n}->{peer} reorder " \
+                    f"buffer not drained"
+    except Exception:
+        path = os.environ.get("AMTPU_POSTMORTEM_OUT",
+                              "federation_postmortem.json")
+        try:
+            with open(path, "w") as fh:
+                _json.dump({n: r.svc.describe()
+                            for n, r in regions.items()}, fh, indent=1)
+            print(f"soak: federation postmortem written to {path}",
+                  file=sys.stderr, flush=True)
+        except Exception as dump_exc:   # noqa: BLE001 — never mask
+            print(f"soak: postmortem dump failed: {dump_exc!r}",
+                  file=sys.stderr, flush=True)
+        raise
+
+    links = [(n, peer, link) for n, r in regions.items()
+             for peer, link in r.links.items()]
+    PROFILE_METRICS["federation"].clear()
+    PROFILE_METRICS["federation"].update(
+        regions=len(regions), rooms=n_rooms, writes=n_writes,
+        writes_skipped_bootstrapping=n_skipped,
+        region_kills=1, residual_lag_tokens=0,
+        reconnects=sum(ln.stats["reconnects"] for _, _, ln in links),
+        channel_revives=sum(ln.chan.stats["revives"]
+                            for _, _, ln in links),
+        buffer_dropped=sum(ln.stats["buffer_dropped"]
+                           for _, _, ln in links),
+        shipped=sum(ln.stats["shipped"] for _, _, ln in links),
+        delivered=sum(ln.stats["delivered"] for _, _, ln in links),
+        group_tokens_minted=sum(r.clock.stats["minted"]
+                                for r in regions.values()),
+        group_tokens_observed=sum(r.clock.stats["observed"]
+                                  for r in regions.values()),
+        ladder_transitions={
+            k: sum(ln.transitions.get(k, 0) for _, _, ln in links)
+            for k in sorted({t for _, _, ln in links
+                             for t in ln.transitions})})
+
+
 PROFILES = {"general": session_general, "conflict": session_conflict,
             "lossy": session_lossy, "table": session_table,
             "chaos": session_chaos, "checkpoint": session_checkpoint,
-            "service": session_service, "sharded": session_sharded}
+            "service": session_service, "sharded": session_sharded,
+            "federation": session_federation}
 
 
 def run(profile: str, sessions: int, seed_base: int,
         trace: bool = False, clients: int = None,
-        scrape: bool = False) -> int:
+        scrape: bool = False, quick: bool = False) -> int:
     import json
 
     from automerge_tpu import obs
@@ -1132,6 +1401,11 @@ def run(profile: str, sessions: int, seed_base: int,
         # stay proportionate
         profiles["service"] = lambda seed: session_service(
             seed, n_clients=clients, n_ticks=40 if clients >= 500 else 30)
+    if quick:
+        # the CI smoke scale: same scenario shape (partitions + region
+        # kill/rejoin), an order of magnitude fewer write sessions
+        profiles["federation"] = lambda seed: session_federation(
+            seed, n_rooms=3, n_sessions=150, n_ticks=40)
     # the soak ALWAYS records (counters are exact across ring
     # wraparound, so the summary is right even for long campaigns); the
     # --trace flag only controls whether the ring is also exported
@@ -1217,6 +1491,13 @@ def main():
                     help="shorthand for --profile service at scale "
                          "(--clients concurrent sessions, default 1000; "
                          "--sessions defaults to 1 seed)")
+    ap.add_argument("--federation", action="store_true",
+                    help="shorthand for --profile federation (3 regions "
+                         "over WAN chaos with pair partitions and a "
+                         "killed-and-rejoined region; byte-identical "
+                         "survivor convergence + zero residual "
+                         "cross-region lag; --sessions defaults to 1 "
+                         "seed, --quick runs the CI smoke scale)")
     ap.add_argument("--sharded", action="store_true",
                     help="shorthand for --profile sharded (shard-count "
                          "invariance: the same seeded chaotic stream on "
@@ -1228,8 +1509,8 @@ def main():
                     help="service profile: concurrent client sessions "
                          "(default 1000 with --service)")
     ap.add_argument("--quick", action="store_true",
-                    help="service profile: the CI smoke scale "
-                         "(100 clients)")
+                    help="service/federation profiles: the CI smoke "
+                         "scale (100 clients / 150 write sessions)")
     ap.add_argument("--sessions", type=int, default=None)
     ap.add_argument("--seed-base", type=int, default=0)
     ap.add_argument("--trace", action="store_true",
@@ -1243,6 +1524,7 @@ def main():
     profile = ("chaos" if args.chaos
                else "checkpoint" if args.checkpoint
                else "service" if args.service
+               else "federation" if args.federation
                else "sharded" if args.sharded else args.profile)
     clients = args.clients
     if args.service and clients is None:
@@ -1252,10 +1534,10 @@ def main():
         # one seed at service scale (a 1000-session scenario IS the
         # campaign); 8 for the sharded profile (each seed runs the full
         # stream at EVERY shard count); 30 everywhere else
-        sessions = (1 if profile == "service"
+        sessions = (1 if profile in ("service", "federation")
                     else 8 if profile == "sharded" else 30)
     return run(profile, sessions, args.seed_base, trace=args.trace,
-               clients=clients, scrape=args.scrape)
+               clients=clients, scrape=args.scrape, quick=args.quick)
 
 
 if __name__ == "__main__":

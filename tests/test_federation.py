@@ -1,0 +1,555 @@
+"""Geo-distributed federation (ISSUE 16, INTERNALS §20).
+
+The contracts under test:
+
+- **GroupClock** — O(groups) causal metadata: one monotone ordering
+  token per (room, origin-region), destination-independent mints,
+  idempotent max-merge observation, a dumpable table bounded by groups
+  (never peers).
+- **Group tokens on the wire** — the ``[origin, room, token]`` triple
+  rides the ``AMTPUWIRE1`` manifest (hash-covered, version-tolerant),
+  round-trips through encode/decode, and malformed triples are typed
+  ``WireFormatError`` rejections.
+- **WAN chaos profiles** — named, seeded, ASYMMETRIC per direction;
+  the bandwidth cap throttles (holds, never drops) over-budget frames.
+- **Partition tolerance** — three regions partitioned and healed
+  converge to byte-identical canonical saves AND identical change
+  histories, with ZERO residual cross-region lag; the degradation
+  ladder walks ok → partitioned → healing → ok with every transition
+  counted and evented; local writes are accepted throughout.
+- **Reconnect epochs** — heal revives both channel endpoints into a
+  fresh epoch (stale pre-partition frames drop instead of replaying
+  into the reset window) and hub peer re-attachment recomputes the
+  delta from clocks, including snapshot bootstrap for an empty joiner.
+- **Observability** — ``amtpu_region_*`` families on the service
+  scrape (prom-validator-clean), the federation block in describe(),
+  and lineage chains that SPAN regions: fed/ship → fed/recv hops with
+  per-hop dwell, and a most-stuck postmortem that names the
+  partitioned region link a buffered change is parked on.
+"""
+
+import json
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.engine.wire_format import (
+    WireFormatError, decode, split_outgoing, validate_group_token,
+)
+from automerge_tpu.federation import (
+    FederatedRegion, GroupClock, RegionPlacement, connect_regions,
+)
+from automerge_tpu.obs import lineage, prom
+from automerge_tpu.obs.prom import validate_prom
+from automerge_tpu.resilience import WAN_PROFILES, ChaosLink, wan_pair, \
+    wan_profile
+from automerge_tpu.service import ServiceConfig, SyncService
+
+
+@pytest.fixture(autouse=True)
+def _lineage_off_after():
+    was = lineage.ENABLED
+    yield
+    if not was:
+        lineage.disable()
+    lineage.clear()
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _mk_fabric(names=("us", "eu", "ap"), profile="cross_region", seed=3,
+               **region_kw):
+    """Full-mesh fabric: {name: FederatedRegion}, {(a, b): (fwd, rev)}."""
+    regions = {n: FederatedRegion(SyncService(ServiceConfig(region=n)),
+                                  n, **region_kw) for n in names}
+    chaos = {}
+    s = seed
+    names = list(names)
+    for i in range(len(names)):
+        for j in range(i + 1, len(names)):
+            a, b = names[i], names[j]
+            _, _, fwd, rev = connect_regions(
+                regions[a], regions[b], profile=profile, seed=s)
+            chaos[(a, b)] = (fwd, rev)
+            s += 10
+    return regions, chaos
+
+
+def _seed_room(regions, room_id="room0"):
+    doc = am.change(am.init(f"{room_id}-origin"),
+                    lambda d: d.__setitem__("k", 0))
+    base = am.get_all_changes(doc)
+    for r in regions.values():
+        r.svc.seed_doc(room_id, am.apply_changes(
+            am.init(f"srv-{r.name}-{room_id}"), base))
+
+
+def _pump(regions, n=1):
+    for _ in range(n):
+        for r in regions.values():
+            r.pump()
+            r.svc.tick()
+
+
+def _edit(regions, region, room_id, key, val):
+    ds = regions[region].svc.room(room_id).doc_set
+    ds.set_doc(room_id, am.change(ds.get_doc(room_id),
+                                  lambda d: d.__setitem__(key, val)))
+
+
+def _settle(regions, max_rounds=800):
+    for i in range(max_rounds):
+        _pump(regions)
+        if i > 5 and all(r.idle() for r in regions.values()):
+            return i
+    raise AssertionError(
+        f"fabric failed to quiesce in {max_rounds} rounds: "
+        f"{ {n: r.lag_table() for n, r in regions.items()} }")
+
+
+def _canonical_save(doc):
+    """Replica-independent save bytes: replay the FULL change history
+    (deterministically ordered) under one probe actor — byte-identical
+    iff the histories are identical."""
+    chs = sorted(am.get_all_changes(doc),
+                 key=lambda c: (c["actor"], c["seq"]))
+    return am.save(am.apply_changes(am.init("canon-probe"), chs))
+
+
+def _histories(doc):
+    return sorted(json.dumps(c, sort_keys=True)
+                  for c in am.get_all_changes(doc))
+
+
+def _assert_converged(regions, room_id="room0"):
+    docs = {n: r.svc.room(room_id).doc_set.get_doc(room_id)
+            for n, r in regions.items()}
+    assert all(d is not None for d in docs.values()), docs
+    saves = {n: _canonical_save(d) for n, d in docs.items()}
+    assert len(set(saves.values())) == 1, \
+        f"saves diverged: { {n: len(s) for n, s in saves.items()} }"
+    hists = {n: _histories(d) for n, d in docs.items()}
+    ref = next(iter(hists.values()))
+    assert all(h == ref for h in hists.values()), "histories diverged"
+
+
+def _residual_lag(regions):
+    return sum(entry["lag_tokens"] for r in regions.values()
+               for entry in r.lag_table().values())
+
+
+# ---------------------------------------------------------------------------
+# GroupClock: O(groups) causal metadata
+# ---------------------------------------------------------------------------
+
+def test_group_clock_mints_monotone_per_room():
+    gc = GroupClock("us")
+    assert gc.mint("a") == ["us", "a", 1]
+    assert gc.mint("a") == ["us", "a", 2]
+    assert gc.mint("b") == ["us", "b", 1]   # independent per room
+    assert gc.head("a") == 2 and gc.head("b") == 1
+    assert gc.head("never") == 0
+
+
+def test_group_clock_observe_is_idempotent_max_merge():
+    gc = GroupClock("eu")
+    assert gc.observe("a", "us", 3) is True
+    assert gc.observe("a", "us", 3) is False      # duplicate
+    assert gc.observe("a", "us", 1) is False      # stale reorder
+    assert gc.observe("a", "us", 7) is True       # gap is fine: max-merge
+    assert gc.seen("a", "us") == 7
+    assert gc.stats == {"minted": 0, "observed": 2, "stale": 2}
+
+
+def test_group_clock_state_is_o_groups_not_o_peers():
+    gc = GroupClock("hub")
+    # 1000 tokens from 2 origins over 3 rooms: table stays 3 x <=3
+    for i in range(1000):
+        gc.observe(f"room-{i % 3}", ("us", "eu")[i % 2], i + 1)
+        gc.mint(f"room-{i % 3}")
+    table = gc.table()
+    assert len(table) == 3
+    assert all(set(v) <= {"us", "eu", "hub"} for v in table.values())
+
+
+def test_group_clock_rejects_bad_region():
+    with pytest.raises(ValueError):
+        GroupClock("")
+
+
+# ---------------------------------------------------------------------------
+# group tokens on the AMTPUWIRE1 manifest
+# ---------------------------------------------------------------------------
+
+def _changes(n=3):
+    doc = am.init("wire-actor")
+    for i in range(n):
+        doc = am.change(doc, lambda d, i=i: d.__setitem__(f"k{i}", i))
+    return am.get_all_changes(doc)
+
+
+def test_group_token_rides_the_manifest():
+    prefix, frame = split_outgoing(_changes(), min_ops=0,
+                                   group=["us", "room0", 7])
+    assert frame is not None
+    assert frame.group == ["us", "room0", 7]      # send-side cache
+    batch = decode(frame.data)
+    assert batch._group == ["us", "room0", 7]     # decode round-trip
+    # token-less frames stay token-less (no default minting at encode)
+    _, bare = split_outgoing(_changes(), min_ops=0)
+    assert bare.group is None
+    assert getattr(decode(bare.data), "_group", None) is None
+
+
+def test_group_token_validation_is_typed():
+    good = ["us", "room0", 1]
+    assert validate_group_token(list(good)) == good
+    for bad in (["us", "room0"],               # truncated
+                ["us", "room0", 0],            # tokens start at 1
+                ["us", "room0", True],         # bool is not a token
+                ["", "room0", 1],              # empty region
+                ["us", "", 1],                 # empty room
+                ["us", "room0", 2 ** 63],      # i64 overflow
+                "us/room0/1",                  # not a triple
+                ["us", "room0", "1"]):         # stringly token
+        with pytest.raises(WireFormatError):
+            validate_group_token(bad)
+    # split_outgoing treats an un-encodable token like any other encode
+    # failure: typed rejection inside, graceful dict-wire fallback out
+    prefix, frame = split_outgoing(_changes(), min_ops=0,
+                                   group=["us", "room0", 0])
+    assert frame is None and len(prefix) == 3
+
+
+# ---------------------------------------------------------------------------
+# WAN chaos profiles
+# ---------------------------------------------------------------------------
+
+def test_wan_profiles_are_named_and_asymmetric():
+    assert set(WAN_PROFILES) == {"wan", "wan_partitioned", "cross_region"}
+    for name in WAN_PROFILES:
+        fwd, rev = wan_profile(name, "fwd"), wan_profile(name, "rev")
+        assert fwd != rev, f"{name} should be asymmetric"
+        assert fwd["bandwidth"] > rev["bandwidth"]  # fat egress, thin rtn
+    with pytest.raises(KeyError):
+        wan_profile("lan")
+
+
+def test_wan_pair_is_deterministic():
+    def run():
+        got = []
+        fwd, _rev = wan_pair(got.append, lambda m: None,
+                             profile="wan", seed=42)
+        for i in range(200):
+            fwd.send({"i": i})
+            fwd.pump()
+        fwd.drain(200)
+        return got, dict(fwd.stats)
+    a_msgs, a_stats = run()
+    b_msgs, b_stats = run()
+    assert a_msgs == b_msgs and a_stats == b_stats
+    assert a_stats["dropped"] > 0 or a_stats["delayed"] > 0
+
+
+def test_bandwidth_cap_throttles_without_dropping():
+    got = []
+    link = ChaosLink(got.append, seed=1, bandwidth=64)
+    big = {"payload": "x" * 100}
+    for _ in range(8):
+        link.send(dict(big))
+    rounds = 0
+    while not link.idle and rounds < 100:
+        link.pump()
+        rounds += 1
+    assert len(got) == 8                      # throttled, never dropped
+    assert link.stats["throttled"] > 0
+    # each ~100-byte frame alone busts the 64-byte round budget, so the
+    # cap serialized delivery to one frame per pump round
+    assert rounds >= 8
+
+
+def test_bandwidth_cap_first_frame_always_passes():
+    got = []
+    link = ChaosLink(got.append, seed=1, bandwidth=1)  # absurdly thin
+    link.send({"payload": "y" * 1000})
+    link.pump()
+    assert len(got) == 1                      # oversized head-of-line
+
+
+# ---------------------------------------------------------------------------
+# RegionPlacement
+# ---------------------------------------------------------------------------
+
+def test_region_placement_deterministic_and_movable():
+    p = RegionPlacement(["us", "eu", "ap"])
+    q = RegionPlacement(["us", "eu", "ap"])
+    rooms = [f"room-{i}" for i in range(30)]
+    assert [p.home(r) for r in rooms] == [q.home(r) for r in rooms]
+    spread = p.spread(rooms)
+    assert sum(spread.values()) == 30 and len(spread) == 3
+    victim = rooms[0]
+    before, epoch0 = p.home(victim), p.epoch
+    target = next(n for n in ("us", "eu", "ap") if n != before)
+    p.move(victim, target)
+    assert p.home(victim) == target
+    assert p.table() == {victim: target}      # explicit override only
+    assert p.epoch == epoch0 + 1              # move fence
+    p.move(victim, before)                    # back home drops the entry
+    assert p.table() == {}
+
+
+def test_region_placement_rejects_unknowns():
+    with pytest.raises(ValueError):
+        RegionPlacement([])
+    with pytest.raises(ValueError):
+        RegionPlacement(["us", "us"])
+    with pytest.raises(ValueError):
+        RegionPlacement(["us"], overrides={"r": "mars"})
+    p = RegionPlacement(["us", "eu"])
+    with pytest.raises(ValueError):
+        p.move("r", "mars")
+
+
+# ---------------------------------------------------------------------------
+# federation: convergence, partition, heal
+# ---------------------------------------------------------------------------
+
+def test_two_regions_converge_over_wan_chaos():
+    regions, _ = _mk_fabric(("us", "eu"), seed=7)
+    _seed_room(regions)
+    _edit(regions, "us", "room0", "from_us", 1)
+    _edit(regions, "eu", "room0", "from_eu", 2)
+    _settle(regions)
+    _assert_converged(regions)
+    assert _residual_lag(regions) == 0
+    # the ordering tokens actually flowed: eu observed us's mints
+    assert regions["eu"].clock.seen("room0", "us") > 0
+    assert regions["us"].clock.seen("room0", "eu") > 0
+
+
+def test_remote_region_can_introduce_a_room():
+    regions, _ = _mk_fabric(("us", "eu"), seed=11)
+    _pump(regions, 3)
+    # a room born in eu AFTER the fabric is up reaches us lazily
+    doc = am.change(am.init("late-room"), lambda d: d.__setitem__("v", 9))
+    regions["eu"].svc.seed_doc("late", doc)
+    _settle(regions)
+    got = regions["us"].svc.room("late").doc_set.get_doc("late")
+    assert got is not None and am.to_json(got)["v"] == 9
+
+
+def test_three_region_partition_heal_byte_identical():
+    regions, chaos = _mk_fabric(seed=3)
+    _seed_room(regions)
+    _pump(regions, 30)
+
+    fwd, rev = chaos[("us", "eu")]
+    fwd.partition()
+    rev.partition()
+    # local writes stay accepted in EVERY region mid-partition (ladder
+    # rung one), including both sides of the cut
+    for k in range(5):
+        for n in regions:
+            _edit(regions, n, "room0", f"{n}{k}", k)
+        _pump(regions, 8)
+    _pump(regions, 120)           # retransmit cap + dead declaration
+    us_eu = regions["us"].links["eu"]
+    eu_us = regions["eu"].links["us"]
+    assert us_eu.state == "partitioned" and eu_us.state == "partitioned"
+    assert us_eu.transitions.get("ok->partitioned") == 1
+    # the cut is OBSERVABLE: link_up 0 on the scrape mid-partition
+    page = regions["us"].svc.scrape()
+    assert 'amtpu_region_link_up{peer="eu",region="us"} 0' in page
+    # and evented on the service black-box ring
+    events = [e for e in regions["us"].svc._events
+              if e["event"] == "fed_state"]
+    assert any(e["to"] == "partitioned" and e["link"] == "us->eu"
+               for e in events)
+
+    fwd.heal()
+    rev.heal()
+    _settle(regions)
+    _assert_converged(regions)
+    assert _residual_lag(regions) == 0
+    # full ladder walked, counted once per rung
+    assert us_eu.transitions.get("partitioned->healing") == 1
+    assert us_eu.transitions.get("healing->ok") == 1
+    # heal revived BOTH endpoints into a fresh epoch
+    assert us_eu.chan.stats["revives"] >= 1
+    assert eu_us.chan.stats["revives"] >= 1
+    assert us_eu.chan.epoch >= 1 and eu_us.chan.epoch >= 1
+
+
+def test_partition_buffers_are_two_tier_and_bounded():
+    regions, chaos = _mk_fabric(("us", "eu"), seed=19, max_buffer=4)
+    _seed_room(regions)
+    _pump(regions, 30)
+    fwd, rev = chaos[("us", "eu")]
+    fwd.partition()
+    rev.partition()
+    # dead-link detection is traffic-driven (an idle cut link owes
+    # nothing — same contract as the service health ladder): one edit
+    # puts frames in flight, the retransmit cap then declares death
+    _edit(regions, "us", "room0", "tripwire", 1)
+    _pump(regions, 120)
+    link = regions["us"].links["eu"]
+    assert link.state == "partitioned"
+    for k in range(12):
+        _edit(regions, "us", "room0", f"burst{k}", k)
+        _pump(regions, 1)
+    # payload buffer clamped at the cap, drop-oldest counted; the
+    # advert tier dedups by (room, doc) and never exceeds the doc count
+    assert len(link._buf_data) <= 4
+    assert link.stats["buffer_dropped"] > 0
+    assert len(link._buf_adverts) <= 1
+    fwd.heal()
+    rev.heal()
+    _settle(regions)
+    # dropped buffer entries are SAFE: heal re-advertises and the delta
+    # recomputes from clocks — convergence never depended on the buffer
+    _assert_converged(regions)
+    assert _residual_lag(regions) == 0
+
+
+def test_region_killed_and_rejoined_bootstraps_from_snapshot():
+    regions, chaos = _mk_fabric(("us", "eu"), seed=23)
+    _seed_room(regions)
+    regions["us"].svc.room("room0").hub.snapshot_min_changes = 4
+    for k in range(8):
+        _edit(regions, "us", "room0", f"pre{k}", k)
+    _settle(regions)
+    _assert_converged(regions)
+
+    # region eu dies: cut the WAN, then rebuild its service from nothing
+    fwd, rev = chaos[("us", "eu")]
+    fwd.partition()
+    rev.partition()
+    _edit(regions, "us", "room0", "during_cut", 1)   # traffic -> death
+    _pump(regions, 120)
+    assert regions["us"].links["eu"].state == "partitioned"
+    dead = regions.pop("eu")
+    fresh = FederatedRegion(SyncService(ServiceConfig(region="eu")), "eu")
+    fresh_link = fresh.link_to("us", seed=77)
+    # rewire the chaos edges at the dead region's addresses
+    fwd._deliver = fresh_link.on_raw
+    fresh_link.attach_transport(rev)
+    regions["eu"] = fresh
+    fresh.svc.room("room0")               # empty replica, empty clock
+    del dead
+    fwd.heal()
+    rev.heal()
+    _settle(regions)
+    _assert_converged(regions)
+    # the rejoin was served by the checkpoint bootstrap, not a change
+    # replay: the fresh region's doc arrived with the full history
+    assert len(am.get_all_changes(
+        fresh.svc.room("room0").doc_set.get_doc("room0"))) >= 9
+
+
+# ---------------------------------------------------------------------------
+# observability: scrape, describe, lineage across regions
+# ---------------------------------------------------------------------------
+
+def test_scrape_exports_region_families_prom_clean():
+    regions, _ = _mk_fabric(seed=31)
+    _seed_room(regions)
+    _edit(regions, "us", "room0", "x", 1)
+    _settle(regions)
+    page = regions["us"].svc.scrape()
+    report = validate_prom(page)
+    assert not report.get("errors"), report
+    for fam in ("amtpu_region_lag_tokens", "amtpu_region_link_up",
+                "amtpu_region_link_state", "amtpu_region_shipped_total",
+                "amtpu_region_group_tokens_minted_total"):
+        assert fam in page, fam
+    assert 'peer="eu"' in page and 'peer="ap"' in page
+    assert 'amtpu_region_lag_tokens{peer="eu",region="us"} 0' in page
+
+
+def test_describe_carries_the_federation_block():
+    regions, _ = _mk_fabric(("us", "eu"), seed=37,
+                            placement=RegionPlacement(["us", "eu"]))
+    _seed_room(regions)
+    _edit(regions, "us", "room0", "minted", 1)   # something to ship
+    _settle(regions)
+    dump = regions["us"].svc.describe()
+    json.dumps(dump, default=str)             # postmortem-serializable
+    fed = dump["federation"]
+    assert fed["region"] == "us"
+    assert fed["links"]["eu"]["state"] == "ok"
+    assert fed["links"]["eu"]["lag_tokens"] == 0
+    assert fed["group_clock"]["minted"] >= 1
+    assert "placement_epoch" in fed
+
+
+def test_lineage_chain_spans_three_regions_with_dwell():
+    lineage.enable(rate=1, capacity=2048)
+    regions, _ = _mk_fabric(seed=41)
+    _seed_room(regions)
+    _pump(regions, 20)
+    _edit(regions, "us", "room0", "traced", 1)
+    _settle(regions)
+    _assert_converged(regions)
+    led = lineage.ledger()
+    spanning = []
+    for chain in led.chains():
+        stages = [h[0] for h in chain["hops"]]
+        # the traced edit originated on us's server replica; seed-doc
+        # chains also cross regions but with arbitrary ship directions
+        if "fed/ship" in stages and "fed/recv" in stages \
+                and chain["actor"].startswith("srv-us"):
+            spanning.append(chain)
+    assert spanning, "no chain crossed a region boundary"
+    best = max(spanning, key=lambda c: len(c["hops"]))
+    # ship names the directed link, recv the crossing, commit the
+    # region-qualified room replica (ServiceConfig.region)
+    ship_sites = {h[1] for h in best["hops"] if h[0] == "fed/ship"}
+    recv_sites = {h[1] for h in best["hops"] if h[0] == "fed/recv"}
+    # first crossing leaves us; relays (eu re-shipping to ap) may add
+    # further directed edges — every site is a directed region pair
+    assert ship_sites & {"us->eu", "us->ap"}, ship_sites
+    assert recv_sites & {"us->eu", "us->ap"}, recv_sites
+    assert all("->" in s for s in ship_sites | recv_sites)
+    commit_sites = {h[1] for h in best["hops"] if h[0] == "commit"}
+    assert commit_sites & {"svc:eu/room0", "svc:ap/room0"}, commit_sites
+    # per-hop dwell: timestamps are monotone, so every consecutive hop
+    # pair yields a non-negative dwell (the postmortem renders these)
+    ts = [h[2] for h in best["hops"]]
+    assert all(b >= a for a, b in zip(ts, ts[1:]))
+    # and the ledger aggregated a fed-stage dwell series
+    agg = led.telemetry.span_aggregates()
+    fed_dwells = [k for k in agg
+                  if k[0] == "lineage" and k[1].startswith("dwell:fed/")]
+    assert fed_dwells, sorted(agg)
+
+
+def test_stuck_postmortem_names_the_partitioned_link():
+    lineage.enable(rate=1, capacity=2048)
+    regions, chaos = _mk_fabric(seed=43)
+    _seed_room(regions)
+    _pump(regions, 20)
+    # cut BOTH of us's links, so a us-born change is visible nowhere
+    # remote and its chain parks on a fed/buffer hop
+    for pair in (("us", "eu"), ("us", "ap")):
+        key = pair if pair in chaos else (pair[1], pair[0])
+        for edge in chaos[key]:
+            edge.partition()
+    _edit(regions, "us", "room0", "tripwire", 1)   # traffic -> death
+    _pump(regions, 120)
+    assert regions["us"].links["eu"].state == "partitioned"
+    assert regions["us"].links["ap"].state == "partitioned"
+    _edit(regions, "us", "room0", "wedged", 1)
+    _pump(regions, 10)
+    dump = regions["us"].svc.describe()
+    stuck = dump["lineage"]["stuck"]
+    assert stuck, "nothing mid-flight despite a cut fabric"
+    # every us-born change is visible nowhere remote, so the top entries
+    # are mid-flight; the buffered one's chain ends ON the cut link
+    assert stuck[0]["mid_flight"] is True
+    buffered = [s for s in stuck if s["stuck_at"] == "fed/buffer"]
+    assert buffered, [s["stuck_at"] for s in stuck]
+    assert buffered[0]["stuck_site"] in ("us->eu", "us->ap")
+    # the hop chain renders per-hop dwell offsets for the operator
+    assert all(len(h) >= 3 for h in buffered[0]["hops"])

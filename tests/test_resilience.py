@@ -23,8 +23,8 @@ from automerge_tpu import frontend as Frontend
 from automerge_tpu.backend import device as device_backend
 from automerge_tpu.backend import facade as oracle_backend
 from automerge_tpu.resilience import (
-    ChaosLink, ProtocolError, QuarantineQueue, ResilientChannel,
-    validate_msg,
+    ChaosLink, PeerDeadError, ProtocolError, QuarantineQueue,
+    ResilientChannel, validate_msg,
 )
 from automerge_tpu.resilience.inbound import inbound_gate
 from automerge_tpu.sync import Connection, DocSet, SyncHub
@@ -597,6 +597,108 @@ class TestResilientChannel:
                     {"kind": "data", "seq": "1", "ack": 0, "payload": {}}):
             with pytest.raises(ProtocolError):
                 ch.on_wire(env)
+
+
+class TestChannelRevive:
+    """Reconnect epochs (ISSUE 16, INTERNALS §20.2): a channel declared
+    dead by retransmit-cap exhaustion refuses send() until revive(),
+    which starts a FRESH seq/ack epoch — stale pre-epoch data frames and
+    stale acks from the old epoch must not corrupt the new one."""
+
+    def test_dead_channel_refuses_send_until_revived(self):
+        deaths = []
+        ch = ResilientChannel(lambda env: None, lambda m: None,
+                              max_retries=2, base_rto=1,
+                              on_dead=deaths.append)
+        ch.send({"n": 1})
+        for _ in range(32):
+            ch.tick()
+            if ch.dead:
+                break
+        assert ch.dead and deaths == [ch]
+        assert ch.in_flight == 0        # window reclaimed at death
+        with pytest.raises(PeerDeadError):
+            ch.send({"n": 2})
+        ch.revive()
+        assert not ch.dead and ch.epoch == 1
+        assert ch.stats["revives"] == 1
+        wire = []
+        ch._send_raw = wire.append
+        ch.send({"n": 2})
+        # fresh epoch: seq numbering restarts at 1, envelope carries it
+        assert wire[-1]["seq"] == 1 and wire[-1]["epoch"] == 1
+
+    def test_stale_pre_epoch_frames_drop_unacked_after_revive(self):
+        got, wire = [], []
+        ch = ResilientChannel(wire.append, got.append)
+        ch.on_wire({"kind": "data", "seq": 1, "ack": 0,
+                    "payload": {"old": 1}})
+        assert got == [{"old": 1}]
+        ch.revive()                     # reconnect: receive state reset
+        # a pre-epoch frame still floating in the network: same seq
+        # space as the reset window, so it MUST drop un-acked — not
+        # deliver, not dedup-by-seq against the new epoch
+        n_acks = sum(1 for e in wire if e["kind"] == "ack")
+        ch.on_wire({"kind": "data", "seq": 2, "ack": 0,
+                    "payload": {"old": 2}})
+        assert got == [{"old": 1}]
+        assert ch.stats["stale_epoch_dropped"] == 1
+        assert sum(1 for e in wire if e["kind"] == "ack") == n_acks
+        # the new epoch's seq 1 delivers normally
+        ch.on_wire({"kind": "data", "seq": 1, "ack": 0, "epoch": 1,
+                    "payload": {"new": 1}})
+        assert got == [{"old": 1}, {"new": 1}]
+
+    def test_stale_acks_from_old_epoch_are_ignored(self):
+        ch = ResilientChannel(lambda env: None, lambda m: None)
+        ch.revive()                     # now sending in epoch 1
+        ch.send({"n": 1})
+        assert ch.in_flight == 1
+        # an old-epoch ack (aepoch 0 != epoch 1) happens to cover seq 1:
+        # it must NOT delete the new-epoch window entry
+        ch.on_wire({"kind": "ack", "seq": 0, "ack": 1})
+        assert ch.in_flight == 1
+        assert ch.stats["stale_acks"] == 1
+        ch.on_wire({"kind": "ack", "seq": 0, "ack": 1, "aepoch": 1})
+        assert ch.in_flight == 0 and ch.idle
+
+    def test_coordinated_revive_recovers_duplex_after_death(self):
+        parts = {}
+        la = ChaosLink(lambda env: parts["b"].on_wire(env), seed=11)
+        lb = ChaosLink(lambda env: parts["a"].on_wire(env), seed=12)
+        got_b = []
+        parts["a"] = a = ResilientChannel(la.send, lambda m: None,
+                                          seed=13, max_retries=3,
+                                          base_rto=1, max_rto=2)
+        parts["b"] = b = ResilientChannel(lb.send, got_b.append, seed=14)
+        la.partition()
+        a.send({"n": 1})
+        dead = False
+        for _ in range(256):
+            la.pump()
+            lb.pump()
+            try:
+                a.tick()
+            except PeerDeadError:
+                dead = True
+                break
+            b.tick()
+        assert dead and a.dead
+        la.heal()
+        a.revive()
+        b.revive()                      # both ends: the hello handshake
+        a.send({"n": 1})                # upper layer re-sends (window
+        a.send({"n": 2})                # was reclaimed at death)
+        for _ in range(128):
+            la.pump()
+            lb.pump()
+            a.tick()
+            b.tick()
+            if a.idle and b.idle and la.idle and lb.idle:
+                break
+        assert got_b == [{"n": 1}, {"n": 2}]
+        assert a.idle and b.idle
+        assert a.epoch == 1 and b._peer_epoch == 1
 
 
 # ---------------------------------------------------------------------------
