@@ -148,6 +148,13 @@ class CausalDeviceDoc:
     # comparator, tests/test_dispatch_budget.py).
     donate_buffers = False
     packed_residual_writeback = True
+    # `fused_rounds` opts a doc OUT of the ISSUE-17 fused-round kernels
+    # (ops/fused_round.py) when set False; the effective switch is this
+    # attribute AND the AMTPU_FUSED_ROUNDS env gate (read per round so
+    # the A/B harness and parity tests flip legs without rebuilding
+    # docs). With fusion off, rounds run the verbatim XLA program path —
+    # the byte-identical parity comparator.
+    fused_rounds = True
 
     def __init__(self, obj_id: str):
         self.obj_id = obj_id

@@ -84,6 +84,12 @@ LAST_STATS: dict = {}
 #: with its slow-path scatter).
 APPLY_DISPATCH_BASE = 8
 PASS_DISPATCH_BUDGET = 16
+#: The TIGHTENED per-pass budget when the ISSUE-17 fused path ran
+#: (stats["fused"]): one megakernel (both lanes) + at most one combined
+#: slow-path scatter per pass — the 4 leaves headroom for nothing; it is
+#: double the structural count so a single added program trips the
+#: assert before it doubles the round cost.
+FUSED_PASS_DISPATCH_BUDGET = 4
 
 _MAP_MIRROR_KEYS = ("value", "has_value", "win_counter")
 _TEXT_MIRROR_KEYS = ("parent", "ctr", "actor", "value", "has_value")
@@ -125,8 +131,9 @@ def assert_round_budget(stats: dict = None):
     program launch passes through `_count`)."""
     s = LAST_STATS if stats is None else stats
     assert s, "no stacked apply recorded"
-    limit = APPLY_DISPATCH_BASE + PASS_DISPATCH_BUDGET * max(
-        1, s["passes"])
+    per_pass = (FUSED_PASS_DISPATCH_BUDGET if s.get("fused")
+                else PASS_DISPATCH_BUDGET)
+    limit = APPLY_DISPATCH_BASE + per_pass * max(1, s["passes"])
     assert s["dispatches"] <= limit, (
         f"stacked apply launched {s['dispatches']} device programs for "
         f"{s['passes']} round-pass(es) over {s['docs']} objects "
@@ -324,11 +331,14 @@ def apply_stacked(items):
             return False
 
     # ---- GO: commit queues, hoist interning, run the passes ----------
+    from ..ops import fused_round as _F
+    fused = _F.fused_rounds_enabled() and all(
+        getattr(d, "fused_rounds", True) for d in docs)
     stats = {"docs": len(docs), "map_docs": len(map_docs),
              "text_docs": len(text_docs), "rounds": 0, "passes": 0,
              "dispatches": 0, "syncs": 0, "h2d": 0,
              "text_finalized": 0, "pos_seeded": 0,
-             "text_plans": 0, "index_merges": 0}
+             "text_plans": 0, "index_merges": 0, "fused": fused}
     map_set = (_LaneSet(map_docs,
                         ("value", "has_value", "win_actor", "win_seq",
                          "win_counter"), "map") if map_docs else None)
@@ -410,13 +420,20 @@ def apply_stacked(items):
                             del doc._stager
                         if plan is not None:
                             text_plans.append((doc, b, plan))
-                if map_plans:
-                    _exec_map_pass(map_set, map_plans, stats)
                 if text_plans:
                     stats["text_plans"] += len(text_plans)
                     stats["index_merges"] += sum(
                         p.n_index_merges for _, _, p in text_plans)
-                    _exec_text_pass(text_set, text_plans, stats)
+                if fused and (map_plans or text_plans):
+                    # ISSUE-17 fused pass: both lanes' rounds in ONE
+                    # megakernel dispatch + at most one combined scatter
+                    _exec_fused_pass(map_set, map_plans,
+                                     text_set, text_plans, stats)
+                else:
+                    if map_plans:
+                        _exec_map_pass(map_set, map_plans, stats)
+                    if text_plans:
+                        _exec_text_pass(text_set, text_plans, stats)
                 stats["passes"] += 1
                 if obs.ENABLED:
                     obs.span("commit", "stacked_round", _tp, args={
@@ -537,6 +554,213 @@ def _stacked_slow_scatter(lane_set: _LaneSet, wbs: dict, out_cap: int,
     out = K.stacked_scatter_registers(*regs, jnp.asarray(stacked_wb))
     lane_set.cols = (lane_set.cols[:reg_offset] + tuple(out)
                      + lane_set.cols[reg_offset + 5:])
+
+
+def _wb_matrix(n_docs: int, wbs: dict, out_cap: int):
+    """Stack per-doc (6, S_d) host-resolved writebacks into one
+    (D, 6, S) upload (padding rows: OOB slot, dropped by the scatter)."""
+    from ..ops.ingest import bucket
+
+    S = bucket(max(wb.shape[1] for wb in wbs.values()), 64)
+    m = np.zeros((n_docs, 6, S), np.int32)
+    m[:, 0, :] = out_cap
+    for d, wb in wbs.items():
+        m[d, :, : wb.shape[1]] = wb
+    return m
+
+
+def _exec_fused_pass(map_set, map_plans, text_set, text_plans,
+                     stats: dict):
+    """ISSUE-17 megakernel pass: one causal round across EVERY
+    participating object — both lanes — as ONE `fused_stacked_round`
+    dispatch, then (when any object's round left slow residue) ONE
+    combined `fused_scatter_registers` dispatch. Replaces
+    `_exec_map_pass` + the per-shape-group `_exec_text_pass` sequence:
+    the text lane runs the flag-free fused core, so shape groups (and
+    the dense path's padded-window capacity inflation) disappear — every
+    plan shares one uniform scatter-expansion program."""
+    import jax.numpy as jnp
+    from ..ops import fused_round as F
+    from ..ops import ingest as K
+    from ..ops.ingest import (DESC_ELEM_BASE, RES_NEW_SLOT, RES_SLOT,
+                              bucket)
+
+    mode = F.fused_mode()
+    absent = F._absent()
+    uploads = []
+
+    # ---- map lane staging (the _exec_map_pass recipe, dispatch
+    # deferred into the megakernel) ----
+    with_map = bool(map_plans)
+    m_ops = m_conflict = None
+    m_active = {}
+    map_cap = 1
+    if with_map:
+        m_docs = map_set.docs
+        map_cap = max(max(p["out_cap"] for _, _, p in map_plans),
+                      map_set.cap)
+        map_set.ensure(map_cap, stats)
+        map_cap = max(map_cap, map_set.cap)
+        M = bucket(max(p["n_ops"] for _, _, p in map_plans), 128)
+        m_ops = np.zeros((len(m_docs), 5, M), np.int32)
+        m_ops[:, K.MOP_KIND, :] = -1
+        m_ops[:, K.MOP_SLOT, :] = map_cap
+        m_conflict = _conflict_matrix(m_docs, map_cap)
+        for doc, b, p in map_plans:
+            d = map_set.idx[id(doc)]
+            m_active[d] = (doc, b, p)
+            n = p["n_ops"]
+            m_ops[d, K.MOP_KIND, :n] = p["kind"]
+            m_ops[d, K.MOP_SLOT, :n] = p["slot"]
+            m_ops[d, K.MOP_VALUE, :n] = p["value"]
+            m_ops[d, K.MOP_WIN_ACTOR, :n] = p["win_actor"]
+            m_ops[d, K.MOP_WIN_SEQ, :n] = p["win_seq"]
+        uploads += [m_ops, m_conflict]
+
+    # ---- text lane staging: ONE uniform group (no static shape flags,
+    # no dense-window capacity inflation — the fused expand drops
+    # padding through the scatter's OOB sentinel) ----
+    with_text = bool(text_plans)
+    desc_g = blob_g = res_g = conflict_g = touch_g = None
+    t_active = {}
+    text_cap = 1
+    text_res = False
+    if with_text:
+        t_docs = text_set.docs
+        Dt = len(t_docs)
+        text_cap = max(max(p.out_cap for _, _, p in text_plans),
+                       text_set.cap)
+        text_set.ensure(text_cap, stats)
+        text_cap = max(text_cap, text_set.cap)
+        R = bucket(max([p.desc.shape[1] for _, _, p in text_plans
+                        if p.desc is not None] + [1]), 64)
+        N = bucket(max([p.blob.shape[0] for _, _, p in text_plans
+                        if p.blob is not None] + [1]), 256)
+        desc_g = np.zeros((Dt, 9, R), np.int32)
+        desc_g[:, DESC_ELEM_BASE, :] = N
+        blob_g = np.zeros((Dt, N), np.int32)
+        Mt = bucket(max([p.res.shape[1] for _, _, p in text_plans
+                         if p.res is not None] + [1]), 128)
+        res_g = np.zeros((Dt, 8, Mt), np.int32)
+        res_g[:, 0, :] = -1                      # RES_KIND padding
+        res_g[:, RES_SLOT, :] = text_cap
+        res_g[:, RES_NEW_SLOT, :] = text_cap
+        conflict_g = _conflict_matrix(t_docs, text_cap)
+        T = bucket(max([p.touch.shape[1] for _, _, p in text_plans
+                        if p.touch is not None] + [1]), 64)
+        touch_g = np.zeros((Dt, 3, T), np.int32)
+        touch_g[:, 1:, :] = -1
+        for doc, b, p in text_plans:
+            d = text_set.idx[id(doc)]
+            t_active[d] = (doc, b, p)
+            if p.desc is not None:
+                w = p.desc.shape[1]
+                desc_g[d, :, :w] = p.desc
+                pn = p.blob.shape[0]
+                eb = desc_g[d, DESC_ELEM_BASE]
+                eb[eb == pn] = N                 # re-pad the sentinel
+                blob_g[d, :pn] = p.blob
+            if p.res is not None:
+                text_res = True
+                w = p.res.shape[1]
+                res_g[d, :, :w] = p.res
+                for r in (RES_SLOT, RES_NEW_SLOT):
+                    row = res_g[d, r]
+                    row[row == p.out_cap] = text_cap
+            if p.touch is not None:
+                w = p.touch.shape[1]
+                touch_g[d, :, :w] = p.touch
+            doc._begin_round_host(p)
+        uploads += [desc_g, blob_g, res_g, conflict_g, touch_g]
+
+    # ---- THE dispatch of the pass ----
+    _count(stats, "fused_stacked_round")
+    _note_h2d(stats, len(uploads), sum(x.nbytes for x in uploads))
+    args_map = ((tuple(map_set.cols) + (jnp.asarray(m_ops),
+                                        jnp.asarray(m_conflict)))
+                if with_map else (absent,) * 7)
+    args_text = ((tuple(text_set.cols)
+                  + (jnp.asarray(desc_g), jnp.asarray(blob_g),
+                     jnp.asarray(res_g), jnp.asarray(conflict_g),
+                     jnp.asarray(touch_g)))
+                 if with_text else (absent,) * 14)
+    out = F.fused_stacked_round(
+        *args_map, *args_text, map_cap=map_cap, text_cap=text_cap,
+        with_map=with_map, with_text=with_text, mode=mode)
+    i = 0
+    m_info_dev = t_info_dev = None
+    if with_map:
+        map_set.cols = out[:5]
+        map_set.cap = map_cap
+        m_info_dev = out[5]
+        i = 6
+    if with_text:
+        text_set.cols = out[i: i + 9]
+        text_set.cap = text_cap
+        t_info_dev = out[i + 9]
+        for _d, (doc, _b, p) in t_active.items():
+            doc._cap = text_cap
+            doc._finish_round_host(p)
+
+    # ---- slow residue: one packed d2h fetch per lane, host resolution,
+    # one COMBINED scatter dispatch ----
+    map_wbs = {}
+    if with_map:
+        _ts = obs.now() if obs.ENABLED else 0
+        info = np.asarray(m_info_dev)
+        _count_sync(stats, "stacked_slow_info", _ts,
+                    d2h_bytes=info.nbytes)
+        for d, (doc, b, p) in m_active.items():
+            row = info[d][:, : p["n_ops"]]
+            if row[0].any():
+                idxs = np.nonzero(row[0])[0]
+                map_wbs[d] = doc._resolve_slow_host(
+                    b, row[1][idxs], p["kind"][idxs], p["val64"][idxs],
+                    p["win_actor"][idxs], p["win_seq"][idxs],
+                    slot_cap=map_cap,
+                    reg_state=tuple(row[r][idxs] for r in range(2, 7)))
+    text_wbs = {}
+    if text_res:
+        _ts = obs.now() if obs.ENABLED else 0
+        info = np.asarray(t_info_dev)
+        _count_sync(stats, "stacked_slow_info", _ts,
+                    d2h_bytes=info.nbytes)
+        for d, (doc, b, p) in t_active.items():
+            row = info[d][:, : p.n_res]
+            if not p.n_res or not row[0].any():
+                continue
+            res_kind, res_vals, res_rank, res_seq = p.res_host
+            idxs = np.nonzero(row[0])[0]
+            text_wbs[d] = doc._resolve_slow_host(
+                b, row[1][idxs], res_kind[idxs], res_vals[idxs],
+                res_rank[idxs], res_seq[idxs], slot_cap=text_cap,
+                reg_state=tuple(row[r][idxs] for r in range(2, 7)))
+    if map_wbs or text_wbs:
+        m_wb = (_wb_matrix(len(map_set.docs), map_wbs, map_cap)
+                if map_wbs else None)
+        t_wb = (_wb_matrix(len(text_set.docs), text_wbs, text_cap)
+                if text_wbs else None)
+        _count(stats, "fused_scatter")
+        _note_h2d(stats, sum(1 for x in (m_wb, t_wb) if x is not None),
+                  sum(x.nbytes for x in (m_wb, t_wb) if x is not None))
+        out = F.fused_scatter_registers(
+            *(tuple(map_set.cols) + (jnp.asarray(m_wb),)
+              if map_wbs else (absent,) * 6),
+            *(tuple(text_set.cols[3:8]) + (jnp.asarray(t_wb),)
+              if text_wbs else (absent,) * 6),
+            with_map=bool(map_wbs), with_text=bool(text_wbs))
+        i = 0
+        if map_wbs:
+            map_set.cols = out[:5]
+            i = 5
+        if text_wbs:
+            text_set.cols = (tuple(text_set.cols[:3]) + tuple(out[i: i + 5])
+                             + tuple(text_set.cols[8:]))
+    for _d, (doc, _b, _p) in m_active.items():
+        doc._cap = map_cap
+        doc._invalidate()
+    for d in text_wbs:
+        t_active[d][0]._invalidate()
 
 
 def _text_shape(plan):

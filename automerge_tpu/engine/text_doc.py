@@ -834,10 +834,9 @@ class DeviceTextDoc(CausalDeviceDoc):
                 # is ONE fused device program (apply_mixed_round): one
                 # dispatch per committed round, and XLA fuses the phases
                 # instead of round-tripping tables between three programs
-                expand_kind = (("dense" if plan.dense else "sparse")
-                               if plan.n_runs else "none")
+                from ..ops import fused_round as F
                 with_res = bool(plan.n_res)
-                with_touch = plan.touch is not None
+                use_fused = self.fused_rounds and F.fused_rounds_enabled()
                 if with_res:
                     # conflict slots are built at execute time (NOT staged
                     # at plan time): an earlier round of the same prepared
@@ -848,20 +847,42 @@ class DeviceTextDoc(CausalDeviceDoc):
                         conflict_slots[: len(self.conflicts)] = \
                             list(self.conflicts)
                     conflict_dev = jnp.asarray(conflict_slots)
+                elif use_fused:
+                    conflict_dev = F.round_dummies(out_cap)[3]
                 else:
                     conflict_dev = K._dummy_i32()
-                dummy = K._dummy_i32()
-                fn = (K.apply_mixed_round_donated if donate
-                      else K.apply_mixed_round)
-                self._count_dispatch(label="apply_mixed_round")
-                out = fn(*tables,
-                         plan.desc if plan.desc is not None else dummy,
-                         plan.blob if plan.blob is not None else dummy,
-                         plan.res if plan.res is not None else dummy,
-                         conflict_dev,
-                         plan.touch if plan.touch is not None else dummy,
-                         out_cap=out_cap, expand_kind=expand_kind,
-                         with_res=with_res, with_touch=with_touch)
+                if use_fused:
+                    # ISSUE-17 fused round: the flag-free core — every
+                    # phase runs over padding-convention no-ops, so one
+                    # trace per capacity bucket replaces the
+                    # (expand_kind, with_res, with_touch) trace lattice
+                    dd, db, dr, _dc, dt = F.round_dummies(out_cap)
+                    fn = (F.fused_mixed_round_donated if donate
+                          else F.fused_mixed_round)
+                    self._count_dispatch(label="fused_mixed_round")
+                    out = fn(*tables,
+                             plan.desc if plan.desc is not None else dd,
+                             plan.blob if plan.blob is not None else db,
+                             plan.res if plan.res is not None else dr,
+                             conflict_dev,
+                             plan.touch if plan.touch is not None else dt,
+                             out_cap=out_cap, mode=F.fused_mode())
+                else:
+                    expand_kind = (("dense" if plan.dense else "sparse")
+                                   if plan.n_runs else "none")
+                    with_touch = plan.touch is not None
+                    dummy = K._dummy_i32()
+                    fn = (K.apply_mixed_round_donated if donate
+                          else K.apply_mixed_round)
+                    self._count_dispatch(label="apply_mixed_round")
+                    out = fn(*tables,
+                             plan.desc if plan.desc is not None else dummy,
+                             plan.blob if plan.blob is not None else dummy,
+                             plan.res if plan.res is not None else dummy,
+                             conflict_dev,
+                             plan.touch if plan.touch is not None else dummy,
+                             out_cap=out_cap, expand_kind=expand_kind,
+                             with_res=with_res, with_touch=with_touch)
                 tables = out[:9]
                 if with_res:
                     # the ONE d2h round trip of the residual path: slow

@@ -167,6 +167,54 @@ def fused_segment_scans(chain, has_value, n_elems, base=0, *,
     return rank, head, cumvis
 
 
+def _multi_scan_kernel(x_ref, o_ref, carry):
+    """K independent row-wise prefix sums, one (K, ROWS, LANES) tile per
+    grid step, per-channel running totals carried in SMEM."""
+    i = pl.program_id(0)
+    n_chan = x_ref.shape[0]
+
+    @pl.when(i == 0)
+    def _():
+        for k in range(n_chan):
+            carry[k] = 0
+
+    for k in range(n_chan):
+        x = x_ref[k]
+        cs = _scan_add(x, 1)
+        row_tot = cs[:, -1:]
+        row_pre = _scan_add(row_tot, 0) - row_tot
+        o_ref[k] = cs + row_pre + carry[k]
+        carry[k] = carry[k] + jnp.sum(x)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def multi_scan(x, *, interpret: bool = False):
+    """Row-wise inclusive prefix sum of an int32 (K, N) matrix in ONE
+    kernel: the fused-round expansion (ops/fused_round.py) scans its six
+    boundary-delta channels here instead of six XLA cumsum programs. Same
+    tile/carry structure as `fused_segment_scans`; any N works (internal
+    pad to a TILE multiple, outputs sliced back)."""
+    K, N0 = x.shape
+    N = ((N0 + TILE - 1) // TILE) * TILE
+    if N != N0:
+        x = jnp.pad(x, ((0, 0), (0, N - N0)))
+    grid = N // TILE
+    shape3d = (K, grid * ROWS, LANES)
+
+    out = pl.pallas_call(
+        _multi_scan_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((K, ROWS, LANES), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((K, ROWS, LANES), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(shape3d, jnp.int32),
+        scratch_shapes=[pltpu.SMEM((K,), jnp.int32)],
+        interpret=interpret,
+    )(x.astype(jnp.int32).reshape(shape3d))
+    return out.reshape(K, N)[:, :N0]
+
+
 def sharded_fused_scans(mesh, chain, has_value, n_elems, *, axis: str = "elem",
                         interpret: bool = False):
     """`fused_segment_scans` over an element-sharded table: each device

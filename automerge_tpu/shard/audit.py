@@ -118,6 +118,29 @@ def commit_path_collectives(mesh=None, docs_per_device: int = 2,
         in_shardings=(shard,) * 6, out_shardings=shard)
     out["stacked_scatter_registers"] = count_collectives(
         scatter_fn, reg_tables + (put(wb),))
+
+    # ISSUE 17: the fused megakernel (both lanes in one program) and the
+    # combined scatter must stay embarrassingly parallel over the doc
+    # axis too. Audited on the "lax" scan rung — the audit is about the
+    # SPMD partitioner's view of the doc axis, and the Pallas rung lowers
+    # the same per-shard program bodies.
+    from ..ops import fused_round as F
+    fused_fn = jax.jit(
+        lambda *a: F.fused_stacked_round(
+            *a, map_cap=cap, text_cap=cap, with_map=True, with_text=True,
+            mode="lax"),
+        in_shardings=(shard,) * 21, out_shardings=shard)
+    out["fused_stacked_round"] = count_collectives(
+        fused_fn,
+        reg_tables + (put(ops), put(conflict)) + elem_tables
+        + (put(desc), put(blob), put(res), put(conflict), put(touch)))
+    fscatter_fn = jax.jit(
+        lambda *a: F.fused_scatter_registers(
+            *a, with_map=True, with_text=True),
+        in_shardings=(shard,) * 12, out_shardings=shard)
+    out["fused_scatter_registers"] = count_collectives(
+        fscatter_fn,
+        reg_tables + (put(wb),) + elem_tables[3:8] + (put(wb),))
     del jnp
     return out
 

@@ -781,6 +781,50 @@ def config16_federation(n_rounds: int = 12, n_rooms: int = 4,
         print(f"# appended to {B.SESSION_LOG_PATH}", file=sys.stderr)
 
 
+def config17_fused(quick: bool = False, record_session: bool = False):
+    """Fused-round megakernel A/B row (ISSUE 17, INTERNALS §21): the
+    cfg17 bench pairs every rewritten kernel (solo mixed round, the
+    both-lanes stacked megakernel, the combined scatter) with its XLA
+    comparator on the SAME pre-generated stream — fused vs XLA seconds
+    by cost-model attribution, roofline ratio both legs, dispatch count
+    per round — with identical committed state, byte-identical frontend
+    saves across AMTPU_FUSED_ROUNDS, the tightened round budget, and
+    zero steady-state recompiles all asserted in-run. Subprocess for a
+    clean registry/jax state; ``--session`` appends the row to
+    BENCH_SESSIONS.jsonl."""
+    import os
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "AMTPU_SKIP_PREFLIGHT": "1"}
+    cmd = [sys.executable, os.path.join(root, "bench.py"), "--fused"]
+    if quick:
+        cmd.append("--quick")
+    if record_session:
+        cmd.append("--session")
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=root,
+                         env=env, timeout=1200)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"cfg17 fused-round bench failed rc={out.returncode}: "
+            f"{out.stderr[-800:]}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    emit("cfg17_fused_rounds_ops_per_sec", rec["value"], "ops/s",
+         xla_ops_per_sec=rec["xla_ops_per_sec"],
+         speedup_vs_xla=rec["speedup_vs_xla"],
+         dispatch_per_round=rec["dispatch_per_round"],
+         xla_dispatch_per_round=rec["xla_dispatch_per_round"],
+         dispatch_reduction=rec["dispatch_reduction"],
+         recompiles_at_steady_state=rec["recompiles_at_steady_state"],
+         roofline_ratio_fused=rec["roofline_ratio_fused"],
+         roofline_ratio_xla=rec["roofline_ratio_xla"],
+         roofline_ratio_vs_xla=rec["roofline_ratio_vs_xla"],
+         kernel_ab=rec["kernel_ab"],
+         saves_byte_identical=rec["saves_byte_identical"],
+         measured_platform=rec["platform"],
+         threshold=rec["threshold"])
+
+
 def config5b_residual_heavy(n_actors: int = 10_000, quick: bool = False):
     """Adversarial headline shape: 20% of ops are RESIDUALS (bare deletes
     of distinct base elements + bare inserts without values) that cannot
@@ -1527,6 +1571,10 @@ def main():
         # the chip_session.sh cfg16 step: ONLY the federation row
         config16_federation(quick=quick, record_session=True)
         return
+    if "--fused-session" in sys.argv:
+        # the chip_session.sh cfg17 step: ONLY the fused-round A/B row
+        config17_fused(quick=quick, record_session=True)
+        return
     record_round = None
     record_path = None
     if "--record" in sys.argv:
@@ -1614,6 +1662,7 @@ def main():
         lambda: config13_wire(quick=quick),
         lambda: config14_lineage(quick=quick),
         lambda: config15_device_truth(quick=quick),
+        lambda: config17_fused(quick=quick),
     ]
     if record_path is not None:
         steps.insert(0, fold_headline)

@@ -76,6 +76,11 @@ SLOS = [
     # across the WAN pages here even while local throughput holds)
     ("cfg16_federation", "value", "min", 0.8),
     ("cfg16_federation", "cross_region_visibility_p99_ms", "max", 1.5),
+    # ISSUE 17: fused-round rows — throughput floor on the fused leg of
+    # the megakernel A/B (the leg AMTPU_FUSED_ROUNDS ships on by
+    # default; the XLA comparator leg is recorded alongside but carries
+    # no bar of its own)
+    ("cfg17_fused_rounds", "value", "min", 0.8),
 ]
 
 #: Absolute SLOs: (metric_prefix, dotted field, op, bound) checked on
@@ -124,6 +129,18 @@ ABS_SLOS = [
     # cross-region lag (pending group-token envelopes) in a committed
     # row is a wiring bug, not a tradeoff
     ("cfg16_federation", "residual_lag_tokens", "<=", 0),
+    # the ISSUE-17 acceptance bars on every committed cfg17 row,
+    # forever: the stacked round-loop dispatch count stays under the
+    # TIGHTENED fused budget (APPLY_DISPATCH_BASE 8 + FUSED_PASS_
+    # DISPATCH_BUDGET 4 per pass, engine/stacked.py — this workload is
+    # single-pass, so 12 is the hard ceiling, not a tunable); the fused
+    # leg's measured-vs-roofline ratio never regresses past the XLA
+    # comparator's on the same stream (no-worse, with headroom for the
+    # cpu sanity-band caveats of INTERNALS §19.4); and the fused entry
+    # points compile NOTHING at steady state (also asserted in-run)
+    ("cfg17_fused_rounds", "dispatch_per_round", "<=", 12.0),
+    ("cfg17_fused_rounds", "roofline_ratio_vs_xla", "<=", 1.25),
+    ("cfg17_fused_rounds", "recompiles_at_steady_state", "<=", 0),
 ]
 
 #: Derived fields computable from any row that carries the inputs.

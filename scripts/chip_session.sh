@@ -153,6 +153,20 @@ run "cfg15_device_truth" 1200 python -m benchmarks.run_all --device-truth-sessio
 # asserted inside the measurement, cross-region visibility quantiles
 # from rate=1 lineage; appended to BENCH_SESSIONS.jsonl
 run "cfg16_federation" 1200 python -m benchmarks.run_all --federation-session
+# fused-round megakernel A/B (ISSUE 17): the cfg17 row on the chip —
+# the FIRST run where the Pallas rung (not the cpu lax fallback) carries
+# the fused leg: one fused_stacked_round megakernel + at most one
+# combined scatter per stacked pass vs the verbatim XLA program path on
+# the same stream. Identical committed state, byte-identical saves
+# across AMTPU_FUSED_ROUNDS, the tightened 4/pass budget, zero
+# steady-state recompiles and per-kernel roofline ratios all asserted
+# inside the measurement; datasheet peaks exported so the
+# measured-vs-roofline columns are chip-real, not the cpu sanity band;
+# appended to BENCH_SESSIONS.jsonl
+run "cfg17_fused" 1200 env \
+  AMTPU_PEAK_FLOPS="${AMTPU_PEAK_FLOPS:-2e14}" \
+  AMTPU_PEAK_BYTES_PER_S="${AMTPU_PEAK_BYTES_PER_S:-8e11}" \
+  python -m benchmarks.run_all --fused-session
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu

@@ -426,7 +426,9 @@ def test_commit_path_compiles_with_zero_collectives():
         pytest.skip("single-device backend: doc mesh is trivial")
     audit = commit_path_collectives()
     assert set(audit) == {"stacked_map_round", "stacked_mixed_round",
-                          "stacked_scatter_registers"}
+                          "stacked_scatter_registers",
+                          "fused_stacked_round",
+                          "fused_scatter_registers"}
     assert_zero_collectives(audit)
 
 
