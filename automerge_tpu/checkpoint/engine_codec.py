@@ -238,7 +238,10 @@ def _require(arrays: dict, name: str) -> np.ndarray:
 def _padded_tables(arrays: dict, prefix: str, keys, n_live: int, cap: int):
     """-> (host dict, device dict) of tables padded to `cap`."""
     import jax.numpy as jnp
+
+    from ..engine import accounting
     host, dev = {}, {}
+    staged = 0
     for key in keys:
         col = _require(arrays, prefix + "tbl_" + key)
         want_bool = key in _BOOL_KEYS
@@ -253,6 +256,11 @@ def _padded_tables(arrays: dict, prefix: str, keys, n_live: int, cap: int):
         out[:n_live] = col[:n_live]
         host[key] = out
         dev[key] = jnp.asarray(out)
+        staged += out.nbytes
+    # the restore IS an h2d staging pass (padded tables -> device):
+    # meter the exact bytes so residency page-ins are measured volume,
+    # not an estimate (PR-15 metered-staging discipline)
+    accounting.record_h2d(staged)
     return host, dev
 
 

@@ -189,7 +189,10 @@ class LineageLedger:
     #: commit elsewhere) must not truncate the reported parked/deferred
     #: period — these are the headline dwell numbers the cfg14 row and
     #: the soak summary report.
-    PAIRED_DWELL = {"quar/release": "quar/park", "svc/admit": "svc/defer"}
+    PAIRED_DWELL = {"quar/release": "quar/park", "svc/admit": "svc/defer",
+                    # residency page-in dwell: bundle pop + h2d staging,
+                    # opened by res/page_wait at the adopting lane site
+                    "res/page_in": "res/page_wait"}
 
     def record(self, actor: str, seq: int, stage: str, site=None,
                doc=None, extra=0, t_ns: Optional[int] = None) -> bool:

@@ -91,6 +91,17 @@ class ServiceConfig:
       context, so room document state lives device-local per shard. 0
       (the default) keeps the unsharded single-device behavior; -1 uses
       one lane per visible device.
+    - ``residency_budget_bytes`` (+ ``residency_headroom`` /
+      ``residency_cold_after`` / ``residency_spill_dir``): the
+      device-residency tier (INTERNALS §22). Non-zero turns on the bulk
+      doc mesh with a residency manager over the service's shard lanes:
+      hot docs stay device-resident under the byte budget, warm docs
+      demote to host checkpoint bundles, cold bundles age to disk after
+      ``residency_cold_after`` pager rounds (``residency_spill_dir``
+      must be set for the cold tier). ``tick()`` is the pager
+      heartbeat; ``mesh_deliver`` feeds the paging gate. Like every
+      other knob here, this is a BOUND: the live population may be any
+      size, the device bytes may not.
     """
 
     __slots__ = ("tick_budget_ms", "heartbeat_ticks", "suspect_grace_ticks",
@@ -98,7 +109,9 @@ class ServiceConfig:
                  "quarantine_capacity", "quarantine_global_capacity",
                  "starvation_boost_ticks", "tick_ring", "default_budget",
                  "lag_probe_ticks", "event_log", "prom_lag_series",
-                 "shard_lanes", "region")
+                 "shard_lanes", "region", "residency_budget_bytes",
+                 "residency_headroom", "residency_cold_after",
+                 "residency_spill_dir")
 
     def __init__(self, *, tick_budget_ms: float = 0.0,
                  heartbeat_ticks: int = 30, suspect_grace_ticks: int = 30,
@@ -110,7 +123,10 @@ class ServiceConfig:
                  default_budget: TenantBudget = None,
                  lag_probe_ticks: int = 1, event_log: int = 256,
                  prom_lag_series: int = 64, shard_lanes: int = 0,
-                 region: str = None):
+                 region: str = None, residency_budget_bytes: int = 0,
+                 residency_headroom: float = 0.85,
+                 residency_cold_after: int = 64,
+                 residency_spill_dir: str = None):
         self.tick_budget_ms = tick_budget_ms
         self.heartbeat_ticks = heartbeat_ticks
         self.suspect_grace_ticks = suspect_grace_ticks
@@ -133,6 +149,10 @@ class ServiceConfig:
         #: (``svc:<region>/<room>``), so a change's hop chain names
         #: WHICH region's replica made it visible.
         self.region = region
+        self.residency_budget_bytes = int(residency_budget_bytes)
+        self.residency_headroom = float(residency_headroom)
+        self.residency_cold_after = int(residency_cold_after)
+        self.residency_spill_dir = residency_spill_dir
 
 
 def approx_msg_bytes(msg) -> int:

@@ -213,6 +213,28 @@ class SyncService:
                 ShardLane(i, devices[i % len(devices)],
                           telemetry=self.telemetry, assert_budget=False)
                 for i in range(n)]
+        # the device-residency tier (INTERNALS §22): a non-zero budget
+        # turns on the bulk doc mesh — a ShardedDocSet over the SAME
+        # shard lanes (or one service-local lane) with a residency
+        # manager enforcing the byte budget: mesh_deliver feeds the
+        # paging gate, tick() is the pager heartbeat
+        self._doc_mesh = None
+        self._residency = None
+        self._mesh_backlog: list = []
+        if self.config.residency_budget_bytes:
+            from ..shard.set import ShardedDocSet
+            if self._shard_lanes:
+                self._doc_mesh = ShardedDocSet(
+                    telemetry=self.telemetry, lanes=self._shard_lanes)
+            else:
+                self._doc_mesh = ShardedDocSet(
+                    n_shards=1, telemetry=self.telemetry,
+                    assert_budget=False)
+            self._residency = self._doc_mesh.attach_residency(
+                budget_bytes=self.config.residency_budget_bytes,
+                headroom=self.config.residency_headroom,
+                cold_after=self.config.residency_cold_after,
+                spill_dir=self.config.residency_spill_dir)
         # black-box degradation-event ring for describe(): the
         # postmortem must work with tracing OFF, so the service keeps
         # its own bounded copy of the ladder events it obs-emits
@@ -462,6 +484,16 @@ class SyncService:
                          if s.pending_dead]:
                 self.evict(sess.tenant_id, sess.pending_dead)
         self._track_bounds()
+        if self._doc_mesh is not None:
+            # the residency tier's tick-loop paging hooks: drain the
+            # bulk-mesh backlog through the paging gate (deliver_round
+            # pages stored docs in, reserves for new ones, evicts to
+            # budget), then beat the pager clock so warm bundles age
+            # toward the cold tier even across idle ticks
+            backlog, self._mesh_backlog = self._mesh_backlog, []
+            for deliveries in backlog:
+                self._doc_mesh.deliver_round(deliveries)
+            self._residency.tick()
         if cfg.lag_probe_ticks \
                 and self._tick_no % cfg.lag_probe_ticks == 0:
             self.probe_lag()
@@ -747,6 +779,30 @@ class SyncService:
                 "p50_tick_ms": pct(0.50), "p99_tick_ms": pct(0.99),
                 "max_tick_ms": round(ring[-1], 3) if ring else 0.0}
 
+    # -- the bulk doc mesh (residency tier, INTERNALS §22) --------------
+
+    @property
+    def residency(self):
+        """The residency manager, or None when the tier is off."""
+        return self._residency
+
+    @property
+    def doc_mesh(self):
+        """The bulk :class:`~..shard.set.ShardedDocSet`, or None."""
+        return self._doc_mesh
+
+    def mesh_deliver(self, deliveries: dict):
+        """Enqueue one bulk-mesh serving round ``{doc_id: [changes]}``;
+        the next :meth:`tick` drains it through the paging gate
+        (demand page-ins, budget eviction, quarantine for premature
+        changes). The tick-loop hook that lets sync traffic drive
+        residency without a second scheduler."""
+        if self._doc_mesh is None:
+            raise RuntimeError(
+                "residency tier is off: set residency_budget_bytes")
+        self._mesh_backlog.append(dict(deliveries))
+        return len(self._mesh_backlog)
+
     def reclaimed(self, tenant_id: str) -> bool:
         """True iff no service-side state remains for an evicted tenant:
         session, hub peer, ClockMatrix slot, quarantine attribution (the
@@ -829,6 +885,8 @@ class SyncService:
             "events": list(self._events),
             "tick_p99_ms_telemetry": self.tick_p99_ms_telemetry(),
             **({"shards": self.shard_map()} if self._shard_lanes else {}),
+            **({"residency": self._residency.describe()}
+               if self._residency is not None else {}),
             **({"federation": self._federation.describe()}
                if self._federation is not None else {}),
         }
@@ -896,6 +954,11 @@ class SyncService:
             # ladder states, transition counters, per-(remote, room)
             # lag-token gauges, buffered/shipped/received totals
             fams += self._federation.families("amtpu_region")
+        if self._residency is not None:
+            # residency-tier families (INTERNALS §22.4): per-tier doc/
+            # byte gauges, paging event counters, budget + peak, hit
+            # rate, page-in dwell p99
+            fams += self._residency.families("amtpu_residency")
         if lineage.ledger() is not None:
             # per-stage dwell histograms + end-to-end visibility
             # quantiles for the sampled change population (§18.3)

@@ -141,6 +141,25 @@ def commit_path_collectives(mesh=None, docs_per_device: int = 2,
     out["fused_scatter_registers"] = count_collectives(
         fscatter_fn,
         reg_tables + (put(wb),) + elem_tables[3:8] + (put(wb),))
+
+    # ISSUE 18 (the PR-17 leftover): the ring-commit megakernels — the
+    # whole common-case merge round (dense expansion + materialization)
+    # in ONE program, the pipelined ring's steady-state commit — must
+    # also stay embarrassingly parallel over the doc axis. The raw
+    # per-doc kernels vmap over the leading doc dimension.
+    segplan = np.zeros((D, 4, S), i32)
+    planned_fn = jax.jit(
+        jax.vmap(lambda *a: K._merge_and_materialize_dense_planned(
+            *a, out_cap=cap, S=S, as_u8=True, L=cap)),
+        in_shardings=(shard,) * 12, out_shardings=shard)
+    out["merge_and_materialize_dense_planned"] = count_collectives(
+        planned_fn, elem_tables + (put(desc), put(blob), put(segplan)))
+    dense_fn = jax.jit(
+        jax.vmap(lambda *a: K._merge_and_materialize_dense(
+            *a, out_cap=cap, S=S, as_u8=True, L=cap)),
+        in_shardings=(shard,) * 11, out_shardings=shard)
+    out["merge_and_materialize_dense"] = count_collectives(
+        dense_fn, elem_tables + (put(desc), put(blob)))
     del jnp
     return out
 

@@ -84,6 +84,12 @@ class ShardLane:
         self.docs[doc_id] = doc
         self.doc_ops[doc_id] = 0
         self.stats["docs_in"] += 1
+        # a promote boundary: the doc's tables just landed on this
+        # device — feed its gauge and this lane's aggregate immediately
+        # (the residency budget invariant reads the live gauges, not
+        # the next commit)
+        doc._note_footprint()
+        self._note_footprint()
         return doc
 
     def export(self, doc_id: str) -> bytes:
@@ -91,12 +97,18 @@ class ShardLane:
         (the migration source half; commit-boundary only — the caller
         guarantees no in-flight plan)."""
         from ..checkpoint import capture_engine
+        from ..obs import device_truth
         doc = self.docs[doc_id]
         with self.device_ctx():
             bundle = capture_engine(doc)
         del self.docs[doc_id]
         self.doc_ops.pop(doc_id, None)
         self.stats["docs_out"] += 1
+        # a demote boundary: the tables leave the device with the doc —
+        # retire its gauge (peak already recorded) and re-aggregate
+        if device_truth.ENABLED:
+            device_truth.REGISTRY.drop_footprint("doc", doc.obj_id)
+        self._note_footprint()
         return bundle
 
     # -- the commit path ------------------------------------------------
@@ -151,6 +163,12 @@ class ShardLane:
             obs.span("shard", "lane_ingest", _t0, args={
                 "lane": self.index, "docs": len(items), "n_ops": n_ops,
                 "stacked": bool(st)})
+        # the stacked path commits outside the per-doc apply wrappers,
+        # so feed each touched doc's footprint gauge here — the lane
+        # ingest IS their commit boundary (the residency budget
+        # invariant is asserted against the doc-kind peak gauge)
+        for doc_id in deliveries:
+            self.docs[doc_id]._note_footprint()
         self._note_footprint()
         return n_ops
 

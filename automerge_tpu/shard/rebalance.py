@@ -50,6 +50,15 @@ class Rebalancer:
         loads = self.window_loads()
         hot = max(range(len(loads)), key=loads.__getitem__)
         cold = min(range(len(loads)), key=loads.__getitem__)
+        if sharded.residency is not None:
+            # budget-aware placement (INTERNALS §22): among the lanes
+            # tied for the coldest window, land the migrant where the
+            # device footprint is lightest — a rebalance should relieve
+            # ops pressure without concentrating bytes
+            cold = min(
+                (i for i in range(len(loads)) if loads[i] == loads[cold]),
+                key=lambda i: (
+                    sharded.lanes[i].device_footprint()["device_bytes"], i))
         if hot == cold or loads[hot] < self.min_ops \
                 or loads[hot] < self.ratio * max(loads[cold], 1):
             return None

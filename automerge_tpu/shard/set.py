@@ -58,26 +58,37 @@ class ShardedDocSet:
     def __init__(self, n_shards: int = None, devices=None,
                  doc_kind: str = "text", capacity: int = 1024,
                  quarantine_capacity: int = 1024, telemetry=None,
-                 assert_budget: bool = True):
-        if devices is None:
-            devices = default_devices()
-        if n_shards is None:
-            n_shards = len(devices)
-        #: always-on rolling telemetry: per-lane admitted-ops windows
-        #: (the rebalance policy's input) + migration counters
-        self.telemetry = telemetry if telemetry is not None else Telemetry()
-        self.placement = PlacementTable(n_shards)
-        self.lanes = [ShardLane(i, devices[i % len(devices)],
-                                telemetry=self.telemetry,
-                                assert_budget=assert_budget,
-                                doc_kind=doc_kind, capacity=capacity)
-                      for i in range(n_shards)]
+                 assert_budget: bool = True, lanes=None):
+        if lanes is not None:
+            # adopt pre-built lanes (the service shares its tick-loop
+            # lanes with the bulk doc mesh this way) — they already
+            # carry a telemetry sink and device bindings
+            self.telemetry = telemetry if telemetry is not None \
+                else lanes[0].telemetry
+            self.lanes = list(lanes)
+            self.placement = PlacementTable(len(self.lanes))
+        else:
+            if devices is None:
+                devices = default_devices()
+            if n_shards is None:
+                n_shards = len(devices)
+            #: always-on rolling telemetry: per-lane admitted-ops windows
+            #: (the rebalance policy's input) + migration counters
+            self.telemetry = telemetry if telemetry is not None \
+                else Telemetry()
+            self.placement = PlacementTable(n_shards)
+            self.lanes = [ShardLane(i, devices[i % len(devices)],
+                                    telemetry=self.telemetry,
+                                    assert_budget=assert_budget,
+                                    doc_kind=doc_kind, capacity=capacity)
+                          for i in range(n_shards)]
         self.doc_kind = doc_kind
         self.capacity = capacity
         self._quarantine: dict = {}     # doc_id -> QuarantineQueue
         self._quarantine_cap = quarantine_capacity
         self._migrating: dict = {}      # doc_id -> [parked deliveries]
         self.rebalancer = None          # attach_rebalancer installs one
+        self.residency = None           # attach_residency installs one
         self.stats = {"rounds": 0, "admitted_ops": 0, "parked": 0,
                       "released": 0, "migrations": 0,
                       "migrations_deferred": 0, "migration_parked": 0,
@@ -118,6 +129,8 @@ class ShardedDocSet:
                            if len(q)},
             "migrating": sorted(self._migrating),
             "stats": dict(self.stats),
+            **({"residency": self.residency.describe()}
+               if self.residency is not None else {}),
         }
 
     # -- the delivery gate ----------------------------------------------
@@ -149,7 +162,7 @@ class ShardedDocSet:
             rest = nxt
         return ready, rest
 
-    def _park(self, doc_id: str, changes):
+    def _park(self, doc_id: str, changes, protect=()):
         q = self._quarantine.get(doc_id)
         if q is None:
             q = self._quarantine[doc_id] = QuarantineQueue(
@@ -163,6 +176,12 @@ class ShardedDocSet:
         total = sum(len(q) for q in self._quarantine.values())
         if total > self.stats["peak_parked"]:
             self.stats["peak_parked"] = total
+        if self.residency is not None:
+            # admission-aware prefetch: a park means this doc's missing
+            # dependencies are in flight — a demoted doc starts staging
+            # back before the release needs it (without evicting docs
+            # the caller routed but has not yet ingested)
+            self.residency.hint_park(doc_id, changes, protect=protect)
 
     def deliver(self, doc_id: str, changes) -> int:
         """Single-doc convenience wrapper over :meth:`deliver_round`."""
@@ -177,6 +196,11 @@ class ShardedDocSet:
         of the round is a commit boundary: the attached rebalancer (if
         any) runs its policy here."""
         _t0 = obs.now() if obs.ENABLED else 0
+        if self.residency is not None:
+            # the demand-paging gate: stored docs this round touches
+            # page in and the eviction pass makes room BEFORE any lane
+            # ingest can roll the footprint gauge past the budget
+            self.residency.before_round(deliveries)
         per_lane: dict = {}
         for doc_id, changes in deliveries.items():
             changes = list(changes)
@@ -189,12 +213,25 @@ class ShardedDocSet:
                     lineage.hop_delivery(changes, "quar/pen",
                                          site="router", doc=doc_id)
                 continue
+            if self.residency is not None \
+                    and doc_id in self.residency.store:
+                # the doc's live state IS its stored bundle (before_round
+                # judged nothing ready against the stored frontier):
+                # routing here would ensure_doc a FRESH empty doc and
+                # replay history over it — park everything instead; the
+                # park hint prefetches, and the drain releases against
+                # the live clock once the doc is resident again
+                self._park(doc_id, changes, protect=tuple(deliveries))
+                continue
             lane = self.lane_of(doc_id)
             doc = lane.docs.get(doc_id)
             ready, premature = self._split_ready(
                 changes, doc.clock if doc is not None else {})
             if premature:
-                self._park(doc_id, premature)
+                self._park(doc_id, premature, protect=tuple(deliveries))
+                # a park prefetch hint may have paged the doc in with
+                # budget-aware placement — re-resolve the owner
+                lane = self.lane_of(doc_id)
             if ready:
                 per_lane.setdefault(lane.index, {})[doc_id] = ready
         admitted = 0
@@ -208,6 +245,8 @@ class ShardedDocSet:
         if obs.ENABLED:
             obs.span("shard", "round", _t0, args={
                 "docs": len(deliveries), "admitted_ops": admitted})
+        if self.residency is not None:
+            self.residency.after_round(deliveries)
         if self.rebalancer is not None:
             self.rebalancer.maybe_rebalance()
         return admitted
@@ -221,18 +260,36 @@ class ShardedDocSet:
         while progress:
             progress = False
             per_lane: dict = {}
+            routed: list = []   # released docs awaiting ingest — a
+            #                     later page-in must not evict them
             for doc_id, q in list(self._quarantine.items()):
                 if not len(q) or doc_id in self._migrating:
                     continue
-                lane = self.lane_of(doc_id)
-                doc = lane.docs.get(doc_id)
+                stored = (self.residency is not None
+                          and doc_id in self.residency.store)
+                if stored:
+                    # judge readiness against the STORED frontier (the
+                    # bundle manifest's clock) — only a releasable
+                    # change justifies paging the doc in; an all-
+                    # premature quarantine leaves it demoted
+                    clock = self.residency.stored_clock(doc_id) or {}
+                else:
+                    doc = self.lane_of(doc_id).docs.get(doc_id)
+                    clock = doc.clock if doc is not None else {}
                 parked = q.drain()
-                ready, premature = self._split_ready(
-                    parked, doc.clock if doc is not None else {})
+                ready, premature = self._split_ready(parked, clock)
                 for ch in premature:
                     q.park(ch, requeue=True)
                 if ready:
+                    if stored:
+                        # admission hint: the release is about to
+                        # ingest — page in now (and resolve the lane
+                        # AFTER, page-in placement is budget-aware)
+                        self.residency.hint_release(
+                            doc_id, protect=tuple(routed) + (doc_id,))
+                    lane = self.lane_of(doc_id)
                     per_lane.setdefault(lane.index, {})[doc_id] = ready
+                    routed.append(doc_id)
                     self.stats["released"] += len(ready)
                     if lineage.ENABLED:
                         lineage.hop_delivery(ready, "quar/release",
@@ -258,6 +315,13 @@ class ShardedDocSet:
         from .rebalance import Rebalancer
         self.rebalancer = Rebalancer(self, **kwargs)
         return self.rebalancer
+
+    def attach_residency(self, **kwargs):
+        """Install the device-residency tier (INTERNALS §22): demand
+        paging, budget-driven eviction to host bundles, disk aging."""
+        from ..residency import ResidencyManager
+        self.residency = ResidencyManager(self, **kwargs)
+        return self.residency
 
     def migrate(self, doc_id: str, dst_shard: int,
                 _mid_migration=None) -> bool:
@@ -331,6 +395,12 @@ class ShardedDocSet:
         deterministic for a given state — the shard-count-invariance
         soak compares exactly these bytes across mesh sizes)."""
         from ..checkpoint import capture_engine
+        if self.residency is not None:
+            # a demoted doc's checkpoint IS its stored bundle — it was
+            # produced by this same capture at demotion, byte-identical
+            bundle = self.residency.stored_bundle(doc_id)
+            if bundle is not None:
+                return bundle
         lane = self.lane_of(doc_id)
         with lane.device_ctx():
             return capture_engine(lane.docs[doc_id])

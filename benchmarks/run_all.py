@@ -825,6 +825,51 @@ def config17_fused(quick: bool = False, record_session: bool = False):
          threshold=rec["threshold"])
 
 
+def config18_residency(quick: bool = False, record_session: bool = False):
+    """Bounded-HBM residency row (ISSUE 18, INTERNALS §22): a doc
+    population 10x+ the device byte budget served through the paging
+    mesh — demand page-ins through the disk tier every round, rotating
+    hot set for the steady-state hit rate, peak footprint gauge <= the
+    budget, zero overruns, and byte-identical captures vs an unbounded
+    reference all asserted in-run before the record is emitted.
+    Subprocess for a clean registry/jax state; ``--session`` appends
+    the row to BENCH_SESSIONS.jsonl."""
+    import os
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "AMTPU_SKIP_PREFLIGHT": "1"}
+    cmd = [sys.executable, os.path.join(root, "bench.py"), "--residency"]
+    if quick:
+        cmd.append("--quick")
+    if record_session:
+        cmd.append("--session")
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=root,
+                         env=env, timeout=1200)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"cfg18 residency bench failed rc={out.returncode}: "
+            f"{out.stderr[-800:]}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    emit("cfg18_residency_ops_per_sec", rec["value"], "ops/s",
+         budget_bytes=rec["budget_bytes"],
+         peak_footprint_bytes=rec["peak_footprint_bytes"],
+         population_over_budget=rec["population_over_budget"],
+         touched_docs=rec["touched_docs"],
+         hit_rate=rec["hit_rate"],
+         page_in_p99_ms=rec["page_in_p99_ms"],
+         page_ins=rec["page_ins"],
+         page_outs=rec["page_outs"],
+         cold_ages=rec["cold_ages"],
+         cold_loads=rec["cold_loads"],
+         budget_overruns=rec["budget_overruns"],
+         restore_h2d_bytes=rec["restore_h2d_bytes"],
+         tier_counts=rec["tier_counts"],
+         captures_byte_identical=rec["captures_byte_identical"],
+         measured_platform=rec["platform"],
+         threshold=rec["threshold"])
+
+
 def config5b_residual_heavy(n_actors: int = 10_000, quick: bool = False):
     """Adversarial headline shape: 20% of ops are RESIDUALS (bare deletes
     of distinct base elements + bare inserts without values) that cannot
@@ -1575,6 +1620,10 @@ def main():
         # the chip_session.sh cfg17 step: ONLY the fused-round A/B row
         config17_fused(quick=quick, record_session=True)
         return
+    if "--residency-session" in sys.argv:
+        # the chip_session.sh cfg18 step: ONLY the bounded-HBM row
+        config18_residency(quick=quick, record_session=True)
+        return
     record_round = None
     record_path = None
     if "--record" in sys.argv:
@@ -1663,6 +1712,7 @@ def main():
         lambda: config14_lineage(quick=quick),
         lambda: config15_device_truth(quick=quick),
         lambda: config17_fused(quick=quick),
+        lambda: config18_residency(quick=quick),
     ]
     if record_path is not None:
         steps.insert(0, fold_headline)

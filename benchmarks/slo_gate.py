@@ -81,6 +81,13 @@ SLOS = [
     # default; the XLA comparator leg is recorded alongside but carries
     # no bar of its own)
     ("cfg17_fused_rounds", "value", "min", 0.8),
+    # ISSUE 18: residency rows — paged-serving throughput floor plus a
+    # relative ceiling on the page-in p99 dwell (a restore-path or
+    # staging regression that slows demand paging pages here even while
+    # admitted throughput still holds; the budget bound itself is the
+    # absolute rule below, never a relative one)
+    ("cfg18_residency", "value", "min", 0.8),
+    ("cfg18_residency", "page_in_p99_ms", "max", 1.5),
 ]
 
 #: Absolute SLOs: (metric_prefix, dotted field, op, bound) checked on
@@ -141,6 +148,14 @@ ABS_SLOS = [
     ("cfg17_fused_rounds", "dispatch_per_round", "<=", 12.0),
     ("cfg17_fused_rounds", "roofline_ratio_vs_xla", "<=", 1.25),
     ("cfg17_fused_rounds", "recompiles_at_steady_state", "<=", 0),
+    # the ISSUE-18 acceptance bar on every committed cfg18 row, forever:
+    # the doc-kind peak footprint gauge never exceeds the device byte
+    # budget — an ABSOLUTE bound, because "bounded HBM" is the tier's
+    # whole contract (also asserted in-run at every rep boundary and
+    # after the paged convergence reads); plus zero budget overruns from
+    # the manager's own ledger
+    ("cfg18_residency", "peak_over_budget", "<=", 1.0),
+    ("cfg18_residency", "budget_overruns", "<=", 0),
 ]
 
 #: Derived fields computable from any row that carries the inputs.
@@ -154,6 +169,13 @@ DERIVED = {
     "collective_ops_total": lambda row: (
         sum(sum(v.values()) for v in row["collective_audit"].values())
         if isinstance(row.get("collective_audit"), dict) else None),
+    # peak device footprint as a fraction of the cfg18 byte budget: the
+    # gate recomputes the ratio from the row's own inputs so a stale or
+    # hand-edited ratio field can never mask a breach
+    "peak_over_budget": lambda row: (
+        row["peak_footprint_bytes"] / row["budget_bytes"]
+        if row.get("budget_bytes") and "peak_footprint_bytes" in row
+        else None),
 }
 
 

@@ -167,6 +167,15 @@ run "cfg17_fused" 1200 env \
   AMTPU_PEAK_FLOPS="${AMTPU_PEAK_FLOPS:-2e14}" \
   AMTPU_PEAK_BYTES_PER_S="${AMTPU_PEAK_BYTES_PER_S:-8e11}" \
   python -m benchmarks.run_all --fused-session
+# bounded-HBM residency (ISSUE 18): the cfg18 row on the chip — a doc
+# population 10x+ the device byte budget served through the paging mesh
+# (demand page-ins through the disk spill tier every round, learned
+# working-set eviction); the FIRST run where page-in dwell is real h2d
+# staging latency and the peak footprint gauge is real HBM, not the cpu
+# sanity band. Peak <= budget at every rep boundary, zero overruns, and
+# byte-identical captures vs the unbounded reference all asserted
+# inside the measurement; appended to BENCH_SESSIONS.jsonl
+run "cfg18_residency" 1200 python -m benchmarks.run_all --residency-session
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu

@@ -526,7 +526,8 @@ def session_checkpoint(seed: int) -> None:
 #: JSON — the one-line artifact contract lives in emit_summary alone,
 #: so a new profile cannot regress it by copy-pasting emission logic).
 #: Non-empty entries fold into the summary as "<profile>_metrics".
-PROFILE_METRICS: dict = {"service": {}, "sharded": {}, "federation": {}}
+PROFILE_METRICS: dict = {"service": {}, "sharded": {}, "federation": {},
+                         "residency": {}}
 
 #: back-compat alias: the service profile's registry entry
 LAST_SERVICE_METRICS = PROFILE_METRICS["service"]
@@ -1107,6 +1108,151 @@ def session_sharded(seed: int, n_docs: int = 8, n_actors: int = 2,
         released=results[multi][2]["released"])
 
 
+def session_residency(seed: int, n_docs: int = 40, n_seqs: int = 4,
+                      budget_docs: int = 4) -> None:
+    """Bounded-HBM serving (ISSUE 18 acceptance run: ``--residency``):
+    a doc population >= 10x the device byte budget served through the
+    residency tier. Two legs, same seeded stream — interleaved per-doc
+    touches with occasional one-seq-early arrivals (premature parks
+    exercise the admission-aware prefetch) and ~10% dup redeliveries:
+
+    1. a REFERENCE mesh with no residency manager (everything stays
+       device-resident) establishes the expected captures/texts and the
+       measured per-doc footprint the budget derives from;
+    2. a budgeted mesh with a disk spill dir serves the identical
+       stream; after EVERY round the doc-kind peak footprint gauge must
+       be <= the budget (the reservation discipline's absolute bar).
+
+    Convergence is compared doc-at-a-time — the reads themselves demand
+    page under the same budget — and the final accounting must name
+    every doc in exactly one tier with nothing lost."""
+    import tempfile
+
+    from automerge_tpu.obs import device_truth as dtruth
+    from automerge_tpu.shard import ShardedDocSet
+
+    rng = np.random.default_rng(seed * 6133 + 11)
+    docs = [f"rdoc-{seed}-{i}" for i in range(n_docs)]
+    streams = {}
+    for di, doc in enumerate(docs):
+        actor, run_len = f"r{di}", 3
+        chs = []
+        for s in range(1, n_seqs + 1):
+            base = (s - 1) * run_len + 1
+            key = "_head" if s == 1 else f"{actor}:{base - 1}"
+            ops = []
+            for k in range(run_len):
+                ctr = base + k
+                ops.append({"action": "ins", "obj": doc, "key": key,
+                            "elem": ctr})
+                ops.append({"action": "set", "obj": doc,
+                            "key": f"{actor}:{ctr}",
+                            "value": chr(97 + (ctr + di) % 26)})
+                key = f"{actor}:{ctr}"
+            chs.append({"actor": actor, "seq": s, "deps": {}, "ops": ops})
+        streams[doc] = chs
+    # the round schedule: two docs per round (the budget must hold one
+    # round's working set — that is the invariant's own precondition),
+    # each touch advancing its doc one seq; ~20% of touches send the
+    # NEXT seq one touch early (premature -> router park -> prefetch
+    # hint), the held-back seq follows on the doc's next touch
+    pos = {d: 0 for d in docs}
+    skipped: dict = {}
+    rounds = []
+    while True:
+        pool = [d for d in docs if pos[d] < n_seqs or d in skipped]
+        if not pool:
+            break
+        chunk = {}
+        for i in rng.choice(len(pool), size=min(2, len(pool)),
+                            replace=False):
+            d = pool[int(i)]
+            if d in skipped:
+                out = [streams[d][skipped.pop(d)]]
+            elif pos[d] + 1 < n_seqs and rng.random() < 0.2:
+                skipped[d] = pos[d]
+                out = [streams[d][pos[d] + 1]]
+                pos[d] += 2
+            else:
+                out = [streams[d][pos[d]]]
+                pos[d] += 1
+            if rng.random() < 0.1:
+                out = out + [out[0]]            # dup redelivery
+            chunk[d] = out
+        rounds.append(chunk)
+
+    # leg 1: the unbounded reference (no residency manager attached)
+    ref = ShardedDocSet(n_shards=2, capacity=64)
+    for chunk in rounds:
+        ref.deliver_round(chunk)
+    ref_caps = {d: ref.capture(d) for d in docs}
+    ref_texts = ref.texts()
+    per_doc = max(doc.device_footprint()["device_bytes"]
+                  for lane in ref.lanes for doc in lane.docs.values())
+    budget = budget_docs * per_doc
+    assert n_docs * per_doc >= 10 * budget, \
+        f"residency seed {seed}: population only " \
+        f"{n_docs * per_doc / budget:.1f}x the budget"
+
+    # leg 2: the budgeted mesh — fresh gauge session, disk spill tier
+    dtruth.REGISTRY.clear_session()
+    with tempfile.TemporaryDirectory() as spill:
+        mesh = ShardedDocSet(n_shards=2, capacity=64)
+        res = mesh.attach_residency(budget_bytes=budget, spill_dir=spill,
+                                    cold_after=4)
+        for n, chunk in enumerate(rounds):
+            mesh.deliver_round(chunk)
+            peak = dtruth.REGISTRY.footprint()["peak_device_bytes"]
+            assert peak <= budget, \
+                f"residency seed {seed}: round {n} peak {peak} > " \
+                f"budget {budget}"
+        for d in docs:
+            assert mesh.quarantined(d) == 0, \
+                f"residency seed {seed}: quarantine not drained for {d}"
+        acct = res.accounting()
+        population = sorted(acct["hot"] + acct["warm"] + acct["cold"])
+        assert population == sorted(docs), \
+            f"residency seed {seed}: tier accounting lost docs"
+        m = res.metrics()
+        assert m["budget_overruns"] == 0, \
+            f"residency seed {seed}: {m['budget_overruns']} budget " \
+            f"overruns (working set exceeded the budget)"
+        assert m["page_outs"] > 0 and m["page_ins"] > 0
+        assert m["prefetches"] > 0, \
+            f"residency seed {seed}: premature arrivals never " \
+            f"prefetched a demoted doc ({m})"
+        assert m["cold_ages"] > 0, \
+            f"residency seed {seed}: the disk tier never engaged ({m})"
+        # doc-at-a-time convergence: the reads page under the budget
+        texts = {}
+        for d in docs:
+            assert mesh.capture(d) == ref_caps[d], \
+                f"residency seed {seed}: capture of {d} diverged " \
+                f"after paging churn"
+            res.ensure_resident(d)
+            lane = mesh.lane_of(d)
+            with lane.device_ctx():
+                texts[d] = lane.docs[d].text()
+        assert texts == ref_texts, \
+            f"residency seed {seed}: texts diverged after paging churn"
+        peak = dtruth.REGISTRY.footprint()["peak_device_bytes"]
+        assert peak <= budget, \
+            f"residency seed {seed}: paged reads breached the budget " \
+            f"({peak} > {budget})"
+        final = res.metrics()
+    PROFILE_METRICS["residency"].clear()
+    PROFILE_METRICS["residency"].update(
+        n_docs=n_docs, budget_bytes=budget, per_doc_bytes=per_doc,
+        population_over_budget=round(n_docs * per_doc / budget, 1),
+        peak_resident_bytes=final["peak_resident_bytes"],
+        gauge_peak_bytes=peak, hit_rate=final["hit_rate"],
+        page_in_p99_ms=final["page_in_p99_ms"],
+        page_ins=final["page_ins"], page_outs=final["page_outs"],
+        prefetches=final["prefetches"], cold_ages=final["cold_ages"],
+        cold_loads=final["cold_loads"],
+        budget_overruns=final["budget_overruns"])
+
+
 def session_federation(seed: int, n_rooms: int = 6,
                        n_sessions: int = 1000, n_ticks: int = 80,
                        quiesce_rounds: int = 6000) -> None:
@@ -1379,6 +1525,7 @@ PROFILES = {"general": session_general, "conflict": session_conflict,
             "lossy": session_lossy, "table": session_table,
             "chaos": session_chaos, "checkpoint": session_checkpoint,
             "service": session_service, "sharded": session_sharded,
+            "residency": session_residency,
             "federation": session_federation}
 
 
@@ -1406,6 +1553,9 @@ def run(profile: str, sessions: int, seed_base: int,
         # kill/rejoin), an order of magnitude fewer write sessions
         profiles["federation"] = lambda seed: session_federation(
             seed, n_rooms=3, n_sessions=150, n_ticks=40)
+        # same tier ladder + 10x-over-budget ratio, half the population
+        profiles["residency"] = lambda seed: session_residency(
+            seed, n_docs=20, n_seqs=3, budget_docs=2)
     # the soak ALWAYS records (counters are exact across ring
     # wraparound, so the summary is right even for long campaigns); the
     # --trace flag only controls whether the ring is also exported
@@ -1505,6 +1655,15 @@ def main():
                          "with a telemetry-triggered hot-doc migration "
                          "mid-stream on the mesh; --sessions defaults "
                          "to 8 seeds)")
+    ap.add_argument("--residency", action="store_true",
+                    help="shorthand for --profile residency (bounded-HBM "
+                         "serving: a doc population >= 10x the device "
+                         "budget pages through the residency tier; the "
+                         "peak footprint gauge must never exceed the "
+                         "budget and every doc must converge "
+                         "byte-identically with a no-residency "
+                         "reference mesh; --sessions defaults to 4 "
+                         "seeds, --quick halves the population)")
     ap.add_argument("--clients", type=int, default=None,
                     help="service profile: concurrent client sessions "
                          "(default 1000 with --service)")
@@ -1525,7 +1684,8 @@ def main():
                else "checkpoint" if args.checkpoint
                else "service" if args.service
                else "federation" if args.federation
-               else "sharded" if args.sharded else args.profile)
+               else "sharded" if args.sharded
+               else "residency" if args.residency else args.profile)
     clients = args.clients
     if args.service and clients is None:
         clients = 100 if args.quick else 1000
@@ -1535,7 +1695,8 @@ def main():
         # campaign); 8 for the sharded profile (each seed runs the full
         # stream at EVERY shard count); 30 everywhere else
         sessions = (1 if profile in ("service", "federation")
-                    else 8 if profile == "sharded" else 30)
+                    else 8 if profile == "sharded"
+                    else 4 if profile == "residency" else 30)
     return run(profile, sessions, args.seed_base, trace=args.trace,
                clients=clients, scrape=args.scrape, quick=args.quick)
 

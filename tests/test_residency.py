@@ -1,0 +1,504 @@
+"""Device-residency tiering (ISSUE 18, INTERNALS §22).
+
+The tier ladder (hot device-resident / warm host bundle / cold spill
+file), demand paging on sync traffic, admission-aware prefetch, the
+learned working-set eviction model, the budget invariant against the
+device-truth peak gauge, exact h2d metering on the restore staging
+path, and the ``res/*`` lineage hops with paired page-in dwell.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from automerge_tpu.obs import device_truth as dt
+from automerge_tpu.obs import lineage
+from automerge_tpu.residency import (BundleStore, LruModel, ResidencyConfig,
+                                     WorkingSetModel, make_model)
+from automerge_tpu.shard import ShardedDocSet
+
+
+@pytest.fixture(autouse=True)
+def _small_gate(monkeypatch):
+    monkeypatch.setenv("AMTPU_STACKED_MIN_OPS", "1")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_gauges():
+    """Each test starts from a clean footprint session (peak included)."""
+    dt.REGISTRY.clear_session()
+    yield
+    dt.REGISTRY.clear_session()
+
+
+def text_change(actor, seq, text, start_ctr=1, after=None, deps=None,
+                obj="t"):
+    ops = []
+    key = after if after is not None else "_head"
+    for i, c in enumerate(text):
+        ctr = start_ctr + i
+        ops.append({"action": "ins", "obj": obj, "key": key, "elem": ctr})
+        ops.append({"action": "set", "obj": obj, "key": f"{actor}:{ctr}",
+                    "value": c})
+        key = f"{actor}:{ctr}"
+    return {"actor": actor, "seq": seq, "deps": deps or {}, "ops": ops}
+
+
+def doc_stream(doc_id, n_seqs, piece="x"):
+    """One doc's causally-chained change sequence."""
+    actor = f"a-{doc_id}"
+    out = []
+    for s in range(1, n_seqs + 1):
+        ctr0 = (s - 1) * len(piece) + 1
+        out.append(text_change(
+            actor, s, piece, start_ctr=ctr0, obj=doc_id,
+            after=(None if s == 1 else f"{actor}:{ctr0 - 1}")))
+    return out
+
+
+def build_mesh(n_shards=2, budget=0, spill_dir=None, **res_kw):
+    mesh = ShardedDocSet(n_shards=n_shards, capacity=256)
+    res = mesh.attach_residency(budget_bytes=budget, spill_dir=spill_dir,
+                                **res_kw)
+    return mesh, res
+
+
+def prime(mesh, res):
+    """Teach the manager the per-doc footprint (one doc, one round) so
+    reservations are informed from the first fan-out round, then demote
+    the primer so it does not occupy the budget."""
+    mesh.deliver_round({"__prime__": [text_change(
+        "pa", 1, "x", obj="__prime__")]})
+    if res.tier_of("__prime__") == "hot":   # auto-eviction may beat us
+        assert res.demote("__prime__")
+    res.store.pop("__prime__")          # drop the primer entirely
+    res.model.forget("__prime__")
+
+
+# ---------------------------------------------------------------------------
+# the bundle store (warm / cold tiers)
+# ---------------------------------------------------------------------------
+
+
+class TestBundleStore:
+    def test_put_peek_pop_warm(self):
+        st = BundleStore()
+        st.put("d", b"bundle-bytes")
+        assert "d" in st and st.tier("d") == "warm"
+        assert st.peek("d") == b"bundle-bytes"
+        assert st.tier("d") == "warm"           # peek never re-tiers
+        assert st.pop("d") == b"bundle-bytes"
+        assert "d" not in st and st.pop("d") is None
+
+    def test_age_to_disk_and_cold_pop(self, tmp_path):
+        st = BundleStore(str(tmp_path))
+        st.put("d", b"payload")
+        assert st.age("d") is True
+        assert st.tier("d") == "cold" and st.warm_bytes == 0
+        files = list(tmp_path.glob("*.amtpuckpt"))
+        assert len(files) == 1 and files[0].read_bytes() == b"payload"
+        assert st.peek("d") == b"payload"       # read without promotion
+        assert st.tier("d") == "cold"
+        assert st.pop("d") == b"payload"        # page-in consumes the file
+        assert not list(tmp_path.glob("*.amtpuckpt"))
+        assert st.stats["loads"] == 1
+
+    def test_age_without_spill_dir_is_noop(self):
+        st = BundleStore()
+        st.put("d", b"x")
+        assert st.age("d") is False and st.tier("d") == "warm"
+
+    def test_redemote_overwrites_and_drops_cold(self, tmp_path):
+        st = BundleStore(str(tmp_path))
+        st.put("d", b"v1")
+        st.age("d")
+        st.put("d", b"v2")                      # newest bundle is truth
+        assert st.tier("d") == "warm" and st.peek("d") == b"v2"
+
+    def test_accounting_is_exact(self, tmp_path):
+        st = BundleStore(str(tmp_path))
+        st.put("a", b"aa")
+        st.put("b", b"bbbb")
+        st.age("a")
+        t = st.tiers()
+        assert t == {"warm": ["b"], "cold": ["a"],
+                     "warm_bytes": 4, "cold_bytes": 2}
+
+
+# ---------------------------------------------------------------------------
+# eviction policy: the learned working-set model vs plain LRU
+# ---------------------------------------------------------------------------
+
+
+class TestPolicy:
+    def test_config_rejects_unknown_policy(self):
+        with pytest.raises(ValueError):
+            ResidencyConfig(eviction="clairvoyant")
+
+    def test_make_model(self):
+        assert isinstance(make_model("learned"), WorkingSetModel)
+        assert isinstance(make_model("lru"), LruModel)
+
+    def test_learned_inverts_lru_for_mixed_rhythms(self):
+        """The scenario plain LRU gets wrong: doc A ran hot for a few
+        rounds then died; doc B beats steadily every 5 rounds. At the
+        decision point A is *fresher* in LRU terms yet further past its
+        own rhythm — the learned model evicts A, LRU evicts B."""
+        learned, lru = WorkingSetModel(), LruModel()
+        for m in (learned, lru):
+            for r in (8, 9, 10, 11):            # A: burst then silence
+                m.note_touch("A", r)
+            for r in (0, 5, 10):                # B: 5-round heartbeat
+                m.note_touch("B", r)
+        now = 14
+        assert lru.score("B", now) > lru.score("A", now)
+        assert learned.score("A", now) > learned.score("B", now)
+
+    def test_cold_start_uses_population_prior(self):
+        m = WorkingSetModel()
+        for r in range(0, 40, 4):               # population rhythm: 4
+            m.note_touch("veteran", r)
+        # a brand-new doc inherits a sane predicted gap from the fit
+        # instead of the evict-me-first gap of 1
+        m.note_touch("rookie", 36)
+        assert m.predicted_gap("rookie") > 1.0
+
+    def test_forget_drops_per_doc_state(self):
+        m = WorkingSetModel()
+        m.note_touch("d", 1)
+        m.note_touch("d", 3)
+        m.forget("d")
+        assert m.describe()["tracked_docs"] == 0
+
+
+# ---------------------------------------------------------------------------
+# eviction under pressure: the budget invariant
+# ---------------------------------------------------------------------------
+
+
+class TestEvictionUnderPressure:
+    def test_population_10x_budget_peak_gauge_bounded(self, tmp_path):
+        """ISSUE 18 acceptance: population >= 10x the device budget;
+        the doc-kind peak footprint gauge NEVER exceeds the budget;
+        nothing is lost — every doc accounted for in exactly one tier
+        and every doc's content intact after paged reads."""
+        mesh, res = build_mesh(n_shards=2, spill_dir=str(tmp_path),
+                               budget=0, cold_after=3)
+        prime(mesh, res)
+        per_doc = res._est_bytes
+        assert per_doc > 0
+        budget = 3 * per_doc                    # 3 docs' worth of HBM
+        res.config.budget_bytes = budget
+        n_docs = 30                             # 10x the budget
+        seqs = {i: 0 for i in range(n_docs)}
+        rng = random.Random(18)
+        for rnd in range(40):
+            touched = rng.sample(range(n_docs), 2)
+            deliveries = {}
+            for i in touched:
+                seqs[i] += 1
+                a = f"a-doc{i}"
+                deliveries[f"doc{i}"] = [text_change(
+                    a, seqs[i], "x", start_ctr=seqs[i], obj=f"doc{i}",
+                    after=(None if seqs[i] == 1 else f"{a}:{seqs[i]-1}"))]
+            mesh.deliver_round(deliveries)
+            fp = dt.REGISTRY.footprint()
+            assert fp["peak_device_bytes"] <= budget, (
+                f"round {rnd}: peak {fp['peak_device_bytes']} > "
+                f"budget {budget}")
+        m = res.metrics()
+        assert m["budget_overruns"] == 0
+        assert m["page_outs"] > 0 and m["page_ins"] > 0
+        assert m["cold_ages"] > 0               # the disk tier engaged
+        # full accounting: every delivered doc in exactly one tier
+        acct = res.accounting()
+        population = sorted(acct["hot"] + acct["warm"] + acct["cold"])
+        assert population == sorted(
+            f"doc{i}" for i in range(n_docs) if seqs[i])
+        # nothing lost: paged reads reproduce every doc's text
+        for i in range(n_docs):
+            if not seqs[i]:
+                continue
+            res.ensure_resident(f"doc{i}")
+            lane = mesh.lane_of(f"doc{i}")
+            with lane.device_ctx():
+                assert lane.docs[f"doc{i}"].text() == "x" * seqs[i]
+        fp = dt.REGISTRY.footprint()
+        assert fp["peak_device_bytes"] <= budget, "paged reads breached"
+
+    def test_unbounded_budget_meters_but_never_evicts(self):
+        mesh, res = build_mesh(budget=0)
+        for i in range(6):
+            mesh.deliver_round({f"doc{i}": doc_stream(f"doc{i}", 1)})
+        assert res.metrics()["evictions"] == 0
+        assert len(res.accounting()["hot"]) == 6
+        assert res.resident_bytes() > 0
+
+    def test_protected_working_set_over_budget_counts_overrun(self):
+        mesh, res = build_mesh(budget=1)     # nothing fits
+        mesh.deliver_round({"d0": doc_stream("d0", 1)})
+        mesh.deliver_round({"d0": [doc_stream("d0", 2)[1]]})
+        assert res.metrics()["budget_overruns"] > 0
+
+
+# ---------------------------------------------------------------------------
+# demote -> promote round-trip under a chaotic concurrent stream
+# ---------------------------------------------------------------------------
+
+
+class TestRoundTrip:
+    def test_chaotic_stream_with_churn_restores_saves_and_footprint(self):
+        """Demote→promote churn riding a shuffled/duplicated concurrent
+        stream: every doc's capture stays byte-identical to a reference
+        mesh that never demoted, and device_footprint() is identical
+        across demote→promote cycles (restore is shape-canonical)."""
+        def run(churn):
+            mesh, res = build_mesh(n_shards=2, budget=0)
+            rng = random.Random(7)
+            streams = {f"doc{i}": doc_stream(f"doc{i}", 6, piece="ab")
+                       for i in range(4)}
+            pending = [(d, ch) for d, chs in streams.items()
+                       for ch in chs]
+            pending += rng.sample(pending, 5)          # dup delivery
+            rng.shuffle(pending)                       # arrival chaos
+            footprints = {}
+            for n, (doc_id, ch) in enumerate(pending):
+                mesh.deliver_round({doc_id: [ch]})
+                if churn and n % 3 == 2:
+                    victim = f"doc{rng.randrange(4)}"
+                    if res.demote(victim):
+                        res.ensure_resident(victim)
+                        lane = mesh.lane_of(victim)
+                        f1 = lane.docs[victim].device_footprint()
+                        assert res.demote(victim)
+                        res.ensure_resident(victim)
+                        f2 = mesh.lane_of(victim).docs[
+                            victim].device_footprint()
+                        assert f1 == f2, "footprint drifted across cycle"
+                        footprints[victim] = f2
+            assert not mesh._quarantine or all(
+                not len(q) for q in mesh._quarantine.values())
+            return ({d: mesh.capture(d) for d in streams},
+                    mesh.texts(), footprints)
+
+        ref_caps, ref_texts, _ = run(churn=False)
+        churn_caps, churn_texts, footprints = run(churn=True)
+        assert churn_texts == ref_texts
+        assert churn_caps == ref_caps, "churned captures diverged"
+        assert footprints, "churn never exercised a demote cycle"
+
+    def test_capture_of_demoted_doc_is_stored_bundle(self):
+        mesh, res = build_mesh(budget=0)
+        mesh.deliver_round({"d": doc_stream("d", 3)})
+        live = mesh.capture("d")
+        assert res.demote("d")
+        assert mesh.capture("d") == live
+        assert res.tier_of("d") == "warm"
+
+    def test_demote_refuses_queued_and_migrating_docs(self):
+        mesh, res = build_mesh(budget=0)
+        mesh.deliver_round({"d": doc_stream("d", 1)})
+        mesh._migrating["d"] = []
+        assert res.demote("d") is False
+        del mesh._migrating["d"]
+        assert res.demote("d") is True
+
+
+# ---------------------------------------------------------------------------
+# demand paging + admission-aware prefetch
+# ---------------------------------------------------------------------------
+
+
+class TestPaging:
+    def test_premature_change_prefetches_demoted_doc(self):
+        """A router park IS a paging hint: a premature change for a
+        demoted doc stages the doc back BEFORE the release needs it."""
+        mesh, res = build_mesh(budget=0)
+        chs = doc_stream("d", 3)
+        mesh.deliver_round({"d": [chs[0]]})
+        assert res.demote("d")
+        mesh.deliver_round({"d": [chs[2]]})     # premature: seq 3 needs 2
+        assert res.tier_of("d") == "hot"        # prefetched at park time
+        assert res.stats["prefetches"] == 1
+        assert mesh.quarantined("d") == 1
+        mesh.deliver_round({"d": [chs[1]]})     # unblocks the release
+        assert mesh.quarantined("d") == 0
+        lane = mesh.lane_of("d")
+        with lane.device_ctx():
+            assert lane.docs["d"].text() == "xxx"
+
+    def test_prefetch_off_defers_page_in_to_release(self):
+        mesh, res = build_mesh(budget=0, prefetch=False)
+        chs = doc_stream("d", 3)
+        mesh.deliver_round({"d": [chs[0]]})
+        assert res.demote("d")
+        mesh.deliver_round({"d": [chs[2]]})
+        assert res.tier_of("d") == "warm"       # no prefetch
+        mesh.deliver_round({"d": [chs[1]]})     # drain pages it in
+        assert res.tier_of("d") == "hot"
+        lane = mesh.lane_of("d")
+        with lane.device_ctx():
+            assert lane.docs["d"].text() == "xxx"
+
+    def test_page_in_places_on_lightest_lane(self):
+        """Budget-aware placement: a page-in lands on the lane with the
+        smallest device footprint, and ownership follows."""
+        mesh, res = build_mesh(n_shards=2, budget=0)
+        for i in range(6):
+            mesh.deliver_round({f"doc{i}": doc_stream(f"doc{i}", 1)})
+        target = "doc0"
+        assert res.demote(target)
+        # load the target's home lane so the other lane is lighter
+        home = mesh.placement.shard_of(target)
+        bytes_before = [lane.device_footprint()["device_bytes"]
+                        for lane in mesh.lanes]
+        lane = res.page_in(target)
+        assert lane is not None
+        expect = min(range(2), key=lambda i: (bytes_before[i], i))
+        assert lane.index == expect
+        assert mesh.placement.shard_of(target) == expect
+        if expect != home:
+            assert res.stats["placement_moves"] >= 1
+
+    def test_mesh_texts_after_heavy_churn_converge(self):
+        mesh, res = build_mesh(n_shards=2, budget=0, cold_after=1)
+        seqs = {}
+        for rnd in range(10):
+            doc = f"doc{rnd % 3}"
+            seqs[doc] = seqs.get(doc, 0) + 1
+            a = f"a-{doc}"
+            mesh.deliver_round({doc: [text_change(
+                a, seqs[doc], "y", start_ctr=seqs[doc], obj=doc,
+                after=(None if seqs[doc] == 1 else f"{a}:{seqs[doc]-1}"))]})
+            for d in list(seqs):
+                if d != doc:
+                    res.demote(d)
+            res.tick()
+        for d in seqs:
+            res.ensure_resident(d)
+        assert mesh.texts() == {d: "y" * n for d, n in seqs.items()}
+
+
+# ---------------------------------------------------------------------------
+# observability: h2d metering, lineage hops, prom families
+# ---------------------------------------------------------------------------
+
+
+class TestObservability:
+    def test_restore_staging_meters_exact_h2d_bytes(self):
+        """The restore/adopt path counts EXACT staged bytes through
+        record_h2d: the delta equals the padded-table nbytes the doc
+        actually staged (recomputed from the restored doc), never an
+        estimate."""
+        from automerge_tpu.engine import accounting
+        mesh, res = build_mesh(budget=0)
+        mesh.deliver_round({"d": doc_stream("d", 4)})
+        assert res.demote("d")
+        before = accounting.snapshot()["h2d_bytes"]
+        res.ensure_resident("d")
+        staged = accounting.snapshot()["h2d_bytes"] - before
+        doc = mesh.lane_of("d").docs["d"]
+        table_bytes = sum(v.nbytes for v in doc._dev.values())
+        assert staged >= table_bytes > 0
+        # exactness: a second identical round-trip stages the same
+        assert res.demote("d")
+        before = accounting.snapshot()["h2d_bytes"]
+        res.ensure_resident("d")
+        assert accounting.snapshot()["h2d_bytes"] - before == staged
+
+    def test_page_in_lineage_hops_and_paired_dwell(self):
+        lineage.enable(rate=1)
+        try:
+            mesh, res = build_mesh(budget=0)
+            chs = doc_stream("d", 2)
+            mesh.deliver_round({"d": [chs[0]]})
+            assert res.demote("d")
+            mesh.deliver_round({"d": [chs[1]]})     # ready: demand page-in
+            led = lineage.ledger()
+            chain = led.chain("a-d", 2)
+            assert chain is not None
+            stages = [h[0] for h in chain["hops"]]
+            wait_i = stages.index("res/page_wait")
+            in_i = stages.index("res/page_in")
+            assert wait_i < in_i
+            # same site (the adopting lane), and the dwell pairing is
+            # registered so families export a page-in dwell histogram
+            assert chain["hops"][wait_i][1] == chain["hops"][in_i][1]
+            assert lineage.LineageLedger.PAIRED_DWELL[
+                "res/page_in"] == "res/page_wait"
+            agg = led.telemetry.span_aggregates()
+            assert agg[("lineage", "dwell:res/page_wait")]["count"] >= 1
+        finally:
+            lineage.disable()
+            lineage.clear()
+
+    def test_prom_families_expose_clean(self):
+        from automerge_tpu.obs import prom
+        mesh, res = build_mesh(n_shards=2, budget=0, cold_after=1)
+        mesh.deliver_round({"d": doc_stream("d", 2)})
+        res.demote("d")
+        res.tick()
+        res.ensure_resident("d")
+        fams = res.families()
+        page = prom.expose(fams)                # validates exposition
+        for needle in ("amtpu_residency_docs", "amtpu_residency_bytes",
+                       "amtpu_residency_budget_bytes",
+                       "amtpu_residency_peak_resident_bytes",
+                       "amtpu_residency_hit_rate",
+                       "amtpu_residency_page_in_p99_ms",
+                       "amtpu_residency_events_total"):
+            assert needle in page, needle
+
+    def test_describe_rides_mesh_snapshot(self):
+        mesh, res = build_mesh(budget=0)
+        mesh.deliver_round({"d": doc_stream("d", 1)})
+        d = mesh.describe()["residency"]
+        assert d["schema"] == "amtpu-residency-v1"
+        assert d["tier_counts"]["hot"] == 1
+        assert d["model"]["kind"] == "learned"
+
+
+# ---------------------------------------------------------------------------
+# service integration: budget config + tick-loop paging hooks
+# ---------------------------------------------------------------------------
+
+
+class TestServiceIntegration:
+    def test_budget_zero_keeps_tier_off(self):
+        from automerge_tpu.service import ServiceConfig, SyncService
+        svc = SyncService(ServiceConfig())
+        assert svc.residency is None
+        with pytest.raises(RuntimeError):
+            svc.mesh_deliver({"d": []})
+
+    def test_mesh_deliver_drains_on_tick(self, tmp_path):
+        from automerge_tpu.service import ServiceConfig, SyncService
+        svc = SyncService(ServiceConfig(
+            residency_budget_bytes=10 * 1024 * 1024,
+            residency_cold_after=1,
+            residency_spill_dir=str(tmp_path)))
+        svc.mesh_deliver({"d": doc_stream("d", 2)})
+        assert svc.doc_mesh.doc("d") is None    # queued, not applied
+        svc.tick()
+        lane = svc.doc_mesh.lane_of("d")
+        with lane.device_ctx():
+            assert lane.docs["d"].text() == "xx"
+        # the pager heartbeat ages a demoted doc across idle ticks
+        svc.residency.demote("d")
+        svc.tick()
+        svc.tick()
+        assert svc.residency.tier_of("d") == "cold"
+        d = svc.describe()
+        assert d["residency"]["tier_counts"]["cold"] == 1
+        page = svc.scrape()
+        assert "amtpu_residency_docs" in page
+        assert "amtpu_residency_events_total" in page
+
+    def test_shard_lanes_are_shared_with_mesh(self, tmp_path):
+        from automerge_tpu.service import ServiceConfig, SyncService
+        svc = SyncService(ServiceConfig(
+            shard_lanes=2, residency_budget_bytes=10 * 1024 * 1024,
+            residency_spill_dir=str(tmp_path)))
+        assert svc.doc_mesh.lanes == svc._shard_lanes

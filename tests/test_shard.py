@@ -428,7 +428,11 @@ def test_commit_path_compiles_with_zero_collectives():
     assert set(audit) == {"stacked_map_round", "stacked_mixed_round",
                           "stacked_scatter_registers",
                           "fused_stacked_round",
-                          "fused_scatter_registers"}
+                          "fused_scatter_registers",
+                          # ISSUE 18: the ring-commit megakernels ride
+                          # the same audit (the PR-17 leftover)
+                          "merge_and_materialize_dense_planned",
+                          "merge_and_materialize_dense"}
     assert_zero_collectives(audit)
 
 
