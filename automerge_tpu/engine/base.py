@@ -32,6 +32,7 @@ import numpy as np
 from .._common import KIND_DEL, KIND_INC, KIND_SET
 from .. import obs
 from . import accounting
+from . import learned_index
 
 import threading
 
@@ -353,7 +354,25 @@ class CausalDeviceDoc:
             return None
         ts = cols.table_sorted
         rank = self._actor_rank
-        missing = [a for a in ts if a not in rank]
+        # learned actor-rank site: the membership scan over the batch
+        # table (which existing actors does it reference?) runs as ONE
+        # packed position-model probe instead of per-actor dict lookups;
+        # small batches keep the dict scan (model call overhead beats
+        # the win below ~8 keys), and an unpackable table falls through.
+        missing = None
+        if len(ts) >= 8 and learned_index.site_enabled("actor_rank"):
+            m = learned_index.doc_actor_model(self)
+            if m is not None:
+                got = learned_index.actor_positions(
+                    self.actor_table, np.asarray(ts, object),
+                    "actor_rank", model=m)
+                if got is not None:
+                    fnd = got[1]
+                    missing = ([] if fnd.all() else
+                               [a for a, f in zip(ts, fnd.tolist())
+                                if not f])
+        if missing is None:
+            missing = [a for a in ts if a not in rank]
         if not missing:
             return None
         table = self.actor_table

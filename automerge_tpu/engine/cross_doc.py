@@ -50,6 +50,7 @@ import os
 import numpy as np
 
 from .. import obs
+from . import learned_index
 from .base import _GroupedRound, columnar_plan_enabled
 from .runs import detect_runs
 from .wire_columns import change_columns
@@ -183,10 +184,76 @@ class CrossDocPlan:
         from ..ops.ingest import bucket
 
         _t0 = obs.now() if obs.ENABLED else 0
+        learned = learned_index.site_enabled("cross_doc_seed")
         for g in self.groups:
             _doc0, b0 = g.members[0]
             plan0 = g.run_plan[1] if g.run_plan is not None else None
             by_table = {}
+            if learned:
+                # learned seeding (engine/learned_index.py): (a) the
+                # per-shape join goes through the packed actor-rank
+                # model instead of the object-dtype searchsorted (one
+                # model evaluation per distinct table, counted on the
+                # "cross_doc_seed" site); (b) the O(table) content-tuple
+                # build is memoized per (doc, interning generation) —
+                # sound because every table mutation bumps the doc's
+                # generation — so a large table is tuplized once per
+                # intern epoch, not once per seeding pass; (c) member
+                # docs of one (gen, shape) SHARE one cache-entry dict
+                # with "gen" baked in (every rank-cache writer stores
+                # shape-level values only: batch_rank/head fields/
+                # desc_tmpl are pure functions of (op columns, interning
+                # shape), so a late fill-in writes identical content).
+                seeded = 0
+                for doc, b in g.members:
+                    table = doc.actor_table
+                    if not table:
+                        # every change of this doc's batch queued, so
+                        # the interning hoist never saw it — no seed
+                        continue
+                    gen = doc._intern_gen
+                    tk = getattr(doc, "_learned_tkey", None)
+                    if tk is None or tk[0] != gen:
+                        tk = (gen, tuple(table))
+                        doc._learned_tkey = tk
+                    ent = by_table.get(tk)
+                    if ent is None:
+                        got = learned_index.actor_positions(
+                            table, g.batch_table, "cross_doc_seed")
+                        if got is not None:
+                            pos, okv = got
+                            if not okv.all():
+                                continue
+                            batch_rank = pos.astype(np.int64)
+                        else:
+                            tbl = np.asarray(table, object)
+                            pos = np.searchsorted(tbl, g.batch_table)
+                            safe = np.clip(pos, 0, len(tbl) - 1)
+                            if not (tbl[safe] == g.batch_table).all():
+                                continue
+                            batch_rank = pos.astype(np.int64)
+                        ent = {"gen": gen,
+                               "batch_rank": batch_rank,
+                               "row_rank": batch_rank[g.row_table_idx]
+                               .astype(np.int32)}
+                        if plan0 is not None and plan0.n_runs:
+                            ent.update(run_head_fields(
+                                plan0, batch_rank, b0.op_target_actor,
+                                b0.op_target_ctr, b0.op_parent_actor,
+                                b0.op_parent_ctr))
+                            R = bucket(plan0.n_runs, 64)
+                            N = bucket(plan0.n_pairs, 256)
+                            tmpl = build_desc_template(
+                                plan0, b0.op_target_ctr, b0.op_change,
+                                ent["head_rank"], ent["row_rank"],
+                                np.asarray(b0.seqs, np.int32), R, N)
+                            tmpl.setflags(write=False)
+                            ent["desc_tmpl"] = tmpl
+                        by_table[tk] = ent
+                    g.cols.rank_cache[doc] = ent
+                    seeded += 1
+                self.stats["rank_seeded"] += seeded
+                continue
             for doc, b in g.members:
                 tkey = tuple(doc.actor_table)
                 ent = by_table.get(tkey)

@@ -45,11 +45,17 @@ import numpy as np
 
 from .._common import check_int32_envelope
 from .. import obs
+from . import learned_index as _learned
 
 #: Process-wide bulk-merge accounting: the cfg12t budget — one bulk merge
 #: per doc per round, never one insert per range — is asserted against
 #: these counters (engine/stacked.assert_round_budget, bench.py cfg12t).
 MERGE_STATS = {"bulk_merges": 0, "ranges_inserted": 0, "compactions": 0}
+
+#: Below this many ranges in the base run, ``lookup_learned`` skips the
+#: model-fit attempt outright: a binary search over a handful of ranges
+#: is already cheaper than any model's fixed probe cost.
+_MIN_MODEL_RANGES = 8
 
 
 def merge_stats_snapshot() -> dict:
@@ -322,7 +328,7 @@ class BatchRangeIndex:
     torn state (tests/test_batch_index.py pins this under 8 threads).
     """
 
-    __slots__ = ("_runs", "n_ranges", "_flat", "_slot_view")
+    __slots__ = ("_runs", "n_ranges", "_flat", "_slot_view", "_model")
 
     _COMPACT_TIERS = 12   # hard lid on tier count (lookup cost bound);
     # the doubling rule keeps real documents far below it
@@ -332,6 +338,9 @@ class BatchRangeIndex:
         self.n_ranges = 0      # total ranges across runs (pre-coalesce)
         self._flat = None      # lazy flattened+coalesced view
         self._slot_view = None
+        self._model = None     # lazy learned model over the base run;
+        # inherited across merges while runs[0] is identity-preserved
+        # (engine/learned_index.py; the exact `lookup` never consults it)
 
     @classmethod
     def from_rows(cls, starts, lens, slots) -> "BatchRangeIndex":
@@ -443,6 +452,12 @@ class BatchRangeIndex:
         out.n_ranges = sum(len(r[0]) for r in runs)
         if len(runs) == 1:
             out._flat = runs[0]
+        # the learned base-run model survives every merge that leaves
+        # runs[0] untouched (the common case under doubling compaction);
+        # a compaction that reaches the base invalidates it — the next
+        # learned probe refits (counted on the "range_index" site)
+        if self._runs and runs[0][0] is self._runs[0][0]:
+            out._model = self._model
         if obs.ENABLED:
             obs.span("plan", "index_merge", _t0, args={
                 "structure": "batch_tiers", "n_new": len(new_run[0]),
@@ -461,6 +476,87 @@ class BatchRangeIndex:
         found = np.zeros(n, bool)
         for starts, lens, slots_r in self._runs:
             pos = np.searchsorted(starts, keys, side="right") - 1
+            safe = np.clip(pos, 0, None)
+            hit = (pos >= 0) & (keys < starts[safe] + lens[safe])
+            if hit.any():
+                slot = np.where(hit, slots_r[safe] + (keys - starts[safe]),
+                                slot)
+                found |= hit
+        return slot, found
+
+    def scalar_affine(self, keys: np.ndarray):
+        """The ε=0 degenerate model, evaluated in scalars: when the
+        index has coalesced to ONE affine range (append-only steady
+        state) and the query column is narrower than vector width,
+        numpy's per-call fixed cost exceeds the arithmetic — the model
+        evaluation is three int ops per key. Returns (slots, found)
+        python lists, or None when the index is not a single range
+        (caller falls through to the vectorized probe)."""
+        runs = self._runs
+        if len(runs) != 1 or len(runs[0][0]) != 1:
+            return None
+        starts, lens, slots_r = runs[0]
+        s0 = int(starts[0])
+        l0 = int(lens[0])
+        z0 = int(slots_r[0])
+        slots = []
+        found = []
+        for k in keys.tolist():
+            off = k - s0
+            hit = 0 <= off < l0
+            found.append(hit)
+            slots.append(z0 + off if hit else 0)
+        _learned.RANGE_SITE.note_hits(len(slots))
+        return slots, found
+
+    def lookup_learned(self, keys: np.ndarray):
+        """``lookup`` with the base-run probe routed through the learned
+        position model (ISSUE 19): exact same (slots, found) — the model
+        predicts the range position ± ε and the windowed verify makes it
+        exact, with counted fallback on miss. Tail tiers (small, freshly
+        merged runs) probe exactly; the base run is where the document's
+        lifetime of ranges lives, so it is where the binary search
+        depth was. Callers gate on ``learned_index.site_enabled``."""
+        from . import learned_index as LI
+        runs = self._runs
+        n = len(keys)
+        if len(runs) == 1:
+            starts, lens, slots_r = runs[0]
+            if len(starts) == 1:
+                # the ε=0 degenerate model: an append-only document's
+                # index coalesces to ONE affine range (slot = key −
+                # start + slot0), so predict + verify collapses to a
+                # single window compare — this is the steady state the
+                # RocksDB learned-index result predicts for
+                # append-mostly key distributions, and the hot shape of
+                # the serving bench
+                off = keys - starts[0]
+                hit = (off >= 0) & (off < lens[0])
+                _learned.RANGE_SITE.note(n, 0)
+                return np.where(hit, slots_r[0] + off, 0), hit
+        slot = np.zeros(n, np.int64)
+        found = np.zeros(n, bool)
+        first = True
+        for starts, lens, slots_r in runs:
+            if first:
+                first = False
+                if len(starts) >= _MIN_MODEL_RANGES:
+                    ent = self._model
+                    if ent is None or ent[0] is not starts:
+                        # (source array, model | None): a refused fit is
+                        # cached too, not re-attempted per probe
+                        ent = (starts,
+                               _learned.fit_model(starts, "range_index"))
+                        self._model = ent
+                    m = ent[1]
+                else:
+                    m = None
+                if m is not None:
+                    pos = m.searchsorted(keys, side="right") - 1
+                else:
+                    pos = np.searchsorted(starts, keys, side="right") - 1
+            else:
+                pos = np.searchsorted(starts, keys, side="right") - 1
             safe = np.clip(pos, 0, None)
             hit = (pos >= 0) & (keys < starts[safe] + lens[safe])
             if hit.any():

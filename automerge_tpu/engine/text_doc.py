@@ -50,6 +50,7 @@ from .pipeline import stage_h2d
 from .runs import detect_runs
 from .host_index import (DuplicateElemId, ElemRangeIndex, new_index,
                          pack_keys, unpack_key)
+from . import learned_index
 from .segments import SegmentMirror
 
 logger = logging.getLogger("automerge_tpu.engine")
@@ -101,6 +102,87 @@ def build_desc_template(plan, tc, op_row, head_rank, row_actor_rank,
     tmpl[DESC_META, META_N_ELEMS] = plan.n_pairs
     tmpl[DESC_META, META_N_RUNS] = n_runs
     return tmpl
+
+
+def _resolve_refs_learned(merged_index, head_parent_pre, n_runs, rpos,
+                          res_is_ins, n_res_ins, batch_rank, ta, tc, pa,
+                          pc, decode, obj_id):
+    """The learned-index resolve-refs fast path (engine/learned_index.py,
+    ISSUE 19): every parent and assignment-target reference of the round
+    resolves through ONE batched index probe — one model evaluation per
+    column instead of up to three separate tier-loop lookups — and the
+    residual refs pack with ONE int32-envelope guard pair instead of one
+    per section. Results, error messages, and the raise order across
+    sections are identical to the exact blocks in `_plan_round` (kept
+    verbatim as the parity comparator behind AMTPU_LEARNED_INDEX=0)."""
+    n_res = len(rpos)
+    k0 = n_runs
+    is_head0 = keys0 = None
+    if n_runs:
+        is_head0, keys0 = head_parent_pre
+    ranks = []
+    ctrs = []
+    is_head1 = res_is_assign = None
+    k1 = 0
+    k2 = 0
+    if n_res:
+        if n_res_ins:
+            ri = rpos[res_is_ins]
+            p_a = pa[ri]
+            is_head1 = p_a == HEAD_PARENT
+            ranks.append(batch_rank[np.where(is_head1, 0, p_a)])
+            ctrs.append(pc[ri].astype(np.int64))
+            k1 = n_res_ins
+        res_is_assign = ~res_is_ins
+        k2 = n_res - n_res_ins
+        if k2:
+            ai = rpos[res_is_assign]
+            ranks.append(batch_rank[ta[ai]])
+            ctrs.append(tc[ai].astype(np.int64))
+    if ranks:
+        packed = pack_keys(
+            ranks[0] if len(ranks) == 1 else np.concatenate(ranks),
+            ctrs[0] if len(ctrs) == 1 else np.concatenate(ctrs))
+        keys_all = packed if keys0 is None \
+            else np.concatenate([keys0, packed])
+    else:
+        keys_all = keys0
+    slots_all, found_all = learned_index.index_lookup(
+        merged_index, keys_all)
+    if n_runs:
+        missing = ~(found_all[:k0] | is_head0)
+        if missing.any():
+            raise ValueError(
+                "ins references unknown parent element "
+                f"{decode(int(keys0[np.flatnonzero(missing)[0]]))} "
+                f"in {obj_id}")
+        run_parent_slot = np.where(is_head0, 0, slots_all[:k0])
+    else:
+        run_parent_slot = np.empty(0, np.int64)
+    res_parent_slot = res_target_slot = None
+    if n_res:
+        res_parent_slot = np.zeros(n_res, np.int64)
+        if k1:
+            s1 = slots_all[k0:k0 + k1]
+            f1 = found_all[k0:k0 + k1]
+            missing = ~(f1 | is_head1)
+            if missing.any():
+                bad = int(keys_all[k0 + np.flatnonzero(missing)[0]])
+                raise ValueError(
+                    "ins references unknown parent element "
+                    f"{decode(bad)} in {obj_id}")
+            res_parent_slot[res_is_ins] = np.where(is_head1, 0, s1)
+        res_target_slot = np.zeros(n_res, np.int64)
+        if k2:
+            s2 = slots_all[k0 + k1:]
+            f2 = found_all[k0 + k1:]
+            if not f2.all():
+                bad = int(keys_all[k0 + k1 + np.flatnonzero(~f2)[0]])
+                raise ValueError(
+                    f"assignment to unknown element {decode(bad)} "
+                    f"in {obj_id}")
+            res_target_slot[res_is_assign] = s2
+    return run_parent_slot, res_parent_slot, res_target_slot
 
 
 @dataclass
@@ -360,11 +442,31 @@ class DeviceTextDoc(CausalDeviceDoc):
             row_actor_rank = rc["row_rank"]
         else:
             _tr = obs.now() if obs.ENABLED else 0
-            rank = self._actor_rank
-            batch_rank = np.asarray(
-                [rank[a] for a in b.actor_table], np.int64)
-            row_actor_rank = np.asarray(
-                [rank[a] for a in b.actors], np.int32)
+            # learned actor-rank site: the doc's lex-sorted table means
+            # rank == table position, so the packed position model (one
+            # evaluation per column) replaces the per-actor dict probes;
+            # any not-found query falls through to the exact path whose
+            # KeyError is the parity-identical unknown-actor signal.
+            batch_rank = row_actor_rank = None
+            if learned_index.site_enabled("actor_rank"):
+                m = learned_index.doc_actor_model(self)
+                if m is not None:
+                    gb = learned_index.actor_positions(
+                        self.actor_table, np.asarray(b.actor_table, object),
+                        "actor_rank", model=m)
+                    gr = learned_index.actor_positions(
+                        self.actor_table, np.asarray(b.actors, object),
+                        "actor_rank", model=m)
+                    if (gb is not None and gr is not None
+                            and gb[1].all() and gr[1].all()):
+                        batch_rank = gb[0].astype(np.int64)
+                        row_actor_rank = gr[0].astype(np.int32)
+            if batch_rank is None:
+                rank = self._actor_rank
+                batch_rank = np.asarray(
+                    [rank[a] for a in b.actor_table], np.int64)
+                row_actor_rank = np.asarray(
+                    [rank[a] for a in b.actors], np.int32)
             rc = {"gen": self._intern_gen, "batch_rank": batch_rank,
                   "row_rank": row_actor_rank}
             if cols is not None:
@@ -460,50 +562,90 @@ class DeviceTextDoc(CausalDeviceDoc):
         else:
             merged_index = base_index
 
-        def resolve_parent(p_actor, p_ctr, pre=None):
-            """Parent refs -> slots (HEAD_PARENT -> slot 0). `pre` is a
-            cached (is_head, packed keys) pair — the doc-interning-keyed
-            half of the resolution; only the index lookup is per-state."""
-            if pre is None:
-                is_head = p_actor == HEAD_PARENT
-                keys = pack_keys(batch_rank[np.where(is_head, 0, p_actor)],
-                                 p_ctr.astype(np.int64))
-            else:
-                is_head, keys = pre
-            slots, found = merged_index.lookup(keys)
-            missing = ~(found | is_head)
-            if missing.any():
-                raise ValueError(
-                    "ins references unknown parent element "
-                    f"{decode(int(keys[np.flatnonzero(missing)[0]]))} "
-                    f"in {self.obj_id}")
-            return np.where(is_head, 0, slots)
-
         _tq = obs.now() if obs.ENABLED else 0
-        if n_runs:
-            run_parent_slot = resolve_parent(None, None,
-                                             pre=head_parent_pre)
+        if learned_index.learned_index_enabled() \
+                and not learned_index.RANGE_SITE.demoted:
+            # learned fast path: one batched probe for every reference of
+            # the round (exact results; misses fall back and are counted).
+            # The dominant serving shape — a pure-runs round with a
+            # sub-vector-width parent column against a single-affine-range
+            # index — resolves inline in scalars (three int ops per key);
+            # everything else goes through the batched model resolver.
+            got = None
+            if not len(rpos) and 0 < n_runs <= 4:
+                sc = getattr(merged_index, "scalar_affine", None)
+                got = sc(head_parent_pre[1]) if sc is not None else None
+            if got is not None:
+                slots_l, found_l = got
+                is_head0 = head_parent_pre[0]
+                run_parent_slot = np.empty(n_runs, np.int64)
+                for i in range(n_runs):
+                    if is_head0[i]:
+                        run_parent_slot[i] = 0
+                    elif found_l[i]:
+                        run_parent_slot[i] = slots_l[i]
+                    else:
+                        raise ValueError(
+                            "ins references unknown parent element "
+                            f"{decode(int(head_parent_pre[1][i]))} "
+                            f"in {self.obj_id}")
+                res_parent_slot = res_target_slot = res_is_assign = None
+            else:
+                run_parent_slot, res_parent_slot, res_target_slot = \
+                    _resolve_refs_learned(
+                        merged_index, head_parent_pre, n_runs, rpos,
+                        res_is_ins, n_res_ins, batch_rank, ta, tc, pa,
+                        pc, decode, self.obj_id)
+                res_is_assign = ~res_is_ins if len(rpos) else None
         else:
-            run_parent_slot = np.empty(0, np.int64)
-
-        res_parent_slot = res_target_slot = None
-        if len(rpos):
-            res_parent_slot = np.zeros(len(rpos), np.int64)
-            if n_res_ins:
-                res_parent_slot[res_is_ins] = resolve_parent(
-                    pa[rpos[res_is_ins]], pc[rpos[res_is_ins]])
-            res_is_assign = ~res_is_ins
-            res_target_slot = np.zeros(len(rpos), np.int64)
-            if res_is_assign.any():
-                ai = rpos[res_is_assign]
-                keys = pack_keys(batch_rank[ta[ai]], tc[ai].astype(np.int64))
+            # exact comparator path (AMTPU_LEARNED_INDEX=0 / demoted),
+            # kept verbatim
+            def resolve_parent(p_actor, p_ctr, pre=None):
+                """Parent refs -> slots (HEAD_PARENT -> slot 0). `pre`
+                is a cached (is_head, packed keys) pair — the
+                doc-interning-keyed half of the resolution; only the
+                index lookup is per-state."""
+                if pre is None:
+                    is_head = p_actor == HEAD_PARENT
+                    keys = pack_keys(
+                        batch_rank[np.where(is_head, 0, p_actor)],
+                        p_ctr.astype(np.int64))
+                else:
+                    is_head, keys = pre
                 slots, found = merged_index.lookup(keys)
-                if not found.all():
-                    bad = int(keys[np.flatnonzero(~found)[0]])
+                missing = ~(found | is_head)
+                if missing.any():
                     raise ValueError(
-                        f"assignment to unknown element {decode(bad)} "
+                        "ins references unknown parent element "
+                        f"{decode(int(keys[np.flatnonzero(missing)[0]]))} "
                         f"in {self.obj_id}")
-                res_target_slot[res_is_assign] = slots
+                return np.where(is_head, 0, slots)
+
+            if n_runs:
+                run_parent_slot = resolve_parent(None, None,
+                                                 pre=head_parent_pre)
+            else:
+                run_parent_slot = np.empty(0, np.int64)
+
+            res_parent_slot = res_target_slot = None
+            if len(rpos):
+                res_parent_slot = np.zeros(len(rpos), np.int64)
+                if n_res_ins:
+                    res_parent_slot[res_is_ins] = resolve_parent(
+                        pa[rpos[res_is_ins]], pc[rpos[res_is_ins]])
+                res_is_assign = ~res_is_ins
+                res_target_slot = np.zeros(len(rpos), np.int64)
+                if res_is_assign.any():
+                    ai = rpos[res_is_assign]
+                    keys = pack_keys(batch_rank[ta[ai]],
+                                     tc[ai].astype(np.int64))
+                    slots, found = merged_index.lookup(keys)
+                    if not found.all():
+                        bad = int(keys[np.flatnonzero(~found)[0]])
+                        raise ValueError(
+                            f"assignment to unknown element {decode(bad)} "
+                            f"in {self.obj_id}")
+                    res_target_slot[res_is_assign] = slots
         if obs.ENABLED:
             obs.span("plan", "rank_resolve", _tq, args={
                 "doc": self.obj_id, "what": "resolve_refs",
@@ -801,31 +943,56 @@ class DeviceTextDoc(CausalDeviceDoc):
             slow_info_np = None
             if (plan.n_runs and plan.dense and self.eager_materialize
                     and self.use_condensed and plan.n_res == 0):
+                # the pipelined ring's steady-state commit: the fused
+                # tier routes it through the ISSUE-19 ring-commit
+                # megakernels (expansion scan on the mode ladder +
+                # materialization in one program); the XLA pair below
+                # stays verbatim as the comparator per the PR-5/7 flag
+                # discipline
+                from ..ops import fused_round as F
+                use_fused = self.fused_rounds and F.fused_rounds_enabled()
                 if plan.seg_plan is not None:
                     # fused merge + HOST-PLANNED materialization: no
                     # device sort, no pointer doubling (engine/segments)
-                    fn = (K.merge_and_materialize_dense_planned_donated
-                          if donate
-                          else K.merge_and_materialize_dense_planned)
                     S = plan.seg_S
                     _, L, as_u8 = self._mat_params(
                         seg_bound=S, n_elems=plan.n_elems_after,
                         cap=out_cap,
                         ascii_=self.all_ascii and not plan.ascii_clear)
-                    self._count_dispatch(label="merge_materialize_planned")
-                    out = fn(*tables, plan.desc, plan.blob,
-                             plan.seg_plan, out_cap=out_cap, S=S,
-                             as_u8=as_u8, L=L)
+                    if use_fused:
+                        fn = (F.fused_commit_round_planned_donated
+                              if donate else F.fused_commit_round_planned)
+                        self._count_dispatch(label="fused_commit_planned")
+                        out = fn(*tables, plan.desc, plan.blob,
+                                 plan.seg_plan, out_cap=out_cap, S=S,
+                                 as_u8=as_u8, L=L, mode=F.fused_mode())
+                    else:
+                        fn = (K.merge_and_materialize_dense_planned_donated
+                              if donate
+                              else K.merge_and_materialize_dense_planned)
+                        self._count_dispatch(
+                            label="merge_materialize_planned")
+                        out = fn(*tables, plan.desc, plan.blob,
+                                 plan.seg_plan, out_cap=out_cap, S=S,
+                                 as_u8=as_u8, L=L)
                 else:
-                    fn = (K.merge_and_materialize_dense_donated if donate
-                          else K.merge_and_materialize_dense)
                     S, L, as_u8 = self._mat_params(
                         seg_bound=self._seg_bound + plan.seg_inc,
                         n_elems=plan.n_elems_after, cap=out_cap,
                         ascii_=self.all_ascii and not plan.ascii_clear)
-                    self._count_dispatch(label="merge_materialize_dense")
-                    out = fn(*tables, plan.desc, plan.blob,
-                             out_cap=out_cap, S=S, as_u8=as_u8, L=L)
+                    if use_fused:
+                        fn = (F.fused_commit_round_donated if donate
+                              else F.fused_commit_round)
+                        self._count_dispatch(label="fused_commit_round")
+                        out = fn(*tables, plan.desc, plan.blob,
+                                 out_cap=out_cap, S=S, as_u8=as_u8, L=L,
+                                 mode=F.fused_mode())
+                    else:
+                        fn = (K.merge_and_materialize_dense_donated
+                              if donate else K.merge_and_materialize_dense)
+                        self._count_dispatch(label="merge_materialize_dense")
+                        out = fn(*tables, plan.desc, plan.blob,
+                                 out_cap=out_cap, S=S, as_u8=as_u8, L=L)
                 tables = out[:9]
                 fused_mat = (out[9], out[10], S)
             else:

@@ -49,12 +49,13 @@ import jax
 import jax.numpy as jnp
 
 from .ingest import (  # noqa: F401
-    DESC_ELEM_BASE, DESC_META, META_N_ELEMS, META_N_RUNS,
+    DESC_ELEM_BASE, DESC_META, META_BASE_SLOT, META_N_ELEMS, META_N_RUNS,
     MOP_KIND, MOP_SLOT, MOP_VALUE, MOP_WIN_ACTOR, MOP_WIN_SEQ,
     RES_KIND, RES_NEW_SLOT, RES_SLOT,
     _TABLE_ARGNUMS, _apply_map_round, _apply_residual_packed,
-    _break_chains_core, _break_chains_packed, _jit_pair, _scatter_rows_9,
-    _scatter_registers_packed, _unpack_desc,
+    _break_chains_core, _break_chains_packed, _jit_pair,
+    _materialize_core, _materialize_core_planned, _scatter_rows_9,
+    _scatter_registers_packed, _slice_live, _unpack_desc,
 )
 
 _MODES = ("pallas", "interpret", "lax")
@@ -179,6 +180,59 @@ fused_mixed_round, fused_mixed_round_donated = _jit_pair(
     _fused_mixed_core, _TABLE_ARGNUMS, ("out_cap", "mode"))
 
 
+def _fused_commit_core(
+    parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
+    chain, desc, blob, *, out_cap: int, S: int, as_u8: bool, L: int,
+    mode: str,
+):
+    """The ring-commit megakernel (the PR-17 follow-on): the pipelined
+    ingestor's steady-state commit — the common-case dense merge round
+    END TO END, expansion (scan lowered through the mode ladder) plus
+    the codes-only materialization — as ONE fused-tier program. The XLA
+    pair (`merge_and_materialize_dense*`, ops/ingest.py) stays verbatim
+    behind AMTPU_FUSED_ROUNDS=0 as the byte-identical comparator."""
+    tables = _fused_expand(
+        (parent, ctr, actor, value, has_value, win_actor, win_seq,
+         win_counter, chain), desc, blob, out_cap=out_cap, mode=mode)
+    n_elems = (desc[DESC_META, META_BASE_SLOT]
+               + desc[DESC_META, META_N_ELEMS] - 1)
+    cols = _slice_live((tables[0], tables[1], tables[2], tables[3],
+                        tables[4], tables[8]), L)
+    codes, scalars = _materialize_core(*cols, n_elems, S, with_pos=False,
+                                       as_u8=as_u8)
+    return tables + (codes, scalars)
+
+
+fused_commit_round, fused_commit_round_donated = _jit_pair(
+    _fused_commit_core, _TABLE_ARGNUMS, ("out_cap", "S", "as_u8", "L",
+                                         "mode"))
+
+
+def _fused_commit_planned_core(
+    parent, ctr, actor, value, has_value, win_actor, win_seq, win_counter,
+    chain, desc, blob, segplan, *, out_cap: int, S: int, as_u8: bool,
+    L: int, mode: str,
+):
+    """`_fused_commit_core` with the materialization's segment structure
+    staged from the host plan — no device sort, no pointer doubling;
+    the fused-tier twin of `merge_and_materialize_dense_planned`."""
+    tables = _fused_expand(
+        (parent, ctr, actor, value, has_value, win_actor, win_seq,
+         win_counter, chain), desc, blob, out_cap=out_cap, mode=mode)
+    n_elems = (desc[DESC_META, META_BASE_SLOT]
+               + desc[DESC_META, META_N_ELEMS] - 1)
+    cols = _slice_live((tables[0], tables[1], tables[2], tables[3],
+                        tables[4], tables[8]), L)
+    codes, scalars = _materialize_core_planned(
+        *cols, n_elems, segplan, S, with_pos=False, as_u8=as_u8)
+    return tables + (codes, scalars)
+
+
+fused_commit_round_planned, fused_commit_round_planned_donated = _jit_pair(
+    _fused_commit_planned_core, _TABLE_ARGNUMS,
+    ("out_cap", "S", "as_u8", "L", "mode"))
+
+
 def _fused_stacked_round(
     # map lane: 5 stacked register tables + (D, 5, M) ops + (D, K) conflicts
     m_value, m_has, m_wa, m_ws, m_wc, m_ops, m_conflict,
@@ -285,6 +339,14 @@ from ..obs import device_truth as _device_truth  # noqa: E402
 fused_mixed_round, fused_mixed_round_donated = \
     _device_truth.instrument_pair(
         (fused_mixed_round, fused_mixed_round_donated), "fused_mixed_round")
+fused_commit_round, fused_commit_round_donated = \
+    _device_truth.instrument_pair(
+        (fused_commit_round, fused_commit_round_donated),
+        "fused_commit_round")
+fused_commit_round_planned, fused_commit_round_planned_donated = \
+    _device_truth.instrument_pair(
+        (fused_commit_round_planned, fused_commit_round_planned_donated),
+        "fused_commit_round_planned")
 fused_stacked_round = _device_truth.instrument(fused_stacked_round,
                                                "fused_stacked_round")
 fused_scatter_registers = _device_truth.instrument(

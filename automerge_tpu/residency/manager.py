@@ -157,8 +157,14 @@ class ResidencyManager:
         protect = [d for d in deliveries if d not in self.mesh._migrating]
         est = self._reserve_estimate()
         need = 0
-        for doc_id in protect:
-            if doc_id in self.store:
+        # batched stored-membership: the whole round's doc ids go through
+        # ONE learned position probe over the store's sorted id table
+        # (store.member_mask, the "residency_clock" site); None keeps the
+        # exact per-doc `in` probes as the parity comparator
+        stored_mask = self.store.member_mask(protect) if protect else None
+        for i, doc_id in enumerate(protect):
+            if (doc_id in self.store if stored_mask is None
+                    else bool(stored_mask[i])):
                 # route against the STORED clock: only causally-ready
                 # work justifies burning h2d bandwidth now — premature
                 # changes will park either way, and the park hint is

@@ -25,6 +25,8 @@ from __future__ import annotations
 import hashlib
 import os
 
+from ..engine import learned_index
+
 
 class BundleStore:
     """Host-side (warm) + disk (cold) checkpoint-bundle store."""
@@ -33,6 +35,8 @@ class BundleStore:
         self.spill_dir = spill_dir
         self._warm: dict = {}           # doc_id -> bundle bytes
         self._cold: dict = {}           # doc_id -> (path, nbytes)
+        self._gen = 0                   # membership generation: bumps on
+        self._learned = None            # put/pop; (gen, ids, model pair)
         self.stats = {"puts": 0, "gets": 0, "ages": 0, "loads": 0,
                       "peak_warm_bytes": 0, "peak_cold_bytes": 0}
 
@@ -40,6 +44,37 @@ class BundleStore:
 
     def __contains__(self, doc_id: str) -> bool:
         return doc_id in self._warm or doc_id in self._cold
+
+    def member_mask(self, doc_ids):
+        """Batched stored-membership of ``doc_ids`` — ONE learned/packed
+        position probe over the store's sorted id table (the
+        "residency_clock" site) instead of a per-doc ``in`` probe each,
+        with the full-key equality gate guaranteeing exactness. The
+        table + model are cached per membership generation (put/pop
+        bumps — the same token discipline as the interning-generation
+        retrain trigger). Returns a bool array aligned to ``doc_ids``,
+        or None when the site must take the exact path (flag off,
+        demoted, unpackable ids)."""
+        if not learned_index.site_enabled("residency_clock"):
+            return None
+        ent = self._learned
+        if ent is None or ent[0] != self._gen:
+            ids = sorted([*self._warm, *self._cold])
+            tk = learned_index.pack_str_keys(ids)
+            pair = None
+            if tk is not None and (len(tk) < 2
+                                   or bool((tk[1:] > tk[:-1]).all())):
+                pair = (tk, learned_index.fit_model(tk, "residency_clock"))
+            ent = (self._gen, ids, pair)
+            self._learned = ent
+        _gen, ids, pair = ent
+        if pair is None:
+            return None
+        got = learned_index.actor_positions(
+            ids, doc_ids, "residency_clock", model=pair)
+        if got is None:
+            return None
+        return got[1]
 
     def tier(self, doc_id: str):
         if doc_id in self._warm:
@@ -69,6 +104,7 @@ class BundleStore:
         overwrites: the newest bundle is the doc's only truth)."""
         self._cold.pop(doc_id, None)
         self._warm[doc_id] = bundle
+        self._gen += 1
         self.stats["puts"] += 1
         wb = self.warm_bytes
         if wb > self.stats["peak_warm_bytes"]:
@@ -122,11 +158,13 @@ class BundleStore:
         copy by design (one tier at a time)."""
         bundle = self._warm.pop(doc_id, None)
         if bundle is not None:
+            self._gen += 1
             self.stats["gets"] += 1
             return bundle
         entry = self._cold.pop(doc_id, None)
         if entry is None:
             return None
+        self._gen += 1
         path, _nbytes = entry
         with open(path, "rb") as fh:
             bundle = fh.read()

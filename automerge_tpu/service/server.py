@@ -866,6 +866,7 @@ class SyncService:
         # byte diff. Omitted entirely when lineage never ran.
         lin = lineage.postmortem(k=8) if lineage.ledger() is not None \
             else None
+        from ..engine import learned_index
         return {
             "schema": "amtpu-postmortem-v1",
             "tick": self._tick_no,
@@ -889,6 +890,10 @@ class SyncService:
                if self._residency is not None else {}),
             **({"federation": self._federation.describe()}
                if self._federation is not None else {}),
+            # ISSUE-19: per-site learned-lookup stats + any site
+            # currently demoted to its exact path (the drift signal an
+            # operator acts on)
+            "learned_index": learned_index.describe(),
         }
 
     def tick_p99_ms_telemetry(self) -> float:
@@ -970,6 +975,11 @@ class SyncService:
         # cache outcomes, staged byte totals, per-doc/lane footprint
         from ..obs import device_truth
         fams += device_truth.families("amtpu_device")
+        # learned-index families (INTERNALS §23): per-site model hits/
+        # misses/refits/demotions, ε-window width, miss-rate gauge —
+        # the exactness ledger of the ISSUE-19 learned lookup paths
+        from ..engine import learned_index
+        fams += learned_index.families("amtpu_index")
         return prom.expose(fams)
 
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):

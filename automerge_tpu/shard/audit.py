@@ -160,6 +160,24 @@ def commit_path_collectives(mesh=None, docs_per_device: int = 2,
         in_shardings=(shard,) * 11, out_shardings=shard)
     out["merge_and_materialize_dense"] = count_collectives(
         dense_fn, elem_tables + (put(desc), put(blob)))
+
+    # ISSUE 19: the fused-tier ring-commit megakernels (the production
+    # route of the pipelined commit path under AMTPU_FUSED_ROUNDS) must
+    # hold the same invariant as their XLA comparators above. Audited on
+    # the "lax" scan rung, same rationale as the fused stacked round.
+    fused_planned_fn = jax.jit(
+        jax.vmap(lambda *a: F._fused_commit_planned_core(
+            *a, out_cap=cap, S=S, as_u8=True, L=cap, mode="lax")),
+        in_shardings=(shard,) * 12, out_shardings=shard)
+    out["fused_commit_round_planned"] = count_collectives(
+        fused_planned_fn, elem_tables + (put(desc), put(blob),
+                                         put(segplan)))
+    fused_commit_fn = jax.jit(
+        jax.vmap(lambda *a: F._fused_commit_core(
+            *a, out_cap=cap, S=S, as_u8=True, L=cap, mode="lax")),
+        in_shardings=(shard,) * 11, out_shardings=shard)
+    out["fused_commit_round"] = count_collectives(
+        fused_commit_fn, elem_tables + (put(desc), put(blob)))
     del jnp
     return out
 

@@ -517,6 +517,52 @@ def config12t_text_prepare(quick: bool = False,
          threshold=rec["threshold"])
 
 
+def config19_learned_index(quick: bool = False,
+                           record_session: bool = False):
+    """Learned-index host planning A/B (ISSUE 19, INTERNALS §23): the
+    cfg19 row — the cfg12t population stream with the production
+    planner config on BOTH legs, A/B'd across AMTPU_LEARNED_INDEX
+    alone. Byte-identical final text, learned-site engagement, the
+    rank_resolve bar (cfg12t-shape scaled <= 0.36 s, >= 2x under the
+    same-run exact leg), zero model-wrong-answers on the untimed
+    audit pass and zero demotions all asserted inside the measurement.
+    Subprocess for a clean obs/jax state; ``--session`` appends the
+    row to BENCH_SESSIONS.jsonl."""
+    import os
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "AMTPU_SKIP_PREFLIGHT": "1"}
+    cmd = [sys.executable, os.path.join(root, "bench.py"), "--learned"]
+    if quick:
+        cmd.append("--quick")
+    if record_session:
+        cmd.append("--session")
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=root,
+                         env=env, timeout=1800)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"cfg19 learned-index bench failed rc={out.returncode}: "
+            f"{out.stderr[-800:]}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    emit("cfg19_learned_index_ops_per_sec", rec["value"], "ops/s",
+         n_docs=rec["n_docs"],
+         exact_ops_per_sec=rec["exact_ops_per_sec"],
+         speedup_vs_exact=rec["speedup_vs_exact"],
+         value_spread_pct=rec["value_spread_pct"],
+         rank_resolve_s=rec["rank_resolve_s"],
+         exact_rank_resolve_s=rec["exact_rank_resolve_s"],
+         rank_resolve_speedup=rec["rank_resolve_speedup"],
+         model_wrong_answers=rec["model_wrong_answers"],
+         model_misses=rec["model_misses"],
+         model_refits=rec["model_refits"],
+         demotions=rec["demotions"],
+         audit_lookups_checked=rec["audit_lookups_checked"],
+         site_stats=rec["site_stats"],
+         measured_platform=rec["platform"],
+         threshold=rec["threshold"])
+
+
 def config13_wire(quick: bool = False, record_session: bool = False):
     """Binary columnar wire A/B at service scale (ISSUE 13, INTERNALS
     §17): the cfg13 row — dict vs AMTPUWIRE1 frames on the SAME seeded
@@ -1624,6 +1670,10 @@ def main():
         # the chip_session.sh cfg18 step: ONLY the bounded-HBM row
         config18_residency(quick=quick, record_session=True)
         return
+    if "--learned-session" in sys.argv:
+        # the chip_session.sh cfg19 step: ONLY the learned-index A/B row
+        config19_learned_index(quick=quick, record_session=True)
+        return
     record_round = None
     record_path = None
     if "--record" in sys.argv:
@@ -1713,6 +1763,7 @@ def main():
         lambda: config15_device_truth(quick=quick),
         lambda: config17_fused(quick=quick),
         lambda: config18_residency(quick=quick),
+        lambda: config19_learned_index(quick=quick),
     ]
     if record_path is not None:
         steps.insert(0, fold_headline)

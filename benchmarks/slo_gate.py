@@ -88,6 +88,12 @@ SLOS = [
     # absolute rule below, never a relative one)
     ("cfg18_residency", "value", "min", 0.8),
     ("cfg18_residency", "page_in_p99_ms", "max", 1.5),
+    # ISSUE 19: learned-index rows — throughput floor on the learned leg
+    # of the host-planning A/B (the leg AMTPU_LEARNED_INDEX ships on by
+    # default; the exact comparator leg is recorded alongside but
+    # carries no bar of its own — the hard guarantees are the absolute
+    # rules below)
+    ("cfg19_learned_index", "value", "min", 0.8),
 ]
 
 #: Absolute SLOs: (metric_prefix, dotted field, op, bound) checked on
@@ -156,6 +162,16 @@ ABS_SLOS = [
     # the manager's own ledger
     ("cfg18_residency", "peak_over_budget", "<=", 1.0),
     ("cfg18_residency", "budget_overruns", "<=", 0),
+    # the ISSUE-19 acceptance bars on every committed cfg19 row,
+    # forever: the learned leg's plan/rank_resolve term, scaled to the
+    # committed cfg12t 28672-plan shape, stays under 0.36 s (>= 2x
+    # under the committed cfg12t 0.72 s term the tentpole exists to
+    # kill), and the audit pass never catches a model returning a
+    # wrong VERIFIED answer — exactness is the tier's whole contract,
+    # so any nonzero count is a correctness regression, not a tunable
+    # (both also asserted in-run before the row is emitted)
+    ("cfg19_learned_index", "rank_resolve_s", "<=", 0.36),
+    ("cfg19_learned_index", "model_wrong_answers", "<=", 0),
 ]
 
 #: Derived fields computable from any row that carries the inputs.

@@ -176,6 +176,14 @@ run "cfg17_fused" 1200 env \
 # byte-identical captures vs the unbounded reference all asserted
 # inside the measurement; appended to BENCH_SESSIONS.jsonl
 run "cfg18_residency" 1200 python -m benchmarks.run_all --residency-session
+# learned-index host planning (ISSUE 19): the cfg19 row on the chip
+# host — the cfg12t population stream A/B'd across AMTPU_LEARNED_INDEX
+# with the production planner config on both legs; byte-identical final
+# text, learned-site engagement, the rank_resolve bar (cfg12t-shape
+# scaled <= 0.36 s, >= 2x under the same-run exact leg), zero
+# model-wrong-answers on the untimed audit pass and zero demotions all
+# asserted inside the measurement; appended to BENCH_SESSIONS.jsonl
+run "cfg19_learned_index" 1800 python -m benchmarks.run_all --learned-session
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu

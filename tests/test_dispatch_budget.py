@@ -112,7 +112,7 @@ def test_labeled_dispatch_histogram():
     after = accounting.labeled_snapshot()["dispatch"]
     fused = {k: v["n"] - before.get(k, {"n": 0})["n"]
              for k, v in after.items()
-             if k.startswith("merge_materialize")}
+             if k.startswith(("merge_materialize", "fused_commit"))}
     assert sum(fused.values()) >= 1, after
 
 

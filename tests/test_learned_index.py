@@ -1,0 +1,320 @@
+"""Learned-index host planning: exactness, parity, demotion (ISSUE 19).
+
+The bounded-error position models (engine/learned_index.py) are
+*advisory*: a model prediction is verified in its ε-window and a failed
+verify is a counted fallback to the exact probe — NEVER a wrong answer.
+These tests pin that contract three ways:
+
+- model-level exactness over randomized tables and query distributions
+  (both searchsorted sides, packed string keys, the full-key equality
+  gate that prevents prefix aliasing);
+- byte-identity of committed engine state across the
+  ``AMTPU_LEARNED_INDEX`` × ``AMTPU_CROSS_DOC_PLAN`` ×
+  ``AMTPU_BATCH_INDEX`` flag matrix on shuffled/dup/premature streams
+  (the PR-5/7 parity discipline: the exact paths stay verbatim behind
+  the flag);
+- adversarial drift: a deliberately under-bounded model (the stale-model
+  shape that cannot arise from `fit_model`'s closed-form ε, simulated
+  directly) must stay exact through every miss, cross the miss-rate
+  window into demotion, and re-arm on refit — the
+  refit-on-intern-gen-bump pin rides the same token.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from automerge_tpu.engine import learned_index as li
+from test_columnar_plan import (_run_population, apply_with_flag,
+                                rand_text_changes)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    li.reset_stats()
+    yield
+    li.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# model-level exactness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_model_searchsorted_exact_random_tables(seed):
+    """Model-predicted positions equal np.searchsorted on both sides for
+    random non-uniform int64 tables and mixed member/miss queries."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(li._min_keys(), 4000))
+    # lognormal gaps: deliberately non-linear key space
+    gaps = np.maximum(1, rng.lognormal(2.0, 2.0, n)).astype(np.int64)
+    keys = np.cumsum(gaps)
+    m = li.fit_model(keys, "range_index")
+    if m is None:      # ε over the refusal cap for this draw: exact path
+        return
+    q = np.concatenate([
+        rng.choice(keys, 50),                        # members
+        keys[rng.integers(0, n, 50)] + rng.integers(-3, 4, 50),  # near
+        rng.integers(0, int(keys[-1]) + 10, 50),     # uniform
+    ])
+    for side in ("left", "right"):
+        got = m.searchsorted(q, side=side)
+        np.testing.assert_array_equal(got, np.searchsorted(keys, q, side))
+
+
+def test_eps_is_exact_bound_and_refusal():
+    """ε is the measured max model error at fit time; a table whose ε
+    would exceed the cap refuses to build (the window would out-read a
+    binary search)."""
+    keys = np.arange(0, 10_000, 7, dtype=np.int64)
+    m = li.fit_model(keys, "range_index")
+    assert m is not None and m.eps == 0   # affine table: exact model
+    # two dense clusters with one huge gap and only 2 anchors would err;
+    # with the default anchor budget ε stays small — force refusal via a
+    # pathological table wider than any plausible ε cap
+    rng = np.random.default_rng(0)
+    bad = np.cumsum(np.maximum(
+        1, rng.pareto(0.3, 5000) * 1e6).astype(np.int64))
+    m2 = li.fit_model(bad, "range_index")
+    if m2 is not None:
+        assert m2.eps <= li._max_eps()
+
+
+def test_pack_str_keys_order_and_refusals():
+    vals = ["a", "ab", "b", "zz9", "zzz"]
+    packed = li.pack_str_keys(vals)
+    assert packed is not None
+    assert (packed[1:] > packed[:-1]).all()   # order-preserving
+    assert li.pack_str_keys(["café"]) is None   # non-ASCII: refuse
+
+
+def test_actor_positions_prefix_collision_never_aliases():
+    """Two actors sharing an 8-byte prefix make the packed table
+    non-strictly-increasing — the site must refuse (exact path), never
+    return an aliased rank."""
+    table = sorted(["actor-000017-a", "actor-000017-b", "b"])
+    got = li.actor_positions(table, np.asarray(["actor-000017-b"], object),
+                             "actor_rank")
+    assert got is None
+    assert li.SITES["actor_rank"].exact_fallbacks >= 1
+
+
+def test_actor_positions_full_key_gate():
+    """Found is full-key equality, not prefix equality: a query whose
+    8-byte prefix matches a table entry but whose tail differs reports
+    not-found."""
+    table = sorted(f"w{i:07d}" for i in range(64))       # exactly 8 bytes
+    q = np.asarray(["w0000003", "w0000003x", "w9999999"], object)
+    got = li.actor_positions(table, q, "actor_rank")
+    assert got is not None
+    pos, found = got
+    assert found.tolist() == [True, False, False]
+    assert pos[0] == 3
+
+
+# ---------------------------------------------------------------------------
+# flag-matrix byte-identity parity (shuffled / dup / premature streams)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(2))
+@pytest.mark.parametrize("cross,batch", [("1", "1"), ("1", "0"),
+                                         ("0", "1"), ("0", "0")])
+def test_flag_matrix_population_parity(seed, cross, batch, monkeypatch):
+    """Committed population state is byte-identical with the learned
+    paths on vs off, under every AMTPU_CROSS_DOC_PLAN ×
+    AMTPU_BATCH_INDEX combination, over randomized out-of-order/
+    duplicate/premature chunked deliveries."""
+    monkeypatch.setenv("AMTPU_LEARNED_INDEX", "0")
+    ref = _run_population(seed, cross, "1", monkeypatch,
+                          batch_index=batch)
+    monkeypatch.setenv("AMTPU_LEARNED_INDEX", "1")
+    got = _run_population(seed, cross, "1", monkeypatch,
+                          batch_index=batch)
+    assert got == ref
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_wide_actor_batch_parity(seed, monkeypatch):
+    """A single wide batch minting many actors (the learned
+    `_intern_batch_actors` membership scan engages above its size
+    threshold) commits byte-identically with the learned path on/off."""
+    rng = random.Random(seed)
+    changes = rand_text_changes(rng, n_changes=40, n_actors=16,
+                                premature=False)
+    monkeypatch.setenv("AMTPU_LEARNED_INDEX", "0")
+    ref = apply_with_flag(list(changes), "1", monkeypatch)
+    monkeypatch.setenv("AMTPU_LEARNED_INDEX", "1")
+    got = apply_with_flag(list(changes), "1", monkeypatch)
+    assert got == ref
+
+
+def test_unknown_parent_same_error_both_paths(monkeypatch):
+    """The learned resolver raises the exact path's unknown-parent
+    signal verbatim (message parity is part of the comparator
+    contract)."""
+    from automerge_tpu.engine.text_doc import DeviceTextDoc
+    bad = [{"actor": "a", "seq": 1, "deps": {}, "ops": [
+        {"action": "ins", "obj": "t", "key": "ghost:99", "elem": 1}]}]
+    msgs = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("AMTPU_LEARNED_INDEX", flag)
+        doc = DeviceTextDoc("t")
+        doc.apply_changes([{"actor": "b", "seq": 1, "deps": {}, "ops": [
+            {"action": "ins", "obj": "t", "key": "_head", "elem": 1}]}])
+        with pytest.raises(ValueError) as ei:
+            doc.apply_changes([dict(c) for c in bad])
+        msgs[flag] = str(ei.value)
+    assert msgs["0"] == msgs["1"]
+
+
+# ---------------------------------------------------------------------------
+# adversarial drift: misses stay exact, demote, re-arm on refit
+# ---------------------------------------------------------------------------
+
+
+def test_drifted_model_misses_stay_exact_then_demote():
+    """A model whose ε under-states the true error (the stale/drifted
+    shape — unreachable through fit_model's closed-form ε, built
+    directly here) must fall back per missing key with the EXACT answer,
+    and enough window misses must demote the site to the exact path."""
+    st = li.SITES["range_index"]
+    rng = np.random.default_rng(3)
+    keys = np.cumsum(np.maximum(
+        1, rng.lognormal(3.0, 2.5, 2000)).astype(np.int64))
+    good = li.fit_model(keys, "range_index")
+    assert good is not None
+    # same anchors, lying ε=0: every prediction off by >0 now misses
+    drifted = li.PositionModel(good.padded, good.anchor_keys,
+                               good.anchor_pos, 0, "range_index")
+    q = rng.integers(0, int(keys[-1]), 4000)
+    rounds = 0
+    while not st.demoted and rounds < 40:
+        got = drifted.searchsorted(q, side="left")
+        np.testing.assert_array_equal(got, np.searchsorted(keys, q))
+        rounds += 1
+    assert st.demoted, "miss-rate window never demoted the site"
+    assert st.misses > 0 and st.wrong == 0
+    assert not li.site_enabled("range_index")   # consumers go exact
+    # a refit (the interning-generation-bump trigger) re-arms the site
+    li.fit_model(keys, "range_index")
+    assert not st.demoted
+    assert li.site_enabled("range_index")
+
+
+def test_actor_churn_forces_exact_fallbacks_never_wrong(monkeypatch):
+    """Non-append actor churn (fresh interleaving actors every round —
+    each bump refits) keeps the learned population byte-identical to the
+    exact comparator; every probe either hits or is a counted fallback,
+    never a wrong answer."""
+    states = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("AMTPU_LEARNED_INDEX", flag)
+        monkeypatch.setenv("AMTPU_LEARNED_AUDIT", "1")
+        li.reset_stats()
+        rng = random.Random(11)
+        # interleaving actor names: aa.., am.., ab.. sort between each
+        # other so every round's interning is a general (non-append)
+        # merge — the churn shape that would punish a stale model
+        changes = []
+        known = ["_head"]
+        ctr = 1
+        for rnd in range(12):
+            actor = f"a{chr(97 + (rnd * 7) % 26)}{rnd:02d}"
+            ops = []
+            for _ in range(6):
+                parent = rng.choice(known)
+                ops.append({"action": "ins", "obj": "t", "key": parent,
+                            "elem": ctr})
+                ops.append({"action": "set", "obj": "t",
+                            "key": f"{actor}:{ctr}", "value": "x"})
+                known.append(f"{actor}:{ctr}")
+                ctr += 1
+            changes.append({"actor": actor, "seq": 1, "deps": {},
+                            "ops": ops})
+        states[flag] = apply_with_flag(changes, "1", monkeypatch,
+                                       seed_doc=False)
+        if flag == "1":
+            snap = li.stats_snapshot()
+            assert all(s["wrong"] == 0 for s in snap.values()), snap
+    assert states["1"] == states["0"]
+
+
+def test_refit_on_intern_gen_bump():
+    """The per-(doc, intern-gen) actor model retrains exactly when the
+    PR-5 invalidation token bumps — same token, same trigger."""
+    from automerge_tpu.engine.text_doc import DeviceTextDoc
+    doc = DeviceTextDoc("t")
+    doc.apply_changes([{"actor": f"a{i:02d}", "seq": 1,
+                        "deps": {}, "ops": []} for i in range(20)])
+    st = li.SITES["actor_rank"]
+    m1 = li.doc_actor_model(doc)
+    r1 = st.refits
+    assert li.doc_actor_model(doc) is m1      # cached: no refit
+    assert st.refits == r1
+    gen0 = doc._intern_gen
+    doc.apply_changes([{"actor": "zz99", "seq": 1, "deps": {},
+                        "ops": []}])          # new actor: gen bump
+    assert doc._intern_gen != gen0
+    m2 = li.doc_actor_model(doc)
+    assert m2 is not m1
+    assert st.refits > r1
+
+
+def test_range_index_model_invalidates_across_merges():
+    """BatchRangeIndex keeps its cached tier model only while the fitted
+    tier's runs are identity-preserved by a merge; a changed tier refits
+    rather than serving stale predictions."""
+    from automerge_tpu.engine import host_index as H
+    idx = H.BatchRangeIndex()
+    n = max(li._min_keys(), H._MIN_MODEL_RANGES) + 8
+    starts = np.arange(0, n * 100, 100, dtype=np.int64)
+    idx = idx.merge(starts, np.full(n, 3, np.int64),
+                    np.arange(1, 3 * n, 3, dtype=np.int64))
+    keys = starts + 1
+    s1, f1 = idx.lookup_learned(keys)
+    se, fe = idx.lookup(keys)
+    np.testing.assert_array_equal(s1, se)
+    np.testing.assert_array_equal(f1, fe)
+    cached = idx._model
+    assert cached is not None
+    # non-adjacent second merge: a new tier appears, tier-0 runs keep
+    # identity, so the cached model survives the merge
+    idx2 = idx.merge(np.asarray([10 ** 9], np.int64),
+                     np.asarray([5], np.int64),
+                     np.asarray([5000], np.int64))
+    assert idx2._model is cached
+    q2 = np.concatenate([keys[:4], np.asarray([10 ** 9 + 2], np.int64)])
+    sl, fl = idx2.lookup_learned(q2)
+    sx, fx = idx2.lookup(q2)
+    np.testing.assert_array_equal(sl, sx)
+    np.testing.assert_array_equal(fl, fx)
+
+
+# ---------------------------------------------------------------------------
+# residency_clock site
+# ---------------------------------------------------------------------------
+
+
+def test_store_member_mask_matches_exact_membership():
+    from automerge_tpu.residency.store import BundleStore
+    s = BundleStore()
+    for i in range(48):
+        s.put(f"doc{i:04d}", b"b" * 4)
+    q = [f"doc{i:04d}" for i in range(0, 96, 5)]
+    mask = s.member_mask(q)
+    assert mask is not None
+    assert mask.tolist() == [d in s for d in q]
+    s.pop("doc0005")
+    mask2 = s.member_mask(q)          # gen bump: table rebuilt
+    assert mask2.tolist() == [d in s for d in q]
+
+
+def test_store_member_mask_respects_flag(monkeypatch):
+    from automerge_tpu.residency.store import BundleStore
+    monkeypatch.setenv("AMTPU_LEARNED_INDEX", "0")
+    s = BundleStore()
+    s.put("d1", b"x")
+    assert s.member_mask(["d1"]) is None   # exact comparator path

@@ -432,7 +432,10 @@ def test_commit_path_compiles_with_zero_collectives():
                           # ISSUE 18: the ring-commit megakernels ride
                           # the same audit (the PR-17 leftover)
                           "merge_and_materialize_dense_planned",
-                          "merge_and_materialize_dense"}
+                          "merge_and_materialize_dense",
+                          # ISSUE 19: their fused-tier twins
+                          "fused_commit_round",
+                          "fused_commit_round_planned"}
     assert_zero_collectives(audit)
 
 
