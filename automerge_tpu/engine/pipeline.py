@@ -46,6 +46,14 @@ worker thread just plans in column space (batch-level decode caches
 shared across the stream), and `AMTPU_COLUMNAR_PLAN=0` runs the same
 ring over the legacy per-change planner
 (tests/test_columnar_plan.py::test_ring_integration_both_planners).
+
+The same worker-thread/queue/overlap discipline, lifted from per-doc to
+per-lane, is `shard/parallel.LaneExecutor` (INTERNALS §24): one
+persistent worker per shard lane runs whole stacked ingest rounds under
+the lane's device context while the caller pre-decodes the NEXT round's
+wire payloads — the ring's "plan k+1 while k commits" seam at mesh
+granularity. Both layers share :func:`device_ctx_factory` for device
+pinning.
 """
 
 from __future__ import annotations
@@ -98,6 +106,23 @@ def planner_pool():
                 max_workers=plan_workers(),
                 thread_name_prefix="amtpu-plan")
     return _POOL
+
+
+def device_ctx_factory(device):
+    """A zero-arg context-manager factory pinning work to `device`
+    (``jax.default_device``), or a nullcontext factory when `device` is
+    None. The one device-pinning idiom shared by the per-doc ring
+    (:class:`PipelinedIngestor`) and the per-lane executor
+    (shard/parallel, INTERNALS §24) — resolved once so the hot paths
+    never re-import jax per call."""
+    if device is None:
+        import contextlib
+
+        def _null():
+            return contextlib.nullcontext()
+        return _null
+    import jax
+    return lambda: jax.default_device(device)
 
 
 def _chunk_elems(arr: np.ndarray) -> int:
@@ -209,14 +234,7 @@ class PipelinedIngestor:
         self._started = False
 
     def _make_device_ctx(self):
-        if self.device is None:
-            import contextlib
-
-            def _null():
-                return contextlib.nullcontext()
-            return _null
-        import jax
-        return lambda: jax.default_device(self.device)
+        return device_ctx_factory(self.device)
 
     # -- context manager -------------------------------------------------
     def __enter__(self):

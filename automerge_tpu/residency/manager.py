@@ -36,11 +36,29 @@ test asserts over.
 
 from __future__ import annotations
 
+import functools
+import threading
 import time
 
 from ..obs import lineage
 from .policy import ResidencyConfig, lane_pressure, make_model
 from .store import BundleStore
+
+
+def _locked(fn):
+    """Serialize a tier-transition method on the manager's re-entrant
+    lock. The round hooks themselves stay caller-thread-only under
+    parallel mesh execution (barrier-ordered by `ShardedDocSet`), but
+    the reservation-ledger banking inside `page_in`/`_make_room` must
+    be atomic against ANY concurrent pager entry point (prefetch hints,
+    promotion reads, the thundering-herd stress in
+    tests/test_parallel_mesh.py) — interleaved make-room/adopt pairs
+    could both fit the budget alone and overshoot it together."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
 
 
 class ResidencyManager:
@@ -60,6 +78,9 @@ class ResidencyManager:
         self._fresh_bytes = None        # measured fresh-doc allocation
         self._reserved = 0              # round-scoped reservation ledger
         self._in_round = False
+        #: guards every tier transition + the reservation ledger (see
+        #: `_locked`); re-entrant so page_in -> _make_room -> demote nests
+        self._lock = threading.RLock()
         self.peak_resident_bytes = 0
         self.stats = {"page_ins": 0, "page_outs": 0, "prefetches": 0,
                       "hints": 0, "hits": 0, "misses": 0, "cold_ages": 0,
@@ -142,6 +163,7 @@ class ResidencyManager:
         frag = _bundle.peek(data).get("doc") or {}
         return dict(frag.get("clock") or {})
 
+    @_locked
     def before_round(self, deliveries: dict):
         """The demand-paging pass, called by `ShardedDocSet.deliver_round`
         BEFORE any routing/ingest: a stored doc with causally-READY work
@@ -194,6 +216,7 @@ class ResidencyManager:
         # _make_room call alone is a check, the ledger is the hold
         self._reserved += need
 
+    @_locked
     def after_round(self, deliveries: dict):
         """The bookkeeping half: touch the model for every doc the round
         actually reached, advance the pager clock, and run the aging
@@ -212,6 +235,7 @@ class ResidencyManager:
         self._make_room(0)
         self._age_pass()
 
+    @_locked
     def tick(self):
         """The pager heartbeat for rounds that arrive from a tick loop
         (SyncService.tick): advances the clock and ages warm bundles
@@ -289,6 +313,7 @@ class ResidencyManager:
             self.stats["placement_moves"] += 1
         return lanes[best]
 
+    @_locked
     def page_in(self, doc_id: str, protect=(), changes=None,
                 why: str = "demand"):
         """Promote a warm/cold doc back to device residency: make room
@@ -331,6 +356,7 @@ class ResidencyManager:
         self.model.note_touch(doc_id, self._round)
         return lane
 
+    @_locked
     def demote(self, doc_id: str) -> bool:
         """Hot -> warm: capture the doc as its checkpoint bundle at a
         commit boundary and release the device tables (the lane drops
@@ -353,6 +379,7 @@ class ResidencyManager:
         self.telemetry.observe_count("res", "page_outs")
         return True
 
+    @_locked
     def _make_room(self, need: int, protect=()):
         """Evict (demote) resident docs until ``resident + need`` fits
         the budget, targeting ``headroom * budget`` once eviction

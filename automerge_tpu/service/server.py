@@ -244,6 +244,10 @@ class SyncService:
         #: cross-region link states, lag-token gauges, and ladder
         #: transition counters alongside the service families
         self._federation = None
+        #: parallel tick executor (INTERNALS §24): lazily created when
+        #: tick pipelining is on and the bulk doc mesh does not already
+        #: carry a worker pool over the same lanes
+        self._tick_executor = None
         self.stats = {"ticks": 0, "admitted_msgs": 0, "admitted_ops": 0,
                       "admitted_bytes": 0, "deferrals": 0, "shed_total": 0,
                       "evictions": 0, "joins": 0, "rejoins": 0,
@@ -428,53 +432,12 @@ class SyncService:
             # columnar decode) per (room, doc) for the whole tick —
             # executed under the room's shard-lane device context when
             # the service is sharded, so every backend apply's device
-            # work lands on the lane that owns the room
-            for (room_id, doc_id), (changes, senders, frames) \
-                    in groups.items():
-                room = self._rooms.get(room_id)
-                if room is None:
-                    continue
-                lane = room.lane
-                ops0 = room.gate.stats["applied_ops"]
-                try:
-                    with (lane.device_ctx() if lane is not None
-                          else nullcontext()):
-                        if frames:
-                            # N tenants' binary frames for one doc:
-                            # combined columnar delivery — still ONE
-                            # backend apply, zero per-op Python on the
-                            # admissible path (dict prefix, if any,
-                            # applies first)
-                            room.gate.deliver_wire(
-                                doc_id, frames, changes=changes,
-                                senders=senders, validated=True)
-                        else:
-                            room.gate.deliver(doc_id, changes,
-                                              validated=True,
-                                              sender=senders)
-                except ProtocolError as exc:
-                    # the gate already salvaged every valid change and
-                    # parked/dropped the poison with per-sender stats;
-                    # the service just counts the rejection
-                    self.stats["protocol_errors"] += 1
-                    self._note("reject", doc=doc_id, error=str(exc)[:120])
-                    if obs.ENABLED:
-                        obs.event("svc", "reject",
-                                  args={"doc": doc_id,
-                                        "error": str(exc)[:120]})
-                if lane is not None:
-                    # the gate's applied-ops delta, NOT the delivered op
-                    # count: a premature change that parks costs this
-                    # lane nothing (it counts on the tick that drains
-                    # it), so the per-lane load series the rebalance
-                    # policy reads stays honest — measured even on the
-                    # salvage path, where valid changes still applied
-                    n_ops = room.gate.stats["applied_ops"] - ops0
-                    if n_ops:
-                        lane.stats["admitted_ops"] += n_ops
-                        self.telemetry.observe_count(
-                            "shard", f"lane{lane.index}_admitted_ops",
-                            n_ops)
+            # work lands on the lane that owns the room; with tick
+            # pipelining on (INTERNALS §24) the groups fan out to the
+            # lane workers concurrently, still inside the deferred-
+            # flush stack — the one-flush-per-room amortization is
+            # preserved at the barrier
+            self._deliver_groups(groups)
             # retransmission (may declare peers dead via on_dead)
             for sess in list(self._tenants.values()):
                 if not sess.pending_dead:
@@ -520,6 +483,212 @@ class SyncService:
             obs.span("svc", "tick", t0,
                      args={"tick": self._tick_no, "shed": shed,
                            "tenants": len(self._tenants)})
+
+    # -- parallel tick execution (INTERNALS §24) ------------------------
+
+    def _mesh_executor(self):
+        """The per-lane worker pool for the tick fan-out, or None when
+        tick pipelining is off / the service is unsharded. Shares the
+        bulk doc mesh's executor when the mesh rides the service's own
+        lanes (the sharded+residency wiring) — one pool, one set of
+        persistent workers, whichever tier fans out first."""
+        from ..shard.parallel import LaneExecutor, tick_pipeline_enabled
+        if not self._shard_lanes \
+                or not tick_pipeline_enabled(len(self._shard_lanes)):
+            return None
+        if self._doc_mesh is not None \
+                and self._doc_mesh.lanes \
+                and self._doc_mesh.lanes[0] is self._shard_lanes[0]:
+            ex = self._doc_mesh.executor()
+            if ex is not None:
+                return ex
+        if self._tick_executor is None:
+            self._tick_executor = LaneExecutor(self._shard_lanes,
+                                               telemetry=self.telemetry)
+        return self._tick_executor
+
+    def close(self):
+        """Retire the parallel workers (idempotent; an unsharded or
+        sequential service is a no-op). The service stays usable — a
+        later parallel tick recreates the pool."""
+        if self._tick_executor is not None:
+            self._tick_executor.close()
+            self._tick_executor = None
+        if self._doc_mesh is not None:
+            self._doc_mesh.close()
+
+    def _deliver_groups(self, groups: dict):
+        """Dispatch the tick's per-(room, doc) groups. The parallel leg
+        fans each touched lane's groups to that lane's worker (a room
+        belongs to exactly ONE lane, so workers never share gate/hub/
+        doc state) while the caller pre-decodes the NEXT tick's queued
+        frames; service-global stats fold after the barrier. The
+        sequential loop below is the parity comparator — identical
+        gate calls in identical per-lane order."""
+        ex = self._mesh_executor() if groups else None
+        if ex is not None:
+            by_lane: dict = {}
+            rest = []
+            for key, payload in groups.items():
+                room = self._rooms.get(key[0])
+                if room is None:
+                    continue
+                if room.lane is None:
+                    rest.append((key, room, payload))
+                else:
+                    by_lane.setdefault(room.lane.index, []).append(
+                        (key, room, payload))
+            if len(by_lane) > 1:
+                tasks = [ex.submit(idx, self._deliver_lane_groups, items)
+                         for idx, items in sorted(by_lane.items())]
+                ex.barrier(tasks, while_waiting=lambda:
+                           self._overlap_host_work(ex, tasks))
+                for task in tasks:
+                    self._fold_deliveries(task.result)
+                for key, room, payload in rest:
+                    self._deliver_one_group(key, room, payload)
+                return
+        for key, payload in groups.items():
+            room = self._rooms.get(key[0])
+            if room is None:
+                continue
+            self._deliver_one_group(key, room, payload)
+
+    def _deliver_one_group(self, key, room, payload):
+        """One (room, doc) group through the gate — the sequential leg,
+        kept verbatim from the pre-parallel tick."""
+        (_room_id, doc_id) = key
+        (changes, senders, frames) = payload
+        lane = room.lane
+        ops0 = room.gate.stats["applied_ops"]
+        try:
+            with (lane.device_ctx() if lane is not None
+                  else nullcontext()):
+                if frames:
+                    # N tenants' binary frames for one doc:
+                    # combined columnar delivery — still ONE
+                    # backend apply, zero per-op Python on the
+                    # admissible path (dict prefix, if any,
+                    # applies first)
+                    room.gate.deliver_wire(
+                        doc_id, frames, changes=changes,
+                        senders=senders, validated=True)
+                else:
+                    room.gate.deliver(doc_id, changes,
+                                      validated=True,
+                                      sender=senders)
+        except ProtocolError as exc:
+            # the gate already salvaged every valid change and
+            # parked/dropped the poison with per-sender stats;
+            # the service just counts the rejection
+            self.stats["protocol_errors"] += 1
+            self._note("reject", doc=doc_id, error=str(exc)[:120])
+            if obs.ENABLED:
+                obs.event("svc", "reject",
+                          args={"doc": doc_id,
+                                "error": str(exc)[:120]})
+        if lane is not None:
+            # the gate's applied-ops delta, NOT the delivered op
+            # count: a premature change that parks costs this
+            # lane nothing (it counts on the tick that drains
+            # it), so the per-lane load series the rebalance
+            # policy reads stays honest — measured even on the
+            # salvage path, where valid changes still applied
+            n_ops = room.gate.stats["applied_ops"] - ops0
+            if n_ops:
+                lane.stats["admitted_ops"] += n_ops
+                self.telemetry.observe_count(
+                    "shard", f"lane{lane.index}_admitted_ops",
+                    n_ops)
+
+    def _deliver_lane_groups(self, items) -> dict:
+        """Worker-side: one lane's groups in tick order, same gate
+        calls as `_deliver_one_group`. Only room-local state (gate,
+        docs, hub buffers, quarantine) is touched on the worker; every
+        service-global increment is RETURNED as a fold the caller
+        applies after the barrier (the per-worker delta discipline —
+        no lost updates on the shared stats dicts). The worker thread
+        already runs inside the lane's device context."""
+        fold = {"lane_ops": {}, "rejects": []}
+        for (_room_id, doc_id), room, (changes, senders, frames) in items:
+            ops0 = room.gate.stats["applied_ops"]
+            try:
+                if frames:
+                    room.gate.deliver_wire(
+                        doc_id, frames, changes=changes,
+                        senders=senders, validated=True)
+                else:
+                    room.gate.deliver(doc_id, changes, validated=True,
+                                      sender=senders)
+            except ProtocolError as exc:
+                fold["rejects"].append((doc_id, str(exc)[:120]))
+            n_ops = room.gate.stats["applied_ops"] - ops0
+            if n_ops:
+                idx = room.lane.index
+                fold["lane_ops"][idx] = \
+                    fold["lane_ops"].get(idx, 0) + n_ops
+        return fold
+
+    def _fold_deliveries(self, fold: dict):
+        """Apply one worker's returned deltas on the caller thread:
+        rejection counters + notes, and the per-lane admitted-ops
+        series the rebalance policy reads."""
+        for doc_id, err in fold["rejects"]:
+            self.stats["protocol_errors"] += 1
+            self._note("reject", doc=doc_id, error=err)
+            if obs.ENABLED:
+                obs.event("svc", "reject",
+                          args={"doc": doc_id, "error": err})
+        for idx, n_ops in fold["lane_ops"].items():
+            self._shard_lanes[idx].stats["admitted_ops"] += n_ops
+            self.telemetry.observe_count(
+                "shard", f"lane{idx}_admitted_ops", n_ops)
+
+    def _overlap_host_work(self, ex, tasks):
+        """The tick-pipelining seam: while tick t's grouped gate
+        deliveries drain on the lane workers, run the tick's REMAINING
+        pure-host decode work on the caller thread instead of after the
+        barrier. Two sources, cheapest-first:
+
+        - queued bulk-mesh rounds (``mesh_deliver`` backlog): their wire
+          payloads pre-decode through the mesh's identity-guarded cache
+          (`ShardedDocSet._predecode_round`, INTERNALS §24) — this tick
+          drains the backlog right after the barrier, so every decoded
+          batch is consumed within the tick;
+        - inbox binary frames whose columnar decode hasn't been forced
+          yet (in-process senders can hand over bare ``WireFrame``
+          objects; boundary traffic arrives pre-validated and is
+          skipped).
+
+        Opportunistic and drain-bounded: checks the lane tasks between
+        units of work, so it extends a tick by at most one decode."""
+        from ..engine.wire_format import WireFrame
+        n = 0
+        if self._doc_mesh is not None:
+            for deliveries in self._mesh_backlog:
+                n += self._doc_mesh._predecode_round(deliveries)
+                if all(t.done() for t in tasks):
+                    break
+        if not all(t.done() for t in tasks):
+            pending = []
+            for sess in self._tenants.values():
+                for msg, _nb, _no in sess.inbox:
+                    wire = msg.get("wire")
+                    if isinstance(wire, WireFrame) \
+                            and getattr(wire, "_batch", None) is None:
+                        pending.append(wire)
+            for wire in pending:
+                try:
+                    wire.batch()
+                    n += 1
+                except Exception:
+                    pass    # poison frames reject on their normal path
+                if all(t.done() for t in tasks):
+                    break
+        if n:
+            ex.stats["rounds_overlapped"] += 1
+            ex.stats["predecoded_batches"] += n
+            self.telemetry.observe_count("svc", "predecoded_frames", n)
 
     def _starve(self, sess: TenantSession):
         sess.starved_streak += 1
@@ -964,6 +1133,14 @@ class SyncService:
             # byte gauges, paging event counters, budget + peak, hit
             # rate, page-in dwell p99
             fams += self._residency.families("amtpu_residency")
+        mesh_ex = (self._doc_mesh._executor
+                   if self._doc_mesh is not None else None) \
+            or self._tick_executor
+        if mesh_ex is not None:
+            # parallel-execution families (INTERNALS §24): live worker
+            # count, per-lane round totals, rounds overlapped, barrier-
+            # wait histogram
+            fams += mesh_ex.families("amtpu_mesh")
         if lineage.ledger() is not None:
             # per-stage dwell histograms + end-to-end visibility
             # quantiles for the sampled change population (§18.3)

@@ -51,6 +51,11 @@ class ShardLane:
                       "docs_in": 0, "docs_out": 0,
                       "cross_planned_docs": 0, "index_merges": 0}
 
+    def stats_delta(self) -> dict:
+        """A zeroed per-round counter delta (same keys as ``stats``) for
+        the parallel executor's fold-at-the-barrier discipline."""
+        return dict.fromkeys(self.stats, 0)
+
     def device_ctx(self):
         """Every engine call for this lane runs inside this context, so
         staged arrays and kernel launches land on the lane's device."""
@@ -113,16 +118,24 @@ class ShardLane:
 
     # -- the commit path ------------------------------------------------
 
-    def ingest(self, deliveries: dict):
+    def ingest(self, deliveries: dict, stats: dict = None):
         """One serving round over this lane's touched docs:
         ``{doc_id: changes}`` (wire dicts or decoded columnar batches)
         executes as ONE stacked multi-object apply on the lane device
-        (`engine/stacked.apply_stacked` — per-round budget asserted),
-        falling back to the per-object engine exactly like the
-        single-device backend when the population is ineligible.
-        Returns the admitted wire-op count."""
+        (`engine/stacked.apply_stacked` — per-round budget asserted
+        against the stats dict THIS apply returned, never the module
+        global, so concurrent lanes assert race-free), falling back to
+        the per-object engine exactly like the single-device backend
+        when the population is ineligible. Returns the admitted wire-op
+        count. `stats` redirects the per-round counter increments into
+        a caller-owned delta dict — the parallel executor's per-worker
+        fold discipline (INTERNALS §24): a worker accumulates into its
+        task delta and the caller folds into ``self.stats`` at the
+        round barrier, so no increment is ever lost to a concurrent
+        writer."""
         if not deliveries:
             return 0
+        st_out = self.stats if stats is None else stats
         items = [(self.ensure_doc(doc_id), changes)
                  for doc_id, changes in deliveries.items()]
         n_ops = sum(_stacked._item_ops(subs) for _, subs in items)
@@ -130,15 +143,15 @@ class ShardLane:
         with self.device_ctx():
             st = _stacked.apply_stacked(items)
             if st:
-                self.stats["stacked_applies"] += 1
+                st_out["stacked_applies"] += 1
                 # cross-doc planning visibility (INTERNALS §16): how many
                 # of this lane's doc-rounds rode a shared admission
                 # template, and the bulk-merge count the budget bounds
                 cd = st.get("cross_doc")
                 if cd:
-                    self.stats["cross_planned_docs"] += cd.get(
+                    st_out["cross_planned_docs"] += cd.get(
                         "sched_shared", 0)
-                self.stats["index_merges"] += st.get("index_merges", 0)
+                st_out["index_merges"] += st.get("index_merges", 0)
                 if self.assert_budget:
                     _stacked.assert_round_budget(st)
             else:
@@ -147,9 +160,9 @@ class ShardLane:
                         doc.apply_batch(changes)
                     else:
                         doc.apply_changes(changes)
-                self.stats["per_object_applies"] += 1
-        self.stats["applies"] += 1
-        self.stats["admitted_ops"] += n_ops
+                st_out["per_object_applies"] += 1
+        st_out["applies"] += 1
+        st_out["admitted_ops"] += n_ops
         for doc_id, changes in deliveries.items():
             self.doc_ops[doc_id] = (self.doc_ops.get(doc_id, 0)
                                     + _stacked._item_ops(changes))

@@ -1,0 +1,287 @@
+"""Parallel mesh execution: persistent per-lane worker threads
+(INTERNALS §24).
+
+Every structural win since the stacked executor is dispatch-count
+accounting; this module converts them into wall-clock on a real mesh.
+A :class:`LaneExecutor` owns ONE persistent daemon worker thread per
+shard lane (the `PipelinedIngestor` thread/queue discipline, lifted
+from per-doc to per-lane): the router fans a serving round out on the
+caller thread, each touched lane's worker runs its stacked ingest
+concurrently under ``jax.default_device(lane.device)``, and a round
+barrier precedes every piece of commit-boundary work (quarantine drain
+to fixpoint, rebalancer policy, residency ``after_round`` + the
+reservation-ledger clear) — so the budget invariant and the migration
+pen semantics are untouched by parallelism.
+
+Safety argument (PAM's partition-parallel shape, PAPERS.md): placement
+gives every doc exactly ONE owning lane, so concurrent lane ingests
+never share doc state; the zero-collective audit proves no lane program
+ever names another lane's device. Shared sinks on the worker path are
+all already concurrency-safe (telemetry: lock-striped; lineage ledger:
+locked; byte/dispatch accounting: locked + `thread_snapshot`;
+device-truth registry: process-global lock). Everything else — the
+``ShardedDocSet.stats`` dict, residency, rebalance, placement — stays
+caller-thread-only, and per-lane ``ShardLane.stats`` increments ride a
+per-task delta dict folded at the barrier (no lost updates, and budget
+tests read race-free numbers).
+
+Flags (read per call, like ``stacked_rounds_enabled``):
+
+- ``AMTPU_PARALLEL_LANES`` — ``0`` forces the sequential loop (the
+  parity comparator, kept verbatim in ``ShardedDocSet``), ``1`` forces
+  workers on; unset defaults to ON when the mesh has more than one
+  lane.
+- ``AMTPU_TICK_PIPELINE`` — the service-tick fan-out + frame pre-decode
+  seam (service/server.py); defaults to the lane-worker setting.
+
+Acceptance is byte-identity: the parallel and sequential paths commit
+through the SAME `ShardLane.ingest` / `apply_stacked` code, differing
+only in which thread runs it, so capture bundles and texts cannot
+diverge; the flag-matrix parity suite (tests/test_parallel_mesh.py)
+asserts exactly that on randomized chaotic streams.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+
+from .. import obs
+
+
+def parallel_lanes_enabled(n_lanes: int) -> bool:
+    """Whether lane ingest rounds fan out to the worker pool.
+    ``AMTPU_PARALLEL_LANES``: ``0`` off, ``1`` on, unset → on iff the
+    mesh has more than one lane (a 1-lane mesh has nothing to overlap;
+    forcing ``1`` there stays correct and exercises the worker path)."""
+    raw = os.environ.get("AMTPU_PARALLEL_LANES", "").strip()
+    if raw == "0":
+        return False
+    if raw == "1":
+        return True
+    return n_lanes > 1
+
+
+def tick_pipeline_enabled(n_lanes: int) -> bool:
+    """Whether ``SyncService.tick()`` fans grouped gate deliveries out
+    per lane and pre-decodes the next tick's frames while device work
+    drains. Defaults to the lane-worker setting so one flag drives the
+    whole parallel tier; ``AMTPU_TICK_PIPELINE=0/1`` overrides."""
+    raw = os.environ.get("AMTPU_TICK_PIPELINE", "").strip()
+    if raw == "0":
+        return False
+    if raw == "1":
+        return True
+    return parallel_lanes_enabled(n_lanes)
+
+
+class _Task:
+    """One unit of lane work: a future the round barrier waits on."""
+
+    __slots__ = ("fn", "args", "kwargs", "lane_index", "result", "error",
+                 "_done", "queued_while_busy")
+
+    def __init__(self, lane_index, fn, args, kwargs):
+        self.lane_index = lane_index
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.result = None
+        self.error = None
+        self._done = threading.Event()
+        self.queued_while_busy = False
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self):
+        self._done.wait()
+
+
+_STOP = object()
+
+
+class _LaneWorker(threading.Thread):
+    """The persistent thread bound to one shard lane. Tasks run in
+    submission order (a lane's rounds are causally ordered — the queue
+    IS the per-lane pipeline); every task executes inside the lane's
+    device context so staged arrays and kernel launches land on the
+    lane's device, exactly like the caller-thread path."""
+
+    def __init__(self, lane, executor):
+        super().__init__(name=f"amtpu-lane{lane.index}", daemon=True)
+        self.lane = lane
+        self.executor = executor
+        self.tasks: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.busy = False          # caller-observed (GIL-atomic flag)
+        self.rounds = 0
+        # resolved ONCE (engine/pipeline.py, shared with the per-doc
+        # ring): the hot loop never re-imports jax per round
+        from ..engine.pipeline import device_ctx_factory
+        self._device_ctx = device_ctx_factory(lane.device)
+        self.start()
+
+    def run(self):
+        while True:
+            task = self.tasks.get()
+            if task is _STOP:
+                return
+            self.busy = True
+            _t0 = obs.now() if obs.ENABLED else 0
+            try:
+                with self._device_ctx():
+                    task.result = task.fn(*task.args, **task.kwargs)
+            except BaseException as exc:   # surfaced at the barrier
+                task.error = exc
+            finally:
+                self.rounds += 1
+                if obs.ENABLED:
+                    obs.span("lane", "round", _t0, args={
+                        "lane": self.lane.index,
+                        "worker": self.name,
+                        "error": task.error is not None})
+                self.busy = False
+                task._done.set()
+
+
+class LaneExecutor:
+    """The per-mesh worker pool: one persistent worker per lane,
+    ``submit`` + ``barrier``, per-round overlap counters, and the
+    ``amtpu_mesh_*`` exposition families."""
+
+    def __init__(self, lanes, telemetry=None):
+        self.telemetry = telemetry
+        self.stats = {"submitted": 0, "completed": 0, "barriers": 0,
+                      "rounds_overlapped": 0, "predecoded_batches": 0,
+                      "errors": 0}
+        self._closed = False
+        self._workers = {lane.index: _LaneWorker(lane, self)
+                         for lane in lanes}
+
+    # -- dispatch -------------------------------------------------------
+
+    def submit(self, lane_index: int, fn, *args, **kwargs) -> _Task:
+        """Queue one unit of work on `lane_index`'s worker. Returns the
+        task future the round barrier waits on. Tasks for one lane run
+        in submission order; tasks for different lanes run
+        concurrently."""
+        if self._closed:
+            raise RuntimeError("LaneExecutor is closed")
+        w = self._workers[lane_index]
+        task = _Task(lane_index, fn, args, kwargs)
+        task.queued_while_busy = w.busy
+        self.stats["submitted"] += 1
+        w.tasks.put(task)
+        return task
+
+    def barrier(self, tasks, while_waiting=None) -> list:
+        """The round barrier: wait for EVERY task (commit-boundary work
+        must never observe a half-ingested round), then re-raise the
+        first worker error on the caller thread — after all workers
+        quiesced, so an assert in one lane cannot leave another lane's
+        ingest racing the caller's unwind. `while_waiting` is the
+        host/device overlap seam: pure host work (next-round decode)
+        the caller runs before blocking."""
+        if while_waiting is not None:
+            while_waiting()
+        t0 = time.perf_counter_ns()
+        for task in tasks:
+            task.wait()
+        wait_ns = time.perf_counter_ns() - t0
+        self.stats["barriers"] += 1
+        self.stats["completed"] += len(tasks)
+        if self.telemetry is not None:
+            # the barrier-wait histogram the amtpu_mesh_* families export:
+            # how long the caller thread stalls on the slowest lane
+            # (overlap work excluded — it ran before the block above)
+            self.telemetry.observe_span("mesh", "barrier_wait", wait_ns)
+        if obs.ENABLED:
+            obs.span("mesh", "barrier_wait", t0, args={
+                "tasks": len(tasks)}, t1_ns=t0 + wait_ns)
+        for task in tasks:
+            if task.error is not None:
+                self.stats["errors"] += 1
+                raise task.error
+        return [task.result for task in tasks]
+
+    # -- lifecycle ------------------------------------------------------
+
+    @property
+    def n_workers(self) -> int:
+        return len(self._workers)
+
+    def close(self):
+        """Stop every worker (idempotent). Pending tasks drain first —
+        the stop sentinel queues BEHIND them, so close at a commit
+        boundary never abandons an in-flight round."""
+        if self._closed:
+            return
+        self._closed = True
+        for w in self._workers.values():
+            w.tasks.put(_STOP)
+        for w in self._workers.values():
+            w.join(timeout=10.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- exposition -----------------------------------------------------
+
+    def describe(self) -> dict:
+        return {
+            "schema": "amtpu-mesh-exec-v1",
+            "workers": {i: {"alive": w.is_alive(), "rounds": w.rounds}
+                        for i, w in sorted(self._workers.items())},
+            "stats": dict(self.stats),
+        }
+
+    def families(self, prefix: str = "amtpu_mesh") -> list:
+        """Prometheus exposition families (SyncService.scrape appends
+        these next to the service families): worker count, per-worker
+        round totals, rounds overlapped (host planning of round t+1
+        under round t's device drain), and the barrier-wait
+        histogram."""
+        fams = [
+            (f"{prefix}_workers", "gauge",
+             "Persistent lane worker threads (one per shard lane; 0 "
+             "when parallel execution is off).",
+             [({}, sum(w.is_alive() for w in self._workers.values()))]),
+            (f"{prefix}_rounds_total", "counter",
+             "Lane ingest rounds executed per worker.",
+             [({"lane": str(i)}, w.rounds)
+              for i, w in sorted(self._workers.items())]),
+            (f"{prefix}_rounds_overlapped_total", "counter",
+             "Rounds whose next-round host planning (wire decode / "
+             "columnar build) overlapped the in-flight device leg.",
+             [({}, self.stats["rounds_overlapped"])]),
+            (f"{prefix}_barriers_total", "counter",
+             "Round barriers taken (one per fanned-out round).",
+             [({}, self.stats["barriers"])]),
+        ]
+        if self.telemetry is not None:
+            from ..obs.telemetry import N_BUCKETS, bucket_le_ns
+            hists, aggs = self.telemetry.span_view()
+            key = ("mesh", "barrier_wait")
+            if key in hists:
+                buckets = hists[key]
+                agg = aggs.get(key, {"count": 0, "total_ns": 0})
+                samples, cum = [], 0
+                for i in range(N_BUCKETS + 1):
+                    cum += buckets[i]
+                    le = bucket_le_ns(i) / 1e9
+                    samples.append((("_bucket", {
+                        "le": "+Inf" if le == float("inf") else repr(le)}),
+                        cum))
+                samples.append((("_sum", {}), agg["total_ns"] / 1e9))
+                samples.append((("_count", {}), agg["count"]))
+                fams.append((
+                    f"{prefix}_barrier_wait_seconds", "histogram",
+                    "Caller-thread stall at the round barrier (time to "
+                    "the slowest lane), log2 buckets fed at emit time.",
+                    samples))
+        return fams

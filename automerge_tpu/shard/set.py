@@ -89,10 +89,35 @@ class ShardedDocSet:
         self._migrating: dict = {}      # doc_id -> [parked deliveries]
         self.rebalancer = None          # attach_rebalancer installs one
         self.residency = None           # attach_residency installs one
+        self._executor = None           # lazy LaneExecutor (parallel.py)
+        self._predecoded: dict = {}     # doc_id -> (src changes, batch)
         self.stats = {"rounds": 0, "admitted_ops": 0, "parked": 0,
                       "released": 0, "migrations": 0,
                       "migrations_deferred": 0, "migration_parked": 0,
                       "peak_parked": 0}
+
+    # -- parallel execution (INTERNALS §24) -----------------------------
+
+    def executor(self):
+        """The per-lane worker pool when parallel mesh execution is on
+        (``AMTPU_PARALLEL_LANES`` — read per call so tests flip the
+        flag mid-process), else None. Workers are persistent: created
+        on first parallel round, reused until :meth:`close`."""
+        from .parallel import LaneExecutor, parallel_lanes_enabled
+        if not parallel_lanes_enabled(self.n_shards):
+            return None
+        if self._executor is None:
+            self._executor = LaneExecutor(self.lanes,
+                                          telemetry=self.telemetry)
+        return self._executor
+
+    def close(self):
+        """Retire the worker pool (idempotent; a mesh without one is a
+        no-op). Safe at any commit boundary — pending lane tasks drain
+        before the workers exit."""
+        if self._executor is not None:
+            self._executor.close()
+            self._executor = None
 
     # -- introspection --------------------------------------------------
 
@@ -129,6 +154,8 @@ class ShardedDocSet:
                            if len(q)},
             "migrating": sorted(self._migrating),
             "stats": dict(self.stats),
+            **({"mesh_exec": self._executor.describe()}
+               if self._executor is not None else {}),
             **({"residency": self.residency.describe()}
                if self.residency is not None else {}),
         }
@@ -187,14 +214,71 @@ class ShardedDocSet:
         """Single-doc convenience wrapper over :meth:`deliver_round`."""
         return self.deliver_round({doc_id: changes})
 
-    def deliver_round(self, deliveries: dict) -> int:
+    def deliver_rounds(self, rounds) -> int:
+        """Serve a queued sequence of rounds with the lane-level round
+        pipelining seam (INTERNALS §24): while the lane workers execute
+        round t's device leg, the caller pre-decodes round t+1's wire
+        payloads into columnar batches — the state-independent half of
+        host planning (``_decode_wire`` reads only the payload and the
+        doc's id), extending the PR-2/4 `PipelinedIngestor` chaining
+        discipline from per-doc to per-lane. Admission (the state-
+        dependent half) still runs in round order on the caller thread,
+        and a batch only substitutes for its source list when the round
+        admits it whole and in order — byte-identical to the sequential
+        path by construction. With parallel execution off this is a
+        plain :meth:`deliver_round` loop."""
+        rounds = list(rounds)
+        total = 0
+        try:
+            for i, chunk in enumerate(rounds):
+                nxt = rounds[i + 1] if i + 1 < len(rounds) else None
+                total += self.deliver_round(
+                    chunk, _next_round=nxt if nxt else None)
+        finally:
+            # anything pre-decoded but never routed (an aborted run, a
+            # doc that migrated away) must not outlive the sequence
+            self._predecoded.clear()
+        return total
+
+    def _predecode_round(self, deliveries: dict) -> int:
+        """Decode the next round's wire payloads (pure host: columnar
+        batch build, cached per delivery list) — the work the executor
+        overlaps with the in-flight round. Only docs that are already
+        materialized and unambiguous (not migrating, not demoted to the
+        store) pre-decode; everything else decodes in-round exactly as
+        before."""
+        n = 0
+        for doc_id, changes in deliveries.items():
+            if doc_id in self._predecoded or doc_id in self._migrating:
+                continue
+            if not isinstance(changes, list) or not changes \
+                    or not all(isinstance(c, dict) for c in changes):
+                continue
+            if self.residency is not None \
+                    and doc_id in self.residency.store:
+                continue
+            doc = self.lane_of(doc_id).docs.get(doc_id)
+            if doc is None:
+                continue
+            try:
+                batch = doc._decode_wire(changes)
+            except Exception:
+                continue    # poison payloads fail in-round, as before
+            self._predecoded[doc_id] = (changes, batch)
+            n += 1
+        return n
+
+    def deliver_round(self, deliveries: dict, _next_round: dict = None) \
+            -> int:
         """One serving round: route ``{doc_id: [wire changes]}`` across
         the lanes (ready changes grouped into ONE stacked apply per
         touched lane), park premature changes in the per-doc quarantine,
         pen deliveries for migrating docs, then drain every quarantine
         the round unblocked. Returns the admitted wire-op count. The end
         of the round is a commit boundary: the attached rebalancer (if
-        any) runs its policy here."""
+        any) runs its policy here. `_next_round` is
+        :meth:`deliver_rounds`' pipelining seam — the following round's
+        deliveries, pre-decoded while this round's lane work drains."""
         _t0 = obs.now() if obs.ENABLED else 0
         if self.residency is not None:
             # the demand-paging gate: stored docs this round touches
@@ -203,6 +287,9 @@ class ShardedDocSet:
             self.residency.before_round(deliveries)
         per_lane: dict = {}
         for doc_id, changes in deliveries.items():
+            pre = self._predecoded.pop(doc_id, None) \
+                if self._predecoded else None
+            orig = changes
             changes = list(changes)
             if doc_id in self._migrating:
                 # the migration pen: the doc has no owner this instant —
@@ -233,12 +320,17 @@ class ShardedDocSet:
                 # budget-aware placement — re-resolve the owner
                 lane = self.lane_of(doc_id)
             if ready:
+                if (pre is not None and not premature
+                        and pre[0] is orig and len(ready) == len(changes)
+                        and all(a is b for a, b in zip(ready, changes))):
+                    # the whole delivery admitted, in arrival order: the
+                    # pre-decoded batch IS what apply_stacked would have
+                    # decoded in-round (same decoder, same payload) —
+                    # hand the lane the batch, skipping the in-round
+                    # decode the overlap already paid for
+                    ready = pre[1]
                 per_lane.setdefault(lane.index, {})[doc_id] = ready
-        admitted = 0
-        for idx in sorted(per_lane):
-            admitted += self.lanes[idx].ingest(per_lane[idx])
-            if lineage.ENABLED:
-                self._hop_committed(idx, per_lane[idx])
+        admitted = self._ingest_per_lane(per_lane, _next_round)
         admitted += self._drain_quarantine()
         self.stats["rounds"] += 1
         self.stats["admitted_ops"] += admitted
@@ -249,6 +341,65 @@ class ShardedDocSet:
             self.residency.after_round(deliveries)
         if self.rebalancer is not None:
             self.rebalancer.maybe_rebalance()
+        return admitted
+
+    def _ingest_per_lane(self, per_lane: dict, next_round: dict = None) \
+            -> int:
+        """Fan one routed round out across its touched lanes. With
+        parallel execution on (shard/parallel.py) every touched lane's
+        worker runs its stacked ingest concurrently and the caller
+        pre-decodes `next_round` while the device legs drain; the
+        sequential loop below is kept verbatim as the parity
+        comparator. Either way the return is the round's admitted
+        wire-op count and the caller resumes at a full barrier."""
+        if not per_lane:
+            return 0
+        ex = self.executor()
+        if ex is not None:
+            return self._ingest_parallel(ex, per_lane, next_round)
+        admitted = 0
+        for idx in sorted(per_lane):
+            admitted += self.lanes[idx].ingest(per_lane[idx])
+            if lineage.ENABLED:
+                self._hop_committed(idx, per_lane[idx])
+        return admitted
+
+    def _ingest_parallel(self, ex, per_lane: dict,
+                         next_round: dict = None) -> int:
+        """The concurrent leg: one task per touched lane on its
+        persistent worker, per-worker stats deltas folded at the round
+        barrier (no lost updates), lineage commit hops emitted
+        caller-thread after the barrier (deterministic order). A worker
+        error (budget assert included) re-raises on the caller AFTER
+        every lane quiesced — completed lanes' stats still fold, like
+        the sequential loop's partial progress."""
+        tasks = []
+        for idx in sorted(per_lane):
+            lane = self.lanes[idx]
+            delta = lane.stats_delta()
+            tasks.append((idx, delta, ex.submit(
+                idx, lane.ingest, per_lane[idx], stats=delta)))
+        overlap = None
+        if next_round:
+            def overlap():
+                n = self._predecode_round(next_round)
+                if n:
+                    ex.stats["rounds_overlapped"] += 1
+                    ex.stats["predecoded_batches"] += n
+        try:
+            ex.barrier([t for _, _, t in tasks], while_waiting=overlap)
+        finally:
+            for idx, delta, task in tasks:
+                if task.error is None and task.done():
+                    lane_stats = self.lanes[idx].stats
+                    for k, v in delta.items():
+                        if v:
+                            lane_stats[k] += v
+        admitted = 0
+        for idx, delta, task in tasks:
+            admitted += task.result
+            if lineage.ENABLED:
+                self._hop_committed(idx, per_lane[idx])
         return admitted
 
     def _drain_quarantine(self) -> int:
@@ -294,10 +445,12 @@ class ShardedDocSet:
                     if lineage.ENABLED:
                         lineage.hop_delivery(ready, "quar/release",
                                              site="router", doc=doc_id)
-            for idx in sorted(per_lane):
-                admitted += self.lanes[idx].ingest(per_lane[idx])
-                if lineage.ENABLED:
-                    self._hop_committed(idx, per_lane[idx])
+            if per_lane:
+                # releases ride the same fan-out as the round proper
+                # (parallel when enabled, the verbatim sequential loop
+                # otherwise); each fixpoint iteration barriers before
+                # re-judging clocks, so causal ordering is untouched
+                admitted += self._ingest_per_lane(per_lane)
                 progress = True
         return admitted
 

@@ -3005,6 +3005,280 @@ def main_sharded():
     return 0
 
 
+PARALLEL_TIMED_REGION = (
+    "parallel mesh execution A/B (automerge_tpu/shard/parallel, "
+    "INTERNALS §24): the SAME mesh size and the SAME pre-generated "
+    "map-population change stream served with the per-lane worker "
+    "threads ON (AMTPU_PARALLEL_LANES=1 — router fan-out on the caller, "
+    "each touched lane's stacked ingest on its persistent worker under "
+    "the lane's device context, round barrier before commit-boundary "
+    "work, round t+1's wire payloads pre-decoded while round t's device "
+    "leg drains) vs OFF (the verbatim sequential lane loop — the parity "
+    "comparator). Both legs run deliver_rounds over fresh meshes; dt "
+    "spans routing + host planning + lane dispatch + the stacked syncs "
+    "for all rounds of one rep, closed by one block_until_ready barrier "
+    "over every lane's tables (identical both legs; deliveries are "
+    "synthesized before the clock starts). value = the parallel leg's "
+    "aggregate admitted wire ops/s, median of >= 5 recorded reps after "
+    "untimed warmup, gc collected between reps and disabled inside the "
+    "timed region both legs, 3-attempt PAIRED contention discipline "
+    "(best paired attempt, never best-of mixed). Byte-identity asserted "
+    "in-run before the row emits: a deterministic doc sample's capture "
+    "bundles and every lane's counters identical across the legs. The "
+    "1.5x speedup bar holds only where the hardware can pay it: lane "
+    "workers are host threads, so the bar is asserted on >= 4-core "
+    "hosts (n_cores recorded; 1-core boxes record the honest ratio and "
+    "the gate treats the bar as not-applicable, mirroring cfg12's "
+    "8-device gating — virtual cpu devices share the host cores, "
+    "SHARDING_r5).")
+
+
+def measure_parallel_mesh(n_shards: int = None, docs_per_shard: int = 256,
+                          capacity: int = 512, ops_per_doc: int = 2,
+                          n_rounds: int = 3, reps: int = None,
+                          quick: bool = False) -> dict:
+    """The cfg20 headline: the same mesh + stream with the per-lane
+    workers on vs off (INTERNALS §24.5). Machine checks: byte-identical
+    sample captures + lane counters across the legs (every attempt);
+    executor engaged with overlap rounds > 0 on the parallel leg; every
+    stacked lane apply within the dispatch budget (asserted inside
+    `ShardLane.ingest`, per-lane, against the stats dict its own apply
+    returned); commit-path HLO collective-free; zero steady-state
+    recompiles on both legs."""
+    import gc
+
+    import jax as _jax
+
+    from automerge_tpu.obs import device_truth
+    from automerge_tpu.shard import ShardedDocSet
+    from automerge_tpu.shard.audit import commit_path_collectives
+
+    devices = _jax.devices()
+    if n_shards is None:
+        try:
+            n_shards = int(os.environ.get("AMTPU_SHARDS", "0")) or \
+                len(devices)
+        except ValueError:
+            n_shards = len(devices)
+    if quick:
+        docs_per_shard, capacity = 8, 256
+        ops_per_doc = max(ops_per_doc, 8)
+    elif n_shards < 2:
+        raise RuntimeError(
+            "cfg20 needs a multi-lane mesh at full scale; run the cpu "
+            "dryrun with XLA_FLAGS=--xla_force_host_platform_device_"
+            "count=8 (scripts/chip_session.sh cfg20_parallel does)")
+    reps = max(5, bench_reps(5) if reps is None else reps)
+    warmup = 1 if quick else 2
+    key_space = 64
+    n_docs = n_shards * docs_per_shard
+    doc_ids = [f"pmdoc-{i:05d}" for i in range(n_docs)]
+    sample = doc_ids[::max(1, n_docs // 32)]
+
+    def leg(flag: str):
+        prior = os.environ.get("AMTPU_PARALLEL_LANES")
+        os.environ["AMTPU_PARALLEL_LANES"] = flag
+        mesh = ShardedDocSet(n_shards=n_shards, devices=devices,
+                             doc_kind="map", capacity=capacity)
+        gc_was = gc.isenabled()
+        try:
+            # seeding round: every doc materialized, the full key space
+            # interned — measured reps never change a plan shape
+            mesh.deliver_round(_sharded_map_round(
+                doc_ids, 1, key_space, key_space))
+            streams = [
+                [_sharded_map_round(doc_ids, 2 + rep * n_rounds + r,
+                                    key_space, ops_per_doc)
+                 for r in range(n_rounds)]
+                for rep in range(warmup + reps)]
+
+            def rep(rounds):
+                gc.collect()
+                gc.disable()
+                n = 0
+                t0 = time.perf_counter()
+                with obs.span_ctx("bench", "parallel_stream",
+                                  args={"parallel": flag}):
+                    n += mesh.deliver_rounds(rounds)
+                    tables = [arr for lane in mesh.lanes
+                              for doc in lane.docs.values()
+                              for arr in doc._ensure_dev().values()]
+                    _jax.block_until_ready(tables)
+                dt = time.perf_counter() - t0
+                if gc_was:
+                    gc.enable()
+                return n, n / dt
+
+            for rounds in streams[:warmup]:
+                admitted, _ = rep(rounds)
+            rates = []
+            # the steady-state window opens AFTER seeding + warmup (a
+            # fresh mesh's first stream compiles legitimately); inside
+            # it, any compile is bucket churn and fails the run
+            with device_truth.steady_state() as ss:
+                for rounds in streams[warmup:]:
+                    admitted, rate = rep(rounds)
+                    rates.append(rate)
+            captures = {d: mesh.capture(d) for d in sample}
+            lane_stats = [dict(lane.stats) for lane in mesh.lanes]
+            ex_stats = dict(mesh._executor.stats) \
+                if mesh._executor is not None else None
+            return {
+                "rates": rates, "ops_per_rep": admitted,
+                "captures": captures, "lane_stats": lane_stats,
+                "executor": ex_stats,
+                "recompiles": sum(ss.recompiles.values()),
+            }
+        finally:
+            if gc_was:
+                gc.enable()
+            mesh.close()
+            if prior is None:
+                os.environ.pop("AMTPU_PARALLEL_LANES", None)
+            else:
+                os.environ["AMTPU_PARALLEL_LANES"] = prior
+
+    # PR-4/PR-12/PR-17 3-attempt contention discipline: the speedup bar
+    # compares two host-thread schedules on a shared box, so one gc or
+    # scheduler swing must not fail it — the best PAIRED attempt is
+    # recorded, never a best-of mixed across attempts
+    par = seq = None
+    best_key = None
+    attempts = 0
+    for _attempt in range(3):
+        attempts += 1
+        par_try = leg("1")
+        seq_try = leg("0")
+        # parity and steady-state are hard invariants, not contention
+        # artifacts: asserted on EVERY attempt before any speedup question
+        assert par_try["recompiles"] == 0 == seq_try["recompiles"], (
+            "recompiles inside the steady-state window",
+            par_try["recompiles"], seq_try["recompiles"])
+        assert par_try["captures"] == seq_try["captures"], (
+            "parallel capture bundles diverged from sequential")
+        assert par_try["lane_stats"] == seq_try["lane_stats"], (
+            "per-lane counters diverged across the legs",
+            par_try["lane_stats"], seq_try["lane_stats"])
+        par_med = _median(par_try["rates"])
+        seq_med = _median(seq_try["rates"])
+        speedup_try = par_med / max(seq_med, 1e-9)
+        key = (not speedup_try >= 0.95, -speedup_try)
+        if best_key is None or key < best_key:
+            best_key = key
+            par, seq = par_try, seq_try
+        if speedup_try >= 1.0:
+            break
+    par_med, seq_med = _median(par["rates"]), _median(seq["rates"])
+    speedup = round(par_med / max(seq_med, 1e-9), 3)
+    n_cores = os.cpu_count() or 1
+
+    # --- machine checks -------------------------------------------------
+    assert len(par["rates"]) == reps and len(seq["rates"]) == reps
+    ex = par["executor"]
+    assert ex is not None and ex["errors"] == 0, ex
+    assert ex["submitted"] == ex["completed"] > 0, ex
+    assert ex["barriers"] > 0, ex
+    assert ex["rounds_overlapped"] > 0 and ex["predecoded_batches"] > 0, (
+        "the round-pipelining overlap seam never engaged", ex)
+    assert seq["executor"] is None, (
+        "the sequential comparator fanned out", seq["executor"])
+    assert sum(ls["stacked_applies"] for ls in par["lane_stats"]) > 0
+    audit = commit_path_collectives()
+    collective_total = sum(sum(v.values()) for v in audit.values())
+    assert collective_total == 0, (
+        f"commit-path HLO contains collectives: {audit}")
+    recompiles = par["recompiles"] + seq["recompiles"]
+
+    from datetime import datetime, timezone
+    platform = devices[0].platform
+    rec = {
+        "metric": "cfg20_parallel_mesh_aggregate_ops_per_sec",
+        "value": round(par_med),
+        "unit": "ops/s",
+        "vs_baseline": round(par_med / TARGET_OPS_PER_SEC, 4),
+        "threshold": (
+            "asserted in code: byte-identical sample capture bundles + "
+            "per-lane counters across AMTPU_PARALLEL_LANES on EVERY "
+            "paired attempt; executor engaged (submitted == completed, "
+            "zero worker errors) with rounds_overlapped > 0 and "
+            "pre-decoded batches consumed; every stacked lane apply "
+            "within the per-round dispatch budget (asserted per lane on "
+            "the worker, against the stats dict its own apply "
+            "returned); commit-path HLO compiled with ZERO collectives; "
+            "zero steady-state recompiles across the paired attempts. "
+            "Acceptance bar: parallel >= 1.5x sequential aggregate "
+            "ops/s, asserted in-run on >= 4-core hosts (n_cores "
+            "recorded; the workers are host threads, so a 1-core box "
+            "records the honest ratio and the bar is not applicable — "
+            "re-checked by slo_gate on every committed >= 4-core row)"),
+        "timed_region": PARALLEL_TIMED_REGION,
+        "n_shards": n_shards,
+        "n_devices": len(devices),
+        "n_cores": n_cores,
+        "n_docs": n_docs,
+        "docs_per_shard": docs_per_shard,
+        "rounds_per_rep": n_rounds,
+        "ops_per_doc_per_round": ops_per_doc,
+        "ops_per_rep": par["ops_per_rep"],
+        "n_reps": reps,
+        "warmup_reps": warmup,
+        "attempts": attempts,
+        "reps_ops_per_sec": [round(r) for r in par["rates"]],
+        "value_spread_pct": round(_spread_pct(par["rates"]), 1),
+        "sequential_ops_per_sec": round(seq_med),
+        "sequential_reps": [round(r) for r in seq["rates"]],
+        "sequential_spread_pct": round(_spread_pct(seq["rates"]), 1),
+        "parallel_speedup_vs_sequential": speedup,
+        "speedup_bar_applicable": bool(not quick and n_cores >= 4),
+        "executor": ex,
+        "parallel_applies": {
+            "stacked": sum(ls["stacked_applies"]
+                           for ls in par["lane_stats"]),
+            "per_object": sum(ls["per_object_applies"]
+                              for ls in par["lane_stats"])},
+        "capacity": capacity,
+        "sample_docs": len(sample),
+        "collective_audit": audit,
+        "zero_collectives": collective_total == 0,
+        "recompiles": recompiles,
+        "platform": platform,
+        "recorded_at_utc": datetime.now(timezone.utc).isoformat(),
+    }
+    assert rec["value"] == round(_median(rec["reps_ops_per_sec"])), rec
+    if not quick and n_cores >= 4:
+        # the ISSUE-20 acceptance bar, asserted where it is defined: a
+        # host with real cores for the lane workers to run on
+        assert speedup >= 1.5, (
+            f"parallel mesh only {speedup:.2f}x the sequential leg on a "
+            f"{n_cores}-core host (bar: 1.5x): {rec['metric']}")
+    if not quick:
+        from benchmarks.common import headline_cpu_floor
+        headline_cpu_floor(rec, "cfg20_" + rec["metric"])
+    return rec
+
+
+def main_parallel():
+    """`bench.py --parallel`: the cfg20 parallel-mesh A/B entry point
+    (append to the committed session log with ``--session`` — cpu
+    dryrun rows are first-class: the speedup bar is defined on >= 4-core
+    hosts, and sub-4-core rows record the honest gated ratio)."""
+    from benchmarks.common import preflight_device
+    budget = float(os.environ.get("AMTPU_PREFLIGHT_BUDGET_S", "420"))
+    if not preflight_device(total_budget_s=budget, allow_cpu=True):
+        print("bench.py --parallel: no reachable jax device — refusing "
+              "to hang", file=sys.stderr)
+        return 3
+    if trace_requested():
+        obs.enable()
+    rec = measure_parallel_mesh(quick="--quick" in sys.argv)
+    if trace_requested():
+        write_bench_trace(rec)
+    print(json.dumps(rec))
+    if is_chip_platform(rec["platform"]) or "--session" in sys.argv:
+        append_session_log(rec)
+    return 0
+
+
 def trace_requested() -> bool:
     """`--trace` (or AMTPU_TRACE=1): record the whole run in the obs
     flight recorder and dump Perfetto-loadable Chrome trace JSON.
@@ -3237,6 +3511,8 @@ if __name__ == "__main__":
         sys.exit(main_text_prepare())
     if "--learned" in sys.argv:
         sys.exit(main_learned())
+    if "--parallel" in sys.argv:
+        sys.exit(main_parallel())
     sys.exit(main_pipeline()
              if ("--pipeline" in sys.argv or "--quick" in sys.argv)
              else main())

@@ -563,6 +563,60 @@ def config19_learned_index(quick: bool = False,
          threshold=rec["threshold"])
 
 
+def config20_parallel(quick: bool = False, record_session: bool = False):
+    """Parallel mesh execution A/B (ISSUE 20, INTERNALS §24): the cfg20
+    row — the SAME mesh size + map-population stream with the per-lane
+    worker threads ON vs OFF (AMTPU_PARALLEL_LANES), byte-identical
+    sample captures + per-lane counters asserted across the legs on
+    every paired attempt, the overlap seam asserted engaged, the
+    zero-collective audit and zero steady-state recompiles asserted
+    in-run, and the 1.5x speedup bar asserted on >= 4-core hosts
+    (n_cores is recorded; 1-core boxes record the honest ratio).
+    Subprocess with the scrubbed 8-virtual-cpu-device env for the same
+    reason as cfg12 (XLA_FLAGS must predate jax init); ``--session``
+    appends the honest row to BENCH_SESSIONS.jsonl."""
+    import os
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "AMTPU_SKIP_PREFLIGHT": "1",
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                         + " --xla_force_host_platform_device_count=8"
+                         ).strip()}
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never init the tunnel plugin
+    env.pop("AMTPU_PARALLEL_LANES", None)   # the bench drives the flag
+    cmd = [sys.executable, os.path.join(root, "bench.py"), "--parallel"]
+    if quick:
+        cmd.append("--quick")
+    if record_session:
+        cmd.append("--session")
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=root,
+                         env=env, timeout=3000)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"cfg20 parallel-mesh bench failed rc={out.returncode}: "
+            f"{out.stderr[-800:]}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    emit("cfg20_parallel_mesh_aggregate_ops_per_sec", rec["value"],
+         "ops/s",
+         n_shards=rec["n_shards"], n_docs=rec["n_docs"],
+         n_cores=rec["n_cores"],
+         sequential_ops_per_sec=rec["sequential_ops_per_sec"],
+         parallel_speedup_vs_sequential=rec[
+             "parallel_speedup_vs_sequential"],
+         speedup_bar_applicable=rec["speedup_bar_applicable"],
+         value_spread_pct=rec["value_spread_pct"],
+         executor=rec["executor"],
+         zero_collectives=rec["zero_collectives"],
+         recompiles=rec["recompiles"],
+         measured_platform=rec["platform"],
+         threshold=rec["threshold"])
+    if record_session:
+        print(f"# cfg20 session row appended by bench.py --parallel "
+              f"--session (platform {rec['platform']})", file=sys.stderr)
+
+
 def config13_wire(quick: bool = False, record_session: bool = False):
     """Binary columnar wire A/B at service scale (ISSUE 13, INTERNALS
     §17): the cfg13 row — dict vs AMTPUWIRE1 frames on the SAME seeded
@@ -1674,6 +1728,10 @@ def main():
         # the chip_session.sh cfg19 step: ONLY the learned-index A/B row
         config19_learned_index(quick=quick, record_session=True)
         return
+    if "--parallel-session" in sys.argv:
+        # the chip_session.sh cfg20 step: ONLY the parallel-mesh A/B row
+        config20_parallel(quick=quick, record_session=True)
+        return
     record_round = None
     record_path = None
     if "--record" in sys.argv:
@@ -1764,6 +1822,7 @@ def main():
         lambda: config17_fused(quick=quick),
         lambda: config18_residency(quick=quick),
         lambda: config19_learned_index(quick=quick),
+        lambda: config20_parallel(quick=quick),
     ]
     if record_path is not None:
         steps.insert(0, fold_headline)

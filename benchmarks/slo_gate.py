@@ -94,6 +94,12 @@ SLOS = [
     # carries no bar of its own — the hard guarantees are the absolute
     # rules below)
     ("cfg19_learned_index", "value", "min", 0.8),
+    # ISSUE 20: parallel-mesh rows — throughput floor on the parallel
+    # leg of the lane-worker A/B (the leg AMTPU_PARALLEL_LANES ships on
+    # by default on multi-lane meshes; the sequential comparator leg is
+    # recorded alongside but carries no bar of its own — the speedup bar
+    # is the gated absolute rule below)
+    ("cfg20_parallel_mesh", "value", "min", 0.8),
 ]
 
 #: Absolute SLOs: (metric_prefix, dotted field, op, bound) checked on
@@ -172,6 +178,19 @@ ABS_SLOS = [
     # (both also asserted in-run before the row is emitted)
     ("cfg19_learned_index", "rank_resolve_s", "<=", 0.36),
     ("cfg19_learned_index", "model_wrong_answers", "<=", 0),
+    # the ISSUE-20 acceptance bars on every committed cfg20 row,
+    # forever: the parallel commit path stays communication-free (the
+    # same zero-collective invariant as cfg12 — the workers change which
+    # THREAD dispatches a lane's program, never which device it names),
+    # compiles nothing at steady state on either leg, and beats the
+    # paired sequential comparator >= 1.5x wherever the hardware can pay
+    # it — the speedup field is DERIVED gated on the row's recorded
+    # n_cores (lane workers are host threads; a sub-4-core box records
+    # the honest ratio and the bar reads not-applicable, mirroring
+    # cfg12's 8-device gating)
+    ("cfg20_parallel_mesh", "collective_ops_total", "<=", 0),
+    ("cfg20_parallel_mesh", "recompiles", "<=", 0),
+    ("cfg20_parallel_mesh", "parallel_speedup_on_multicore", ">=", 1.5),
 ]
 
 #: Derived fields computable from any row that carries the inputs.
@@ -192,6 +211,14 @@ DERIVED = {
         row["peak_footprint_bytes"] / row["budget_bytes"]
         if row.get("budget_bytes") and "peak_footprint_bytes" in row
         else None),
+    # the cfg20 speedup bar, gated on the hardware that defines it:
+    # lane workers are host threads, so the 1.5x bound only binds where
+    # the row's own recorded core count can pay it (a sub-4-core row
+    # reads "seeds"/not-applicable, never a free pass on a real mesh)
+    "parallel_speedup_on_multicore": lambda row: (
+        row["parallel_speedup_vs_sequential"]
+        if row.get("n_cores", 0) >= 4
+        and "parallel_speedup_vs_sequential" in row else None),
 }
 
 

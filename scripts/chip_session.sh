@@ -184,6 +184,15 @@ run "cfg18_residency" 1200 python -m benchmarks.run_all --residency-session
 # model-wrong-answers on the untimed audit pass and zero demotions all
 # asserted inside the measurement; appended to BENCH_SESSIONS.jsonl
 run "cfg19_learned_index" 1800 python -m benchmarks.run_all --learned-session
+# parallel mesh execution (ISSUE 20): the cfg20 row — the same mesh +
+# map-population stream with the per-lane worker threads ON vs OFF
+# (AMTPU_PARALLEL_LANES), byte-identical sample captures + per-lane
+# counters + the zero-collective audit + zero steady-state recompiles
+# asserted inside the measurement; the 1.5x speedup bar asserts on
+# >= 4-core hosts (the chip host qualifies; this box's 1-core dryrun
+# records the honest gated ratio). Subprocess with the 8-virtual-device
+# env, like cfg12; appended to BENCH_SESSIONS.jsonl
+run "cfg20_parallel" 1800 python -m benchmarks.run_all --parallel-session
 if [ "${AMTPU_SESSION_DRYRUN:-0}" = "1" ]; then
   # NO --record in a dry run: write_record replaces same-platform rows,
   # and a pipeline-validation pass must never overwrite the curated cpu

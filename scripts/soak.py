@@ -1051,6 +1051,7 @@ def session_sharded(seed: int, n_docs: int = 8, n_actors: int = 2,
     mid-stream"); single-shard runs prove the same stream without any
     migration, so the comparison also pins migration neutrality."""
     from automerge_tpu.shard import ShardedDocSet
+    from automerge_tpu.shard.parallel import parallel_lanes_enabled
     from automerge_tpu.shard.placement import hash_shard
 
     # hot doc: hammered `hot_factor` harder than the rest, chosen (from
@@ -1066,6 +1067,7 @@ def session_sharded(seed: int, n_docs: int = 8, n_actors: int = 2,
             hot_doc = d
             break
     results = {}
+    exec_stats = {}
     for n_shards in shard_counts:
         docs, rounds = _sharded_stream(seed, n_docs, n_actors, n_seqs,
                                        hot_doc, hot_factor=4,
@@ -1073,8 +1075,24 @@ def session_sharded(seed: int, n_docs: int = 8, n_actors: int = 2,
         mesh = ShardedDocSet(n_shards=n_shards, capacity=64)
         if n_shards >= 2:
             mesh.attach_rebalancer(ratio=2.0, min_ops=64, cooldown=2)
-        for chunk in rounds:
-            mesh.deliver_round(chunk)
+        # deliver_rounds (not a deliver_round loop): the multi-shard leg
+        # runs the INTERNALS §24 parallel tier — per-lane workers + the
+        # round-pipelining pre-decode seam — so the byte-identity
+        # comparison below also pins parallel-vs-sequential parity (the
+        # 1-shard leg stays the sequential comparator by default)
+        mesh.deliver_rounds(rounds)
+        ex = mesh._executor
+        if parallel_lanes_enabled(n_shards):
+            assert ex is not None, \
+                f"sharded seed {seed} ({n_shards} shards): parallel " \
+                "lanes enabled but no executor engaged"
+        if ex is not None:
+            assert ex.stats["barriers"] > 0 and ex.stats["errors"] == 0 \
+                and ex.stats["submitted"] == ex.stats["completed"], \
+                f"sharded seed {seed} ({n_shards} shards): lane workers " \
+                f"attached but never engaged cleanly ({ex.stats})"
+            exec_stats[n_shards] = dict(ex.stats)
+        mesh.close()
         for doc in docs:
             assert mesh.quarantined(doc) == 0, \
                 f"sharded seed {seed} ({n_shards} shards): quarantine " \
@@ -1105,7 +1123,8 @@ def session_sharded(seed: int, n_docs: int = 8, n_actors: int = 2,
                             for n in shard_counts},
         migrations=results[multi][2]["migrations"],
         parked=results[multi][2]["parked"],
-        released=results[multi][2]["released"])
+        released=results[multi][2]["released"],
+        lane_executor={str(n): st for n, st in exec_stats.items()})
 
 
 def session_residency(seed: int, n_docs: int = 40, n_seqs: int = 4,

@@ -1,0 +1,545 @@
+"""Parallel mesh execution (automerge_tpu/shard/parallel, INTERNALS §24).
+
+The tier's contract is FLAG parity: the same seeded chaotic session must
+converge to byte-identical state (checkpoint-bundle bytes AND rendered
+texts, lane counters included) with the per-lane workers on or off, at
+every shard count — the sequential loop is kept verbatim as the parity
+comparator. Plus: the executor lifecycle (persistent workers, drain-
+before-stop close, submit-after-close refusal), worker-error surfacing
+at the round barrier AFTER every lane quiesced, the deliver_rounds /
+service-tick host-overlap seams (pre-decoded batches actually engage and
+never change results), the barrier-wait telemetry + `amtpu_mesh_*`
+exposition families, and the residency tier under parallelism (budget
+holds after every round; the reservation ledger survives a
+barrier-released page-in thundering herd).
+"""
+
+import json
+import random
+import threading
+
+import pytest
+
+from automerge_tpu.engine import stacked
+from automerge_tpu.obs import device_truth as dt
+from automerge_tpu.obs.telemetry import Telemetry
+from automerge_tpu.shard import ShardLane, ShardedDocSet
+from automerge_tpu.shard.parallel import (LaneExecutor,
+                                          parallel_lanes_enabled,
+                                          tick_pipeline_enabled)
+from test_shard import chaotic_stream, map_change, text_change
+
+
+@pytest.fixture(autouse=True)
+def _small_gate(monkeypatch):
+    """Engage the stacked path at test scale."""
+    monkeypatch.setenv("AMTPU_STACKED_MIN_OPS", "1")
+
+
+# ---------------------------------------------------------------------------
+# the flags
+# ---------------------------------------------------------------------------
+
+
+class TestFlags:
+    def test_parallel_default_is_multi_lane_only(self, monkeypatch):
+        monkeypatch.delenv("AMTPU_PARALLEL_LANES", raising=False)
+        assert not parallel_lanes_enabled(1)
+        assert parallel_lanes_enabled(2)
+        assert parallel_lanes_enabled(8)
+
+    def test_parallel_overrides(self, monkeypatch):
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "0")
+        assert not parallel_lanes_enabled(8)
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "1")
+        assert parallel_lanes_enabled(1)
+
+    def test_tick_pipeline_follows_parallel_by_default(self, monkeypatch):
+        monkeypatch.delenv("AMTPU_TICK_PIPELINE", raising=False)
+        monkeypatch.delenv("AMTPU_PARALLEL_LANES", raising=False)
+        assert tick_pipeline_enabled(2) and not tick_pipeline_enabled(1)
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "0")
+        assert not tick_pipeline_enabled(2)
+
+    def test_tick_pipeline_overrides_independently(self, monkeypatch):
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "1")
+        monkeypatch.setenv("AMTPU_TICK_PIPELINE", "0")
+        assert not tick_pipeline_enabled(8)
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "0")
+        monkeypatch.setenv("AMTPU_TICK_PIPELINE", "1")
+        assert tick_pipeline_enabled(1)
+
+
+# ---------------------------------------------------------------------------
+# flag parity: the tier's headline contract
+# ---------------------------------------------------------------------------
+
+
+def _run_mesh(seed, n_shards, flag, monkeypatch, rounds_api=False):
+    monkeypatch.setenv("AMTPU_PARALLEL_LANES", flag)
+    docs, rounds = chaotic_stream(seed)
+    mesh = ShardedDocSet(n_shards=n_shards, capacity=64)
+    try:
+        if rounds_api:
+            mesh.deliver_rounds(rounds)
+        else:
+            for chunk in rounds:
+                mesh.deliver_round(chunk)
+        for d in docs:
+            assert mesh.quarantined(d) == 0
+        bundles = {d: mesh.capture(d) for d in docs}
+        texts = mesh.texts()
+        lane_stats = [dict(lane.stats) for lane in mesh.lanes]
+        ex_stats = dict(mesh._executor.stats) \
+            if mesh._executor is not None else None
+    finally:
+        mesh.close()
+    return bundles, texts, lane_stats, ex_stats
+
+
+class TestFlagParity:
+    @pytest.mark.parametrize("seed", [0, 1])
+    @pytest.mark.parametrize("n_shards", [1, 2, 8])
+    def test_parallel_matches_sequential_byte_identical(
+            self, seed, n_shards, monkeypatch):
+        """parallel vs sequential on the same seeded chaotic stream:
+        byte-identical bundles, texts, AND per-lane counters (the
+        fold-at-the-barrier stats discipline is exact)."""
+        seq = _run_mesh(seed, n_shards, "0", monkeypatch)
+        par = _run_mesh(seed, n_shards, "1", monkeypatch)
+        assert par[0] == seq[0], "bundle bytes diverged"
+        assert par[1] == seq[1], "texts diverged"
+        assert par[2] == seq[2], "lane stats diverged"
+        assert seq[3] is None                 # comparator never fanned out
+        assert par[3] is not None and par[3]["errors"] == 0
+        assert par[3]["submitted"] == par[3]["completed"] > 0
+        assert par[3]["barriers"] > 0
+
+    def test_deliver_rounds_overlap_engages_and_stays_identical(
+            self, monkeypatch):
+        """The lane-level round-pipelining seam: deliver_rounds
+        pre-decodes round t+1 while round t's lane work drains — the
+        overlap counters move and the result is still byte-identical to
+        the sequential per-round loop."""
+        seq = _run_mesh(3, 8, "0", monkeypatch)
+        par = _run_mesh(3, 8, "1", monkeypatch, rounds_api=True)
+        assert par[0] == seq[0] and par[1] == seq[1] and par[2] == seq[2]
+        assert par[3]["rounds_overlapped"] > 0
+        assert par[3]["predecoded_batches"] > 0
+
+    def test_forced_parallel_on_one_lane(self, monkeypatch):
+        """AMTPU_PARALLEL_LANES=1 on a 1-lane mesh runs the worker path
+        (nothing to overlap, still correct)."""
+        seq = _run_mesh(2, 1, "0", monkeypatch)
+        par = _run_mesh(2, 1, "1", monkeypatch)
+        assert par[0] == seq[0] and par[1] == seq[1] and par[2] == seq[2]
+        assert par[3]["submitted"] > 0
+
+    def test_migration_mid_stream_under_parallelism(self, monkeypatch):
+        """Migration pens + the commit-boundary barrier: an 8-shard
+        parallel run that migrates docs between rounds still lands
+        byte-identical with the sequential 1-shard reference."""
+        docs, rounds = chaotic_stream(9, n_chunks=4)
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "0")
+        ref = ShardedDocSet(n_shards=1, capacity=64)
+        for chunk in rounds:
+            ref.deliver_round(chunk)
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "1")
+        mesh = ShardedDocSet(n_shards=8, capacity=64)
+        try:
+            moved = 0
+            for i, chunk in enumerate(rounds):
+                mesh.deliver_round(chunk)
+                victim = docs[i % len(docs)]
+                if mesh.doc(victim) is not None:
+                    dst = (mesh.placement.shard_of(victim) + 3) % 8
+                    moved += mesh.migrate(victim, dst)
+            assert moved >= 2, "migrations never engaged"
+            assert mesh.texts() == ref.texts()
+            for d in docs:
+                assert mesh.capture(d) == ref.capture(d)
+        finally:
+            mesh.close()
+
+
+# ---------------------------------------------------------------------------
+# the executor: lifecycle, ordering, errors, telemetry
+# ---------------------------------------------------------------------------
+
+
+def _lanes(n):
+    return [ShardLane(i) for i in range(n)]
+
+
+class TestExecutor:
+    def test_results_in_submission_order(self):
+        with LaneExecutor(_lanes(3)) as ex:
+            tasks = [ex.submit(i, lambda v=i: v * 10) for i in range(3)]
+            assert ex.barrier(tasks) == [0, 10, 20]
+            assert ex.stats["completed"] == 3
+            assert ex.stats["barriers"] == 1
+
+    def test_per_lane_tasks_run_in_order(self):
+        seen = []
+        with LaneExecutor(_lanes(1)) as ex:
+            tasks = [ex.submit(0, seen.append, k) for k in range(20)]
+            ex.barrier(tasks)
+        assert seen == list(range(20))
+
+    def test_close_is_idempotent_and_drains_pending(self):
+        done = []
+        ex = LaneExecutor(_lanes(2))
+        for k in range(6):
+            ex.submit(k % 2, done.append, k)
+        ex.close()
+        ex.close()
+        assert sorted(done) == list(range(6)), \
+            "close abandoned in-flight work"
+        assert all(not w.is_alive() for w in ex._workers.values())
+        with pytest.raises(RuntimeError):
+            ex.submit(0, lambda: None)
+
+    def test_error_reraises_after_all_lanes_quiesce(self):
+        """A worker error (the budget-assert shape) surfaces on the
+        caller at the barrier — but only after every OTHER lane's task
+        finished, so no lane races the caller's unwind."""
+        other_done = threading.Event()
+
+        def boom():
+            raise AssertionError("round budget exceeded")
+
+        def slow_ok():
+            other_done.wait(timeout=5)
+            return "ok"
+
+        with LaneExecutor(_lanes(2)) as ex:
+            t0 = ex.submit(0, boom)
+            t1 = ex.submit(1, slow_ok)
+            other_done.set()
+            with pytest.raises(AssertionError, match="round budget"):
+                ex.barrier([t0, t1])
+            assert t1.done() and t1.result == "ok"
+            assert ex.stats["errors"] == 1
+
+    def test_while_waiting_runs_before_the_block(self):
+        order = []
+        with LaneExecutor(_lanes(1)) as ex:
+            task = ex.submit(0, lambda: order.append("work"))
+            ex.barrier([task], while_waiting=lambda: order.append("over"))
+        assert "over" in order
+
+    def test_barrier_wait_telemetry_and_families(self):
+        tel = Telemetry()
+        with LaneExecutor(_lanes(2), telemetry=tel) as ex:
+            tasks = [ex.submit(i, lambda: None) for i in range(2)]
+            ex.barrier(tasks)
+            hists, aggs = tel.span_view()
+            assert ("mesh", "barrier_wait") in hists
+            assert aggs[("mesh", "barrier_wait")]["count"] == 1
+            fams = ex.families()
+            names = [f[0] for f in fams]
+            assert "amtpu_mesh_workers" in names
+            assert "amtpu_mesh_rounds_total" in names
+            assert "amtpu_mesh_rounds_overlapped_total" in names
+            assert "amtpu_mesh_barriers_total" in names
+            assert "amtpu_mesh_barrier_wait_seconds" in names
+            workers = dict(zip(names, fams))["amtpu_mesh_workers"]
+            assert workers[3] == [({}, 2)]
+            d = ex.describe()
+            assert d["schema"] == "amtpu-mesh-exec-v1"
+            assert len(d["workers"]) == 2
+
+    def test_budget_assert_surfaces_through_the_mesh(self, monkeypatch):
+        """The per-lane round-budget assert — evaluated on the worker
+        against the stats dict ITS apply returned — propagates to the
+        deliver_round caller; the mesh stays usable afterwards."""
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "1")
+        mesh = ShardedDocSet(n_shards=2, capacity=64, doc_kind="map")
+        try:
+            def boom(st):
+                raise AssertionError("dispatch budget exceeded")
+            monkeypatch.setattr(stacked, "assert_round_budget", boom)
+            round_ = {f"bud-{i}": [map_change("a", 1, f"bud-{i}",
+                                              [("k", i)])]
+                      for i in range(8)}
+            with pytest.raises(AssertionError, match="dispatch budget"):
+                mesh.deliver_round(round_)
+            monkeypatch.undo()
+            monkeypatch.setenv("AMTPU_PARALLEL_LANES", "1")
+            monkeypatch.setenv("AMTPU_STACKED_MIN_OPS", "1")
+            round2 = {f"ok-{i}": [map_change("a", 1, f"ok-{i}",
+                                             [("k", i)])]
+                      for i in range(8)}
+            assert mesh.deliver_round(round2) == 8
+        finally:
+            mesh.close()
+
+    def test_mesh_describe_carries_executor(self, monkeypatch):
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "1")
+        mesh = ShardedDocSet(n_shards=2, capacity=64)
+        try:
+            mesh.deliver_round({
+                "da": [text_change("a", 1, "x", obj="da")],
+                "db": [text_change("a", 1, "y", obj="db")]})
+            d = mesh.describe()
+            assert d["mesh_exec"]["schema"] == "amtpu-mesh-exec-v1"
+        finally:
+            mesh.close()
+
+
+# ---------------------------------------------------------------------------
+# service tick pipelining
+# ---------------------------------------------------------------------------
+
+
+def _service_session(monkeypatch, flag, n_rooms=4, steps=24, **cfg_kw):
+    from test_service import _Client, _seed, am
+    from automerge_tpu.service import ServiceConfig, SyncService
+    monkeypatch.setenv("AMTPU_PARALLEL_LANES", flag)
+    monkeypatch.setenv("AMTPU_TICK_PIPELINE", flag)
+    svc = SyncService(ServiceConfig(shard_lanes=4, **cfg_kw))
+    rng = random.Random(31)
+    rooms = [f"pr-{i}" for i in range(n_rooms)]
+    clients = []
+    for room_id in rooms:
+        base = _seed(svc, room_id)
+        clients.append(_Client(svc, f"{room_id}-t0", room_id, base=base))
+    for step in range(steps):
+        c = rng.choice(clients)
+        c.edit(f"k{rng.randrange(6)}", f"v{step}")
+        if step % 3 == 0:
+            for cl in clients:
+                cl.pump()
+            svc.tick()
+    for _ in range(300):
+        for cl in clients:
+            cl.pump()
+        svc.tick()
+        if svc.idle() and all(cl.chan.idle and not cl.to_server
+                              and not cl.to_client for cl in clients):
+            break
+    state = {r: json.dumps(am.to_json(svc.room(r).doc_set.get_doc(r)),
+                           sort_keys=True) for r in rooms}
+    lane_stats = [dict(lane.stats) for lane in svc._shard_lanes]
+    ex = svc._mesh_executor()
+    ex_stats = dict(ex.stats) if ex is not None else None
+    svc.close()
+    return state, lane_stats, ex_stats, svc
+
+
+class TestServiceTickPipeline:
+    def test_tick_parity_pipelined_vs_sequential(self, monkeypatch):
+        """The same multi-room client session through the pipelined and
+        the sequential tick: identical final room docs, identical lane
+        counters; the executor actually fanned out in the ON leg."""
+        seq = _service_session(monkeypatch, "0")
+        par = _service_session(monkeypatch, "1")
+        assert par[0] == seq[0], "room docs diverged"
+        assert par[1] == seq[1], "lane stats diverged"
+        assert seq[2] is None
+        assert par[2] is not None and par[2]["errors"] == 0
+        assert par[2]["barriers"] > 0 and par[2]["completed"] > 0
+
+    def test_executor_shared_with_residency_mesh(self, monkeypatch,
+                                                 tmp_path):
+        """When the bulk doc mesh rides the service's own lanes
+        (sharded + residency) the tick fan-out reuses the mesh's worker
+        pool — ONE set of persistent threads."""
+        from automerge_tpu.service import ServiceConfig, SyncService
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "1")
+        svc = SyncService(ServiceConfig(
+            shard_lanes=4, residency_budget_bytes=1 << 30,
+            residency_spill_dir=str(tmp_path)))
+        try:
+            assert svc.doc_mesh is not None
+            assert svc._mesh_executor() is svc.doc_mesh.executor()
+            assert svc._tick_executor is None
+        finally:
+            svc.close()
+
+    def test_tick_overlap_predecodes_mesh_backlog(self, monkeypatch,
+                                                  tmp_path):
+        """The tick-pipelining host-overlap seam: while tick t's
+        grouped gate deliveries drain on the workers, the queued
+        bulk-mesh rounds pre-decode on the caller — counters move, and
+        the backlog still converges."""
+        from test_service import _Client, _seed
+        from automerge_tpu.service import ServiceConfig, SyncService
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "1")
+        monkeypatch.setenv("AMTPU_TICK_PIPELINE", "1")
+        svc = SyncService(ServiceConfig(
+            shard_lanes=4, residency_budget_bytes=1 << 30,
+            residency_spill_dir=str(tmp_path)))
+        try:
+            clients = []
+            for i in range(4):
+                base = _seed(svc, f"ov-{i}")
+                clients.append(_Client(svc, f"ov-{i}-t0", f"ov-{i}",
+                                       base=base))
+            # materialize the bulk-mesh doc (predecode only touches
+            # already-resident docs), then keep the backlog fed while
+            # multi-lane grouped deliveries force the fan-out whose
+            # barrier runs the overlap
+            svc.mesh_deliver({"bulk": [text_change("ba", 1, "xx",
+                                                   obj="bulk")]})
+            svc.tick()
+            seq = 1
+            for step in range(8):
+                for j, c in enumerate(clients):
+                    c.edit("k", f"v{step}-{j}")
+                seq += 1
+                svc.mesh_deliver({"bulk": [text_change(
+                    "ba", seq, "yy", start_ctr=(seq - 1) * 2 + 1,
+                    after=f"ba:{(seq - 1) * 2}", obj="bulk")]})
+                for c in clients:
+                    c.pump()
+                svc.tick()
+            ex = svc._mesh_executor()
+            assert ex is not None
+            assert ex.stats["predecoded_batches"] > 0
+            assert ex.stats["rounds_overlapped"] > 0
+            lane = svc.doc_mesh.lane_of("bulk")
+            with lane.device_ctx():
+                assert lane.docs["bulk"].text() == "xx" + "yy" * (seq - 1)
+        finally:
+            svc.close()
+
+    def test_scrape_exposes_mesh_families(self, monkeypatch):
+        from test_service import _Client, _seed
+        from automerge_tpu.service import ServiceConfig, SyncService
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "1")
+        monkeypatch.setenv("AMTPU_TICK_PIPELINE", "1")
+        svc = SyncService(ServiceConfig(shard_lanes=4))
+        try:
+            clients = []
+            for i in range(4):
+                base = _seed(svc, f"sc-{i}")
+                clients.append(_Client(svc, f"sc-{i}-t0", f"sc-{i}",
+                                       base=base))
+            for step in range(6):
+                for j, c in enumerate(clients):
+                    c.edit("k", f"v{step}-{j}")
+                for c in clients:
+                    c.pump()
+                svc.tick()
+            assert svc._tick_executor is not None, \
+                "the tick fan-out never engaged"
+            page = svc.scrape()
+            assert "amtpu_mesh_workers" in page
+            assert "amtpu_mesh_barriers_total" in page
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# residency under parallelism (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def _fresh_gauges():
+    dt.REGISTRY.clear_session()
+    yield
+    dt.REGISTRY.clear_session()
+
+
+class TestResidencyUnderParallelism:
+    def test_population_10x_budget_peak_bounded_with_workers_on(
+            self, monkeypatch, tmp_path, _fresh_gauges):
+        """ISSUE 18's acceptance shape with the lane workers ON: a
+        population 10x the device budget, the doc-kind peak footprint
+        gauge never exceeds the budget after ANY round — the residency
+        hooks stay caller-thread at the commit boundary, so the budget
+        invariant is untouched by parallelism."""
+        from test_residency import build_mesh, prime
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "1")
+        mesh, res = build_mesh(n_shards=2, spill_dir=str(tmp_path),
+                               budget=0, cold_after=3)
+        try:
+            prime(mesh, res)
+            per_doc = res._est_bytes
+            assert per_doc > 0
+            budget = 3 * per_doc
+            res.config.budget_bytes = budget
+            n_docs, seqs = 30, {i: 0 for i in range(30)}
+            rng = random.Random(20)
+            for rnd in range(40):
+                deliveries = {}
+                for i in rng.sample(range(n_docs), 2):
+                    seqs[i] += 1
+                    a = f"a-doc{i}"
+                    deliveries[f"doc{i}"] = [text_change(
+                        a, seqs[i], "x", start_ctr=seqs[i], obj=f"doc{i}",
+                        after=(None if seqs[i] == 1
+                               else f"{a}:{seqs[i] - 1}"))]
+                mesh.deliver_round(deliveries)
+                fp = dt.REGISTRY.footprint()
+                assert fp["peak_device_bytes"] <= budget, (
+                    f"round {rnd}: peak {fp['peak_device_bytes']} > "
+                    f"budget {budget}")
+            m = res.metrics()
+            assert m["budget_overruns"] == 0
+            assert m["page_outs"] > 0 and m["page_ins"] > 0
+            acct = res.accounting()
+            population = sorted(acct["hot"] + acct["warm"] + acct["cold"])
+            assert population == sorted(
+                f"doc{i}" for i in range(n_docs) if seqs[i])
+            assert mesh._executor is not None \
+                and mesh._executor.stats["barriers"] > 0
+        finally:
+            mesh.close()
+
+    def test_reservation_ledger_survives_page_in_thundering_herd(
+            self, monkeypatch, tmp_path, _fresh_gauges):
+        """The ledger-banking lock: a barrier-released herd of threads
+        paging distinct demoted docs in concurrently must keep the
+        make-room/adopt pairs atomic — the budget holds at the herd's
+        peak, and every doc lands in exactly one tier with its content
+        intact."""
+        from test_residency import build_mesh, prime
+        monkeypatch.setenv("AMTPU_PARALLEL_LANES", "0")
+        mesh, res = build_mesh(n_shards=2, spill_dir=str(tmp_path),
+                               budget=0)
+        try:
+            prime(mesh, res)
+            per_doc = res._est_bytes
+            budget = 3 * per_doc
+            res.config.budget_bytes = budget
+            n_docs = 8
+            for i in range(n_docs):
+                mesh.deliver_round({f"h{i}": [text_change(
+                    f"a{i}", 1, "z", obj=f"h{i}")]})
+            for i in range(n_docs):
+                if res.tier_of(f"h{i}") == "hot":
+                    res.demote(f"h{i}")
+            start = threading.Barrier(n_docs)
+            errors = []
+
+            def herd(i):
+                try:
+                    start.wait(timeout=10)
+                    res.ensure_resident(f"h{i}")
+                except Exception as exc:   # noqa: BLE001
+                    errors.append(exc)
+            threads = [threading.Thread(target=herd, args=(i,))
+                       for i in range(n_docs)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors, errors
+            fp = dt.REGISTRY.footprint()
+            assert fp["peak_device_bytes"] <= budget, (
+                f"herd peak {fp['peak_device_bytes']} > budget {budget}")
+            acct = res.accounting()
+            tiers = acct["hot"] + acct["warm"] + acct["cold"]
+            herd_docs = [d for d in tiers if d.startswith("h")]
+            assert sorted(herd_docs) == [f"h{i}" for i in range(n_docs)]
+            assert res.metrics()["budget_overruns"] == 0
+            for i in range(n_docs):
+                res.ensure_resident(f"h{i}")
+                lane = mesh.lane_of(f"h{i}")
+                with lane.device_ctx():
+                    assert lane.docs[f"h{i}"].text() == "z"
+        finally:
+            mesh.close()
